@@ -1,0 +1,82 @@
+"""Embedded default configuration.
+
+Schema parity with the reference's embedded default
+(reference: relayrl_framework/src/default_config.json and the
+DEFAULT_CONFIG_CONTENT string in src/sys_utils/config_loader.rs:66-113):
+per-algorithm hyperparams, three endpoint addresses, model paths, tensorboard
+settings, max trajectory length. TPU-native additions live under "learner"
+(mesh/batching knobs absent from the reference, which has no device story).
+
+Model artifacts are `.rlx` ModelBundles (params + arch + version), not
+TorchScript `.pt`.
+"""
+
+from __future__ import annotations
+
+import copy
+
+DEFAULT_CONFIG: dict = {
+    "algorithms": {
+        "REINFORCE": {
+            "discrete": True,
+            "with_vf_baseline": False,
+            "seed": 1,
+            "traj_per_epoch": 8,
+            "gamma": 0.98,
+            "lam": 0.97,
+            "pi_lr": 3e-4,
+            "vf_lr": 1e-3,
+            "train_vf_iters": 80,
+            "hidden_sizes": [128, 128],
+        },
+        "PPO": {
+            "discrete": True,
+            "seed": 1,
+            "traj_per_epoch": 8,
+            "gamma": 0.99,
+            "lam": 0.95,
+            "clip_ratio": 0.2,
+            "pi_lr": 3e-4,
+            "vf_lr": 1e-3,
+            "train_iters": 4,
+            "minibatch_count": 4,
+            "ent_coef": 0.0,
+            "vf_coef": 0.5,
+            "target_kl": 0.015,
+            "hidden_sizes": [128, 128],
+        },
+    },
+    "grpc_idle_timeout_s": 30.0,
+    "max_traj_length": 1000,
+    "model_paths": {
+        "client_model": "client_model.rlx",
+        "server_model": "server_model.rlx",
+    },
+    "server": {
+        "training_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "50051"},
+        "trajectory_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7776"},
+        "agent_listener": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7777"},
+    },
+    "training_tensorboard": {
+        "launch_tb_on_startup": False,
+        "scalar_tags": "AverageEpRet;LossPi",
+        "global_step_tag": "Epoch",
+    },
+    "learner": {
+        "batch_trajectories": 8,
+        "bucket_lengths": [64, 256, 1000],
+        "mesh": {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1},
+        "precision": "bfloat16",
+        "checkpoint_dir": "checkpoints",
+        "checkpoint_every_epochs": 10,
+    },
+}
+
+# Algorithm whitelist, matching the reference's registry
+# (config_loader.rs:397-433 lists C51/DDPG/DQN/PPO/REINFORCE/SAC/TD3 even
+# though only REINFORCE is implemented there).
+SUPPORTED_ALGORITHMS = ("C51", "DDPG", "DQN", "PPO", "REINFORCE", "SAC", "TD3")
+
+
+def default_config() -> dict:
+    return copy.deepcopy(DEFAULT_CONFIG)

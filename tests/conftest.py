@@ -1,0 +1,26 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective paths are
+validated on XLA's host platform with 8 virtual devices (the standard JAX
+technique for testing pjit/shard_map topologies without a pod).
+"""
+
+import os
+
+# Must be set before jax (or anything importing jax) is imported.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_cwd(tmp_path, monkeypatch):
+    """Run a test inside a throwaway cwd (config auto-create writes there)."""
+    monkeypatch.chdir(tmp_path)
+    return tmp_path

@@ -1,0 +1,253 @@
+"""Unit tests for the wire types/codecs (ref test strategy gap: SURVEY.md §4
+— the reference has zero tests; codec round-trips are the Stage-0 fixtures)."""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.types import (
+    ActionRecord,
+    DType,
+    ModelBundle,
+    TensorSpec,
+    Trajectory,
+    decode_tensor,
+    deserialize_actions,
+    encode_tensor,
+    from_numpy_dtype,
+    serialize_actions,
+    spec_of,
+    to_numpy_dtype,
+)
+
+
+ALL_DTYPES = [
+    np.uint8,
+    np.int16,
+    np.int32,
+    np.int64,
+    np.float32,
+    np.float64,
+    np.bool_,
+    np.float16,
+]
+
+
+class TestDtypes:
+    @pytest.mark.parametrize("np_dtype", ALL_DTYPES)
+    def test_round_trip(self, np_dtype):
+        tag = from_numpy_dtype(np_dtype)
+        assert to_numpy_dtype(tag) == np.dtype(np_dtype)
+
+    def test_bfloat16(self):
+        import ml_dtypes
+
+        tag = from_numpy_dtype(ml_dtypes.bfloat16)
+        assert tag == DType.BFLOAT16
+        assert to_numpy_dtype(tag) == np.dtype(ml_dtypes.bfloat16)
+
+    def test_unknown_rejected(self):
+        with pytest.raises(ValueError):
+            from_numpy_dtype(np.complex64)
+        with pytest.raises(ValueError):
+            to_numpy_dtype(250)
+
+
+class TestTensorCodec:
+    @pytest.mark.parametrize("np_dtype", ALL_DTYPES)
+    @pytest.mark.parametrize("shape", [(), (1,), (7,), (3, 4), (2, 3, 4, 5)])
+    def test_round_trip(self, np_dtype, shape):
+        rng = np.random.default_rng(0)
+        arr = (rng.random(shape) * 100).astype(np_dtype)
+        out = decode_tensor(encode_tensor(arr))
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+
+    def test_jax_array(self):
+        import jax.numpy as jnp
+
+        arr = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        out = decode_tensor(encode_tensor(arr))
+        np.testing.assert_array_equal(out, np.asarray(arr))
+
+    def test_non_contiguous(self):
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]
+        out = decode_tensor(encode_tensor(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_spec_of(self):
+        buf = encode_tensor(np.zeros((5, 2), dtype=np.int32))
+        assert spec_of(buf) == TensorSpec(shape=(5, 2), dtype=DType.INT32)
+
+    def test_corrupt_frames_rejected(self):
+        buf = encode_tensor(np.zeros(4, dtype=np.float32))
+        with pytest.raises(ValueError):
+            decode_tensor(buf[:-1])  # truncated payload
+        with pytest.raises(ValueError):
+            decode_tensor(b"\x00\x00" + bytes(buf[2:]))  # bad magic
+        with pytest.raises(ValueError):
+            decode_tensor(b"\x12")  # truncated header
+
+    def test_spec_of_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            spec_of(b"\x01")  # truncated header
+        buf = bytearray(encode_tensor(np.zeros((2, 2), np.float32)))
+        with pytest.raises(ValueError):
+            spec_of(bytes(buf[:6]))  # header ok, dims missing
+
+    def test_decode_is_zero_copy(self):
+        arr = np.arange(8, dtype=np.float32)
+        buf = encode_tensor(arr)
+        out = decode_tensor(buf)
+        assert not out.flags.writeable  # view over the immutable bytes
+
+
+class TestActionRecord:
+    def _sample(self):
+        return ActionRecord(
+            obs=np.arange(4, dtype=np.float32),
+            act=np.array(1, dtype=np.int32),
+            mask=np.ones(2, dtype=np.float32),
+            rew=1.5,
+            data={
+                "logp_a": np.float32(-0.69),
+                "v": np.float32(0.5),
+                "note": "aux",
+                "flag": True,
+                "count": 7,
+                "vec": np.arange(3, dtype=np.float64),
+            },
+            done=False,
+        )
+
+    def test_round_trip(self):
+        a = self._sample()
+        b = ActionRecord.from_bytes(a.to_bytes())
+        np.testing.assert_array_equal(b.obs, a.obs)
+        np.testing.assert_array_equal(b.act, a.act)
+        np.testing.assert_array_equal(b.mask, a.mask)
+        assert b.rew == pytest.approx(a.rew)
+        assert b.done is False and b.reward_updated is False
+        assert b.data["note"] == "aux"
+        assert b.data["flag"] is True
+        assert b.data["count"] == 7
+        assert b.data["logp_a"] == pytest.approx(-0.69, abs=1e-6)
+        np.testing.assert_array_equal(b.data["vec"], a.data["vec"])
+
+    def test_none_fields(self):
+        a = ActionRecord(rew=0.25, done=True)
+        b = ActionRecord.from_bytes(a.to_bytes())
+        assert b.obs is None and b.act is None and b.mask is None
+        assert b.done is True
+        assert b.rew == pytest.approx(0.25)
+
+    def test_update_reward(self):
+        a = ActionRecord(rew=0.0)
+        a.update_reward(3.0)
+        assert a.rew == 3.0 and a.reward_updated is True
+        b = ActionRecord.from_bytes(a.to_bytes())
+        assert b.reward_updated is True
+
+    def test_getters(self):
+        a = self._sample()
+        assert a.get_rew() == a.rew
+        assert a.get_done() is False
+        np.testing.assert_array_equal(a.get_obs(), a.obs)
+
+
+class TestTrajectory:
+    def _action(self, i, done=False):
+        return ActionRecord(
+            obs=np.full(3, i, dtype=np.float32),
+            act=np.array(i, dtype=np.int64),
+            rew=float(i),
+            done=done,
+        )
+
+    def test_wire_round_trip(self):
+        actions = [self._action(i, done=(i == 4)) for i in range(5)]
+        buf = serialize_actions(actions)
+        out = deserialize_actions(buf)
+        assert len(out) == 5
+        assert out[-1].done is True
+        for i, a in enumerate(out):
+            np.testing.assert_array_equal(a.obs, actions[i].obs)
+            assert a.rew == float(i)
+
+    def test_send_on_done_clears(self):
+        sent = []
+        traj = Trajectory(max_length=100, on_send=sent.append)
+        for i in range(3):
+            assert traj.add_action(self._action(i), send_if_done=True) is False
+        assert traj.add_action(self._action(3, done=True), send_if_done=True) is True
+        assert len(traj) == 0, "buffer must clear after send (ref bug fixed)"
+        assert len(sent) == 1
+        assert len(deserialize_actions(sent[0])) == 4
+
+    def test_no_cumulative_resend(self):
+        # The reference re-sends earlier episodes because it clears only at
+        # max_length (trajectory.rs:196-202). Two episodes → two disjoint sends.
+        sent = []
+        traj = Trajectory(max_length=100, on_send=sent.append)
+        for ep in range(2):
+            traj.add_action(self._action(0))
+            traj.add_action(self._action(1, done=True))
+        assert [len(deserialize_actions(s)) for s in sent] == [2, 2]
+
+    def test_overflow_flush(self):
+        sent = []
+        traj = Trajectory(max_length=4, on_send=sent.append)
+        for i in range(4):
+            traj.add_action(self._action(i), send_if_done=True)
+        assert len(sent) == 1 and len(traj) == 0
+
+    def test_from_bytes(self):
+        actions = [self._action(i) for i in range(3)]
+        traj = Trajectory.from_bytes(serialize_actions(actions))
+        assert len(traj) == 3
+
+    def test_no_transport_retains_episode(self):
+        # Without on_send a done action must NOT discard data (review fix).
+        traj = Trajectory(max_length=100)
+        traj.add_action(self._action(0))
+        assert traj.add_action(self._action(1, done=True)) is False
+        assert len(traj) == 2
+        traj.clear()
+        assert len(traj) == 0
+
+    def test_max_length_one_stays_bounded(self):
+        traj = Trajectory(max_length=1)
+        for i in range(5):
+            traj.add_action(self._action(i), send_if_done=False)
+        assert len(traj) <= 1
+
+
+class TestModelBundle:
+    def test_round_trip(self):
+        params = {
+            "dense0": {"kernel": np.random.randn(4, 8).astype(np.float32),
+                       "bias": np.zeros(8, dtype=np.float32)},
+            "dense1": {"kernel": np.random.randn(8, 2).astype(np.float32),
+                       "bias": np.zeros(2, dtype=np.float32)},
+        }
+        bundle = ModelBundle(version=3, arch={"kind": "mlp", "obs_dim": 4, "act_dim": 2}, params=params)
+        out = ModelBundle.from_bytes(bundle.to_bytes())
+        assert out.version == 3
+        assert out.arch["kind"] == "mlp"
+        np.testing.assert_array_equal(out.params["dense0"]["kernel"], params["dense0"]["kernel"])
+
+    def test_file_round_trip(self, tmp_path):
+        bundle = ModelBundle(version=1, arch={"kind": "mlp"}, params={"w": np.ones(3, np.float32)})
+        path = tmp_path / "model.rlx"
+        bundle.save(path)
+        out = ModelBundle.load(path)
+        assert out.version == 1
+        np.testing.assert_array_equal(out.params["w"], np.ones(3, np.float32))
+
+    def test_template_restore(self):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((2, 2), jnp.float32)}
+        bundle = ModelBundle(version=1, arch={}, params=params)
+        out = ModelBundle.from_bytes(bundle.to_bytes(), params_template=params)
+        np.testing.assert_array_equal(np.asarray(out.params["w"]), np.ones((2, 2)))
