@@ -1,0 +1,24 @@
+"""Learner algorithms (ref layer L7, SURVEY.md §1).
+
+Importing this package registers the built-in algorithms with the registry;
+the training server resolves ``algorithm_name`` through
+:func:`build_algorithm` (the dynamic-import analogue of the reference's
+python_algorithm_reply.py:41-46).
+"""
+
+from relayrl_tpu.algorithms.base import (
+    AlgorithmBase,
+    build_algorithm,
+    register_algorithm,
+    registered_algorithms,
+)
+from relayrl_tpu.algorithms.reinforce import REINFORCE, ReinforceState
+
+__all__ = [
+    "AlgorithmBase",
+    "build_algorithm",
+    "register_algorithm",
+    "registered_algorithms",
+    "REINFORCE",
+    "ReinforceState",
+]

@@ -66,7 +66,9 @@ DEFAULT_CONFIG: dict = {
         "batch_trajectories": 8,
         "bucket_lengths": [64, 256, 1000],
         "mesh": {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1},
-        "precision": "bfloat16",
+        # compute dtype for policy trunks: float32 on CPU actors/tests;
+        # set "bfloat16" on TPU learners to feed the MXU (bench configs do).
+        "precision": "float32",
         "checkpoint_dir": "checkpoints",
         "checkpoint_every_epochs": 10,
     },

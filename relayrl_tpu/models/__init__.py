@@ -1,0 +1,15 @@
+"""Policy model registry (the framework's model-ABI layer).
+
+Importing this package registers the built-in model families; user plugins
+call :func:`register_model` themselves.
+"""
+
+from relayrl_tpu.models.base import (
+    Policy,
+    build_policy,
+    register_model,
+    validate_policy,
+)
+import relayrl_tpu.models.mlp  # noqa: F401  (registers mlp_discrete/continuous)
+
+__all__ = ["Policy", "build_policy", "register_model", "validate_policy"]

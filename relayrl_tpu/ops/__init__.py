@@ -1,0 +1,17 @@
+"""Numerical ops for RL on fixed-shape padded batches (TPU-first)."""
+
+from relayrl_tpu.ops.gae import (
+    discount_cumsum,
+    gae_advantages,
+    masked_mean_std,
+    normalize_advantages,
+    rewards_to_go,
+)
+
+__all__ = [
+    "discount_cumsum",
+    "gae_advantages",
+    "masked_mean_std",
+    "normalize_advantages",
+    "rewards_to_go",
+]

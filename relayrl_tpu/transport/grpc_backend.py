@@ -1,0 +1,221 @@
+"""gRPC transport backend.
+
+Capability parity with the reference's tonic service
+(reference: relayrl_framework/proto/relayrl_grpc.proto:33-36 — service
+``RelayRLRoute { SendActions, ClientPoll }``; server impl
+src/network/server/training_grpc.rs:565-798; client
+src/network/client/agent_grpc.rs). The two-RPC surface is kept:
+
+* ``SendActions``  — trajectory envelope in, ack out (train is async,
+  matching training_grpc.rs:637-641's immediate reply).
+* ``ClientPoll``   — ``{agent_id, version, first_time}`` in; blocks until a
+  model newer than ``version`` exists or the idle timeout lapses, then
+  returns the bundle (long-poll replacing the reference's watch channel,
+  training_grpc.rs:731-796 — with the timeout honored in *seconds*, fixing
+  the seconds-as-millis bug at :757).
+
+Implementation note: handlers are registered dynamically via
+``grpc.method_handlers_generic_handler`` with msgpack bodies — the wire
+contract is this module, not a compiled proto, so the native C++ backend and
+any future proto can interoperate by speaking the same envelopes.
+
+Departure: the reference agent calls ``process::exit(1)`` on a failed
+trajectory send (agent_grpc.rs:529-531); here send errors raise to the
+caller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import msgpack
+
+from relayrl_tpu.transport.base import (
+    AgentTransport,
+    ServerTransport,
+    unpack_trajectory_envelope,
+)
+
+_SERVICE = "relayrl.RelayRLRoute"
+
+
+def _identity(x: bytes) -> bytes:
+    return x
+
+
+class _Servicer:
+    def __init__(self, owner: "GrpcServerTransport"):
+        self._owner = owner
+
+    def send_actions(self, request: bytes, context) -> bytes:
+        try:
+            agent_id, payload = unpack_trajectory_envelope(request)
+        except Exception:
+            return msgpack.packb({"code": 0, "error": "malformed envelope"})
+        self._owner.on_trajectory(agent_id, payload)
+        return msgpack.packb({"code": 1})
+
+    def client_poll(self, request: bytes, context) -> bytes:
+        req = msgpack.unpackb(request, raw=False)
+        agent_id = str(req.get("id", "?"))
+        known_version = int(req.get("ver", -1))
+        first_time = bool(req.get("first", False))
+        if first_time:
+            self._owner.on_register(agent_id)
+        version, bundle = self._owner.get_model()
+        if first_time or version > known_version:
+            return msgpack.packb({"code": 1, "ver": version, "model": bundle},
+                                 use_bin_type=True)
+        # long poll: wait for a newer model or timeout
+        deadline = time.monotonic() + self._owner.idle_timeout_s
+        with self._owner._model_cv:
+            while True:
+                version, bundle = self._owner.get_model()
+                if version > known_version:
+                    return msgpack.packb(
+                        {"code": 1, "ver": version, "model": bundle},
+                        use_bin_type=True)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not context.is_active():
+                    return msgpack.packb({"code": 0, "ver": version})
+                self._owner._model_cv.wait(timeout=min(remaining, 1.0))
+
+
+class GrpcServerTransport(ServerTransport):
+    def __init__(self, bind_addr: str, idle_timeout_s: float = 30.0,
+                 max_workers: int = 16):
+        super().__init__()
+        self._bind_addr = bind_addr
+        self.idle_timeout_s = float(idle_timeout_s)
+        self._max_workers = max_workers
+        self._server: grpc.Server | None = None
+        self._model_cv = threading.Condition()
+
+    def start(self) -> None:
+        servicer = _Servicer(self)
+        handlers = {
+            "SendActions": grpc.unary_unary_rpc_method_handler(
+                servicer.send_actions,
+                request_deserializer=_identity, response_serializer=_identity),
+            "ClientPoll": grpc.unary_unary_rpc_method_handler(
+                servicer.client_poll,
+                request_deserializer=_identity, response_serializer=_identity),
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers),
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),))
+        self._server.add_insecure_port(self._bind_addr)
+        self._server.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            with self._model_cv:
+                self._model_cv.notify_all()
+            self._server.stop(grace=1).wait()
+            self._server = None
+
+    def publish_model(self, version: int, bundle_bytes: bytes) -> None:
+        # Models are pulled via ClientPoll long-polls; publishing just wakes
+        # the waiters (ref: watch channel notify, training_grpc.rs:600-627).
+        with self._model_cv:
+            self._model_cv.notify_all()
+
+
+class GrpcAgentTransport(AgentTransport):
+    def __init__(self, server_addr: str, identity: str | None = None,
+                 poll_timeout_s: float = 35.0):
+        super().__init__()
+        import os
+        import secrets
+
+        self.identity = identity or f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}"
+        self._addr = server_addr
+        self._poll_timeout_s = poll_timeout_s
+        self._channel = grpc.insecure_channel(
+            server_addr,
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+        )
+        self._send = self._channel.unary_unary(
+            f"/{_SERVICE}/SendActions",
+            request_serializer=_identity, response_deserializer=_identity)
+        self._poll = self._channel.unary_unary(
+            f"/{_SERVICE}/ClientPoll",
+            request_serializer=_identity, response_deserializer=_identity)
+        self._known_version = -1
+        self._stop = threading.Event()
+        self._listener: threading.Thread | None = None
+
+    def _poll_once(self, first: bool, timeout_s: float):
+        req = msgpack.packb(
+            {"id": self.identity, "ver": self._known_version, "first": first},
+            use_bin_type=True)
+        resp = msgpack.unpackb(self._poll(req, timeout=timeout_s), raw=False)
+        if resp.get("code") == 1:
+            self._known_version = int(resp["ver"])
+            return int(resp["ver"]), resp["model"]
+        return None
+
+    def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
+        """Bounded connect/handshake retry (the reference's init retry loop
+        never decrements its counter and can spin forever,
+        agent_grpc.rs:151-171)."""
+        deadline = time.monotonic() + timeout_s
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                result = self._poll_once(first=True, timeout_s=min(
+                    5.0, max(0.1, deadline - time.monotonic())))
+                if result is not None:
+                    return result
+            except grpc.RpcError as e:
+                last_err = e
+                time.sleep(0.2)
+        raise TimeoutError(f"gRPC model handshake timed out: {last_err}")
+
+    def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
+        # Registration rides the first_time ClientPoll (one RPC fewer than
+        # the ZMQ plane); fetch_model() already registered us.
+        return True
+
+    def send_trajectory(self, payload: bytes) -> None:
+        from relayrl_tpu.transport.base import pack_trajectory_envelope
+
+        resp = msgpack.unpackb(
+            self._send(pack_trajectory_envelope(self.identity, payload), timeout=30.0),
+            raw=False)
+        if resp.get("code") != 1:
+            raise RuntimeError(f"trajectory rejected: {resp.get('error')}")
+
+    def start_model_listener(self) -> None:
+        if self._listener is not None:
+            return
+        self._stop.clear()
+        self._listener = threading.Thread(
+            target=self._poll_loop, name="grpc-model-poll", daemon=True)
+        self._listener.start()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                result = self._poll_once(first=False, timeout_s=self._poll_timeout_s)
+            except grpc.RpcError:
+                if self._stop.wait(1.0):
+                    break
+                continue
+            if result is not None:
+                self.on_model(*result)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.join(timeout=self._poll_timeout_s + 5)
+            self._listener = None
+        self._channel.close()

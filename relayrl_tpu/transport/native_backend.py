@@ -1,0 +1,51 @@
+"""Native C++ transport backend (ctypes bindings over native/librelayrl_native.so).
+
+The reference's transport core is native Rust (tokio + zmq + tonic); the
+TPU-native equivalent is the C++ core under ``native/`` — a framed-TCP
+epoll event loop speaking the same envelopes as the Python backends.
+This module is the thin ctypes binding; build the library with
+``make -C native`` first.
+"""
+
+from __future__ import annotations
+
+import os
+
+_LIB_NAMES = ("librelayrl_native.so",)
+
+
+def _find_library() -> str | None:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for name in _LIB_NAMES:
+        for cand in (os.path.join(here, "native", name),
+                     os.path.join(here, name)):
+            if os.path.isfile(cand):
+                return cand
+    return None
+
+
+def native_available() -> bool:
+    return _find_library() is not None
+
+
+def _require_lib() -> str:
+    path = _find_library()
+    if path is None:
+        raise RuntimeError(
+            "native transport library not built; run `make -C native` "
+            "(falls back: use server_type='zmq' or 'grpc')")
+    return path
+
+
+# Real implementations are bound in native_bindings once the .so exists;
+# import them lazily so zmq/grpc users never touch ctypes.
+def NativeServerTransport(*args, **kwargs):
+    from relayrl_tpu.transport.native_bindings import NativeServerTransportImpl
+
+    return NativeServerTransportImpl(_require_lib(), *args, **kwargs)
+
+
+def NativeAgentTransport(*args, **kwargs):
+    from relayrl_tpu.transport.native_bindings import NativeAgentTransportImpl
+
+    return NativeAgentTransportImpl(_require_lib(), *args, **kwargs)

@@ -1,0 +1,17 @@
+"""Observability + misc utilities (ref layer L8, SURVEY.md §1)."""
+
+from relayrl_tpu.utils.logger import (
+    EpochLogger,
+    Logger,
+    colorize,
+    setup_logger_kwargs,
+    statistics_scalar,
+)
+
+__all__ = [
+    "EpochLogger",
+    "Logger",
+    "colorize",
+    "setup_logger_kwargs",
+    "statistics_scalar",
+]

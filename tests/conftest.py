@@ -7,14 +7,24 @@ technique for testing pjit/shard_map topologies without a pod).
 
 import os
 
-# Must be set before jax (or anything importing jax) is imported.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax (or anything importing jax) is imported. Force —
+# the ambient environment points JAX_PLATFORMS at the real TPU (axon), and
+# unit tests doing per-step host transfers over the device tunnel are
+# 100-1000× slower than CPU (and the bench owns the real chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# Plugins (jaxtyping) import jax before this conftest runs, and jax.config
+# snapshots JAX_PLATFORMS at import — update the live config too, which works
+# as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

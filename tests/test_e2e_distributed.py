@@ -1,0 +1,161 @@
+"""End-to-end distributed loop: TrainingServer + Agent over real sockets.
+
+This is the test the reference never had (SURVEY.md §4 — its only
+multi-process validation is criterion benches): the full loop of §3.3 —
+handshake → env steps → trajectory over the wire → learner update → model
+publish → actor hot-swap — on localhost ephemeral ports.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.runtime.agent import Agent, run_gym_loop
+from relayrl_tpu.runtime.server import TrainingServer
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _zmq_addrs():
+    return {
+        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+    }
+
+
+def _agent_addrs(server_addrs):
+    return {
+        "agent_listener_addr": server_addrs["agent_listener_addr"],
+        "trajectory_addr": server_addrs["trajectory_addr"],
+        "model_sub_addr": server_addrs["model_pub_addr"],
+    }
+
+
+class _RandomEnv:
+    """Tiny deterministic env so e2e tests don't need gymnasium."""
+
+    def __init__(self, obs_dim=4, horizon=6, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self.obs_dim, self.horizon = obs_dim, horizon
+        self._t = 0
+
+    def reset(self, seed=None):
+        self._t = 0
+        return self._rng.standard_normal(self.obs_dim).astype(np.float32), {}
+
+    def step(self, action):
+        self._t += 1
+        obs = self._rng.standard_normal(self.obs_dim).astype(np.float32)
+        return obs, 1.0, self._t >= self.horizon, False, {}
+
+
+@pytest.mark.parametrize("server_type", ["zmq", "grpc"])
+def test_full_loop_model_update_reaches_agent(tmp_cwd, server_type):
+    if server_type == "zmq":
+        server_addrs = _zmq_addrs()
+        agent_addrs = _agent_addrs(server_addrs)
+    else:
+        port = free_port()
+        server_addrs = {"bind_addr": f"127.0.0.1:{port}"}
+        agent_addrs = {"server_addr": f"127.0.0.1:{port}"}
+
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, server_type=server_type,
+        env_dir=str(tmp_cwd),
+        hyperparams={"traj_per_epoch": 2, "hidden_sizes": [16],
+                     "with_vf_baseline": False},
+        **server_addrs,
+    )
+    if server_type == "grpc":
+        server.transport.idle_timeout_s = 2.0
+    try:
+        agent = Agent(server_type=server_type, handshake_timeout_s=20,
+                      seed=0, **agent_addrs)
+        try:
+            assert agent.model_version == 0
+            env = _RandomEnv()
+            run_gym_loop(agent, env, episodes=2, max_steps=10)
+
+            deadline = time.monotonic() + 30
+            while server.stats["updates"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert server.stats["updates"] >= 1, (
+                f"learner never updated; stats={server.stats}")
+
+            deadline = time.monotonic() + 30
+            while agent.model_version < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert agent.model_version >= 1, "hot-swap never happened"
+            assert agent.transport.identity in server.agent_ids
+        finally:
+            agent.disable_agent()
+    finally:
+        server.disable_server()
+
+
+def test_multi_agent_zmq(tmp_cwd):
+    """Several ZMQ agents against one server — the topology the reference's
+    ZMQ plane cannot serve (SURVEY.md §2.3 socket-topology note)."""
+    server_addrs = _zmq_addrs()
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, server_type="zmq",
+        env_dir=str(tmp_cwd), multiactor=True,
+        hyperparams={"traj_per_epoch": 4, "hidden_sizes": [16],
+                     "with_vf_baseline": False},
+        **server_addrs,
+    )
+    agents = []
+    try:
+        for i in range(3):
+            agents.append(Agent(server_type="zmq", handshake_timeout_s=20,
+                                seed=i, **_agent_addrs(server_addrs)))
+        env = _RandomEnv()
+        for a in agents:
+            run_gym_loop(a, env, episodes=2, max_steps=8)
+
+        deadline = time.monotonic() + 30
+        while server.stats["updates"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.stats["updates"] >= 1
+        assert len(server.agent_ids) == 3
+
+        for i, a in enumerate(agents):
+            deadline = time.monotonic() + 30
+            while a.model_version < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert a.model_version >= 1, f"agent {i} never got the new model"
+    finally:
+        for a in agents:
+            a.disable_agent()
+        server.disable_server()
+
+
+def test_server_restart(tmp_cwd):
+    server_addrs = _zmq_addrs()
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, server_type="zmq",
+        env_dir=str(tmp_cwd),
+        hyperparams={"traj_per_epoch": 1, "hidden_sizes": [8],
+                     "with_vf_baseline": False},
+        **server_addrs,
+    )
+    try:
+        assert server.active
+        server.restart_server()
+        assert server.active
+        # Still serves handshakes after restart.
+        agent = Agent(server_type="zmq", handshake_timeout_s=20,
+                      **_agent_addrs(server_addrs))
+        try:
+            assert agent.model_version >= 0
+        finally:
+            agent.disable_agent()
+    finally:
+        server.disable_server()

@@ -1,0 +1,83 @@
+"""EpochLogger + TensorboardWriter tests (ref: utils/logger.py and
+training_tensorboard.py behavior, SURVEY.md §5.5)."""
+
+import os.path as osp
+
+import pytest
+
+from relayrl_tpu.utils import EpochLogger, setup_logger_kwargs, statistics_scalar
+from relayrl_tpu.utils.tb_writer import TensorboardWriter
+
+
+class TestEpochLogger:
+    def test_progress_tsv_layout(self, tmp_path):
+        logger = EpochLogger(output_dir=str(tmp_path))
+        for epoch in range(1, 3):
+            logger.store(EpRet=10.0 * epoch)
+            logger.store(EpRet=20.0 * epoch)
+            logger.log_tabular("Epoch", epoch)
+            logger.log_tabular("EpRet", with_min_and_max=True)
+            logger.dump_tabular()
+        lines = (tmp_path / "progress.txt").read_text().splitlines()
+        header = lines[0].split("\t")
+        assert header == ["Epoch", "AverageEpRet", "StdEpRet", "MaxEpRet", "MinEpRet"]
+        assert len(lines) == 3
+        row1 = dict(zip(header, lines[1].split("\t")))
+        assert float(row1["AverageEpRet"]) == pytest.approx(15.0)
+        assert float(row1["MaxEpRet"]) == pytest.approx(20.0)
+
+    def test_new_key_after_first_epoch_rejected(self, tmp_path):
+        logger = EpochLogger(output_dir=str(tmp_path))
+        logger.log_tabular("A", 1)
+        logger.dump_tabular()
+        with pytest.raises(KeyError):
+            logger.log_tabular("B", 2)
+
+    def test_save_config(self, tmp_path):
+        logger = EpochLogger(output_dir=str(tmp_path), exp_name="exp")
+        logger.save_config({"gamma": 0.99, "weird": object()})
+        assert (tmp_path / "config.json").is_file()
+
+    def test_setup_logger_kwargs_layout(self):
+        kwargs = setup_logger_kwargs("myexp", seed=7, data_dir="/data")
+        assert kwargs["output_dir"] == osp.join("/data", "myexp", "myexp_s7")
+
+    def test_statistics_scalar(self):
+        mean, std, mn, mx = statistics_scalar([1.0, 2.0, 3.0], with_min_and_max=True)
+        assert mean == pytest.approx(2.0)
+        assert (mn, mx) == (1.0, 3.0)
+
+
+class TestTensorboardWriter:
+    def _write_progress(self, path, rows):
+        header = "Epoch\tAverageEpRet\tLossPi\n"
+        path.write_text(header + "".join(
+            f"{e}\t{r}\t{l}\n" for e, r, l in rows))
+
+    def test_poll_writes_scalars(self, tmp_path):
+        progress = tmp_path / "progress.txt"
+        self._write_progress(progress, [(1, 10.0, 0.5), (2, 20.0, 0.4)])
+        writer = TensorboardWriter(str(progress),
+                                   scalar_tags="AverageEpRet;LossPi",
+                                   logdir=str(tmp_path / "tb"))
+        assert writer.poll() == 2
+        assert writer.poll() == 0  # no new rows
+        self._write_progress(progress, [(1, 10.0, 0.5), (2, 20.0, 0.4), (3, 30.0, 0.3)])
+        assert writer.poll() == 1  # only the new row
+        writer.close()
+        import glob
+
+        assert glob.glob(str(tmp_path / "tb" / "*")), "no event files written"
+
+    def test_missing_tag_warns_but_works(self, tmp_path, capsys):
+        progress = tmp_path / "progress.txt"
+        self._write_progress(progress, [(1, 10.0, 0.5)])
+        writer = TensorboardWriter(str(progress), scalar_tags="NotAColumn",
+                                   logdir=str(tmp_path / "tb"))
+        assert writer.poll() == 1
+        assert "NotAColumn" in capsys.readouterr().out
+        writer.close()
+
+    def test_missing_file_is_noop(self, tmp_path):
+        writer = TensorboardWriter(str(tmp_path / "nope.txt"))
+        assert writer.poll() == 0

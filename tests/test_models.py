@@ -1,0 +1,122 @@
+"""Model registry + policy ABI tests (ref model ABI: kernel.py:99-143 and
+the load-time validator agent_wrapper.rs:88-168)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relayrl_tpu.models import build_policy, validate_policy
+from relayrl_tpu.types.model_bundle import ModelBundle
+
+
+def _discrete_arch(**kw):
+    arch = {"kind": "mlp_discrete", "obs_dim": 4, "act_dim": 3,
+            "hidden_sizes": [32, 32], "has_critic": True}
+    arch.update(kw)
+    return arch
+
+
+class TestDiscretePolicy:
+    def test_step_abi(self):
+        policy = build_policy(_discrete_arch())
+        params = policy.init_params(jax.random.PRNGKey(0))
+        act, aux = policy.step(params, jax.random.PRNGKey(1),
+                               jnp.zeros(4), jnp.ones(3))
+        assert act.shape == ()
+        assert set(aux) == {"logp_a", "v"}
+        assert 0 <= int(act) < 3
+
+    def test_batched_step(self):
+        policy = build_policy(_discrete_arch())
+        params = policy.init_params(jax.random.PRNGKey(0))
+        obs = jnp.zeros((5, 4))
+        act, aux = policy.step(params, jax.random.PRNGKey(1), obs, jnp.ones((5, 3)))
+        assert act.shape == (5,)
+        assert aux["logp_a"].shape == (5,)
+        assert aux["v"].shape == (5,)
+
+    def test_mask_forbids_actions(self):
+        policy = build_policy(_discrete_arch())
+        params = policy.init_params(jax.random.PRNGKey(0))
+        mask = jnp.array([1.0, 0.0, 0.0])
+        for i in range(20):
+            act, _ = policy.step(params, jax.random.PRNGKey(i), jnp.ones(4), mask)
+            assert int(act) == 0, "masked action sampled"
+
+    def test_evaluate_consistent_with_step(self):
+        policy = build_policy(_discrete_arch())
+        params = policy.init_params(jax.random.PRNGKey(0))
+        obs = jax.random.normal(jax.random.PRNGKey(2), (7, 4))
+        act, aux = policy.step(params, jax.random.PRNGKey(3), obs, jnp.ones((7, 3)))
+        logp, ent, v = policy.evaluate(params, obs, act, jnp.ones((7, 3)))
+        np.testing.assert_allclose(np.asarray(logp), np.asarray(aux["logp_a"]), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(aux["v"]), rtol=1e-5)
+        assert np.all(np.asarray(ent) >= 0)
+
+    def test_no_critic_returns_zero_v(self):
+        policy = build_policy(_discrete_arch(has_critic=False))
+        params = policy.init_params(jax.random.PRNGKey(0))
+        _, aux = policy.step(params, jax.random.PRNGKey(1), jnp.zeros(4), None)
+        assert float(aux["v"]) == 0.0
+
+    def test_validate_policy(self):
+        policy = build_policy(_discrete_arch())
+        params = policy.init_params(jax.random.PRNGKey(0))
+        validate_policy(policy, params)  # should not raise
+
+    def test_dims(self):
+        policy = build_policy(_discrete_arch())
+        assert policy.get_input_dim() == 4
+        assert policy.get_output_dim() == 3
+
+
+class TestContinuousPolicy:
+    def _policy(self):
+        return build_policy({"kind": "mlp_continuous", "obs_dim": 3, "act_dim": 2,
+                             "hidden_sizes": [16], "has_critic": True})
+
+    def test_step_abi(self):
+        policy = self._policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        act, aux = policy.step(params, jax.random.PRNGKey(1), jnp.zeros(3))
+        assert act.shape == (2,)
+        assert aux["logp_a"].shape == ()
+
+    def test_mode_is_mean(self):
+        policy = self._policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        m1 = policy.mode(params, jnp.ones(3))
+        m2 = policy.mode(params, jnp.ones(3))
+        np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+
+    def test_logp_matches_normal(self):
+        from scipy import stats
+
+        policy = self._policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        obs = jnp.ones(3)
+        act, aux = policy.step(params, jax.random.PRNGKey(5), obs)
+        logp, _, _ = policy.evaluate(params, obs, act)
+        mu = np.asarray(policy.mode(params, obs))
+        log_std = np.asarray(params["params"]["log_std"])
+        expected = stats.norm.logpdf(np.asarray(act), mu, np.exp(log_std)).sum()
+        assert float(logp) == pytest.approx(expected, rel=1e-4)
+
+
+class TestBundleRoundTrip:
+    def test_params_survive_wire(self):
+        policy = build_policy(_discrete_arch())
+        params = policy.init_params(jax.random.PRNGKey(0))
+        bundle = ModelBundle(version=1, arch=policy.arch, params=jax.device_get(params))
+        restored = ModelBundle.from_bytes(bundle.to_bytes())
+        policy2 = build_policy(restored.arch)
+        obs = jnp.ones(4)
+        a1, aux1 = policy.step(params, jax.random.PRNGKey(9), obs, jnp.ones(3))
+        a2, aux2 = policy2.step(restored.params, jax.random.PRNGKey(9), obs, jnp.ones(3))
+        assert int(a1) == int(a2)
+        assert float(aux1["logp_a"]) == pytest.approx(float(aux2["logp_a"]), rel=1e-5)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown model kind"):
+            build_policy({"kind": "nope", "obs_dim": 1, "act_dim": 1})

@@ -1,0 +1,123 @@
+"""REINFORCE learner tests: mechanics fast, learning on CartPole (slow).
+
+The CartPole improvement test is the Stage-2 north-star check
+(BASELINE.md: CartPole-v1 avg return ≥ 475 at convergence; in CI we assert
+clear improvement within a bounded budget, full convergence runs in the
+bench/examples)."""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import REINFORCE, build_algorithm, registered_algorithms
+from relayrl_tpu.types.action import ActionRecord
+
+
+def _episode(n, obs_dim=4, act_dim=2, seed=0):
+    rng = np.random.default_rng(seed)
+    acts = []
+    for i in range(n):
+        acts.append(ActionRecord(
+            obs=rng.standard_normal(obs_dim).astype(np.float32),
+            act=np.int64(rng.integers(act_dim)),
+            rew=float(rng.random()),
+            data={"logp_a": np.float32(-0.69), "v": np.float32(0.0)},
+            done=(i == n - 1),
+        ))
+    return acts
+
+
+@pytest.fixture
+def algo(tmp_cwd):
+    return build_algorithm(
+        "REINFORCE", obs_dim=4, act_dim=2, traj_per_epoch=2,
+        hidden_sizes=[16, 16], env_dir=str(tmp_cwd),
+        logger_kwargs={"output_dir": str(tmp_cwd / "logs")},
+    )
+
+
+class TestMechanics:
+    def test_registry(self):
+        assert "REINFORCE" in registered_algorithms()
+
+    def test_trains_after_traj_per_epoch(self, algo):
+        assert algo.receive_trajectory(_episode(5, seed=1)) is False
+        assert algo.version == 0
+        assert algo.receive_trajectory(_episode(7, seed=2)) is True
+        assert algo.version == 1
+        assert "LossPi" in algo._last_metrics
+
+    def test_update_changes_pi_params_only_without_baseline(self, tmp_cwd):
+        algo = build_algorithm(
+            "REINFORCE", obs_dim=4, act_dim=2, traj_per_epoch=1,
+            with_vf_baseline=False, hidden_sizes=[8],
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        import jax
+
+        before = jax.device_get(algo.state.params)
+        algo.receive_trajectory(_episode(6))
+        after = jax.device_get(algo.state.params)
+        changed = any(
+            not np.allclose(b, a)
+            for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after))
+        )
+        assert changed
+
+    def test_baseline_updates_value_params(self, tmp_cwd):
+        algo = build_algorithm(
+            "REINFORCE", obs_dim=4, act_dim=2, traj_per_epoch=1,
+            with_vf_baseline=True, train_vf_iters=3, hidden_sizes=[8],
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        import jax
+
+        before = jax.device_get(algo.state.params["params"]["vf_head"]["kernel"])
+        algo.receive_trajectory(_episode(6))
+        after = jax.device_get(algo.state.params["params"]["vf_head"]["kernel"])
+        assert not np.allclose(before, after)
+        assert algo._last_metrics["DeltaLossV"] < 0, "vf iterations should reduce LossV"
+
+    def test_progress_txt_written(self, algo, tmp_cwd):
+        algo.receive_trajectory(_episode(3, seed=1))
+        algo.receive_trajectory(_episode(3, seed=2))
+        progress = tmp_cwd / "logs" / "progress.txt"
+        assert progress.is_file()
+        header = progress.read_text().splitlines()[0].split("\t")
+        for col in ("Epoch", "AverageEpRet", "StdEpRet", "MaxEpRet", "MinEpRet",
+                    "EpLen", "LossPi", "KL", "Entropy"):
+            assert col in header
+
+    def test_bundle_version_tracks_steps(self, algo):
+        assert algo.bundle().version == 0
+        algo.receive_trajectory(_episode(3, seed=1))
+        algo.receive_trajectory(_episode(3, seed=2))
+        assert algo.bundle().version == 1
+
+    def test_save_load(self, algo, tmp_cwd):
+        algo.save(tmp_cwd / "m.rlx")
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        bundle = ModelBundle.load(tmp_cwd / "m.rlx")
+        assert bundle.arch["kind"] == "mlp_discrete"
+
+
+@pytest.mark.slow
+class TestLearning:
+    def test_cartpole_improves(self, tmp_cwd):
+        import gymnasium as gym
+
+        from relayrl_tpu.runtime import LocalRunner
+
+        env = gym.make("CartPole-v1")
+        env.reset(seed=0)
+        runner = LocalRunner(
+            env, "REINFORCE", env_dir=str(tmp_cwd), seed=0,
+            with_vf_baseline=True, traj_per_epoch=8, train_vf_iters=40,
+            hidden_sizes=[64, 64], pi_lr=1e-2, vf_lr=1e-2, gamma=0.99, lam=0.97,
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")},
+        )
+        first = runner.train(epochs=2, max_steps=500)
+        baseline = first["avg_return_last_window"]
+        result = runner.train(epochs=28, max_steps=500)
+        final = result["avg_return_last_window"]
+        assert final > baseline + 30, (
+            f"no learning: first-window {baseline:.1f} -> final {final:.1f}")
+        assert final > 100, f"final avg return too low: {final:.1f}"

@@ -1,0 +1,186 @@
+"""Transport backend tests: handshake, trajectory ingest, model broadcast.
+
+Covers the surface the reference only exercises through criterion benches
+(SURVEY.md §4): ZMQ and gRPC planes against real sockets on localhost with
+ephemeral ports.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.transport import (
+    make_agent_transport,
+    make_server_transport,
+    pack_model_frame,
+    unpack_model_frame,
+    pack_trajectory_envelope,
+    unpack_trajectory_envelope,
+)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cfg(tmp_cwd):
+    return ConfigLoader(create_if_missing=False)
+
+
+class TestEnvelopes:
+    def test_trajectory_envelope(self):
+        agent_id, payload = unpack_trajectory_envelope(
+            pack_trajectory_envelope("agent-1", b"\x01\x02"))
+        assert agent_id == "agent-1" and payload == b"\x01\x02"
+
+    def test_model_frame(self):
+        ver, model = unpack_model_frame(pack_model_frame(5, b"params"))
+        assert ver == 5 and model == b"params"
+
+
+def _roundtrip(server, make_agent):
+    """Shared scenario: handshake → register → trajectory → broadcast."""
+    received = []
+    model_bytes = b"MODEL-V1-PARAMS"
+    server.get_model = lambda: (1, model_bytes)
+    server.on_trajectory = lambda aid, payload: received.append((aid, payload))
+    registered = []
+    server.on_register = registered.append
+    server.start()
+    try:
+        agent = make_agent()
+        try:
+            version, fetched = agent.fetch_model(timeout_s=10)
+            assert (version, fetched) == (1, model_bytes)
+            assert agent.register(agent.identity, timeout_s=10)
+
+            agent.send_trajectory(b"traj-bytes")
+            deadline = time.monotonic() + 5
+            while not received and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert received and received[0][1] == b"traj-bytes"
+            assert received[0][0] == agent.identity
+
+            got = threading.Event()
+            swaps = []
+
+            def on_model(ver, model):
+                swaps.append((ver, model))
+                got.set()
+
+            agent.on_model = on_model
+            agent.start_model_listener()
+            time.sleep(0.3)  # let SUB subscription propagate
+            server.get_model = lambda: (2, b"MODEL-V2")
+            server.publish_model(2, b"MODEL-V2")
+            assert got.wait(timeout=10), "model update never arrived"
+            assert swaps[-1] == (2, b"MODEL-V2")
+
+            if registered:
+                assert agent.identity in registered
+        finally:
+            agent.close()
+    finally:
+        server.stop()
+
+
+class TestZmqTransport:
+    def test_full_roundtrip(self, cfg):
+        ports = [free_port() for _ in range(3)]
+        server = make_server_transport(
+            "zmq", cfg,
+            agent_listener_addr=f"tcp://127.0.0.1:{ports[0]}",
+            trajectory_addr=f"tcp://127.0.0.1:{ports[1]}",
+            model_pub_addr=f"tcp://127.0.0.1:{ports[2]}")
+
+        def make_agent():
+            return make_agent_transport(
+                "zmq", cfg,
+                agent_listener_addr=f"tcp://127.0.0.1:{ports[0]}",
+                trajectory_addr=f"tcp://127.0.0.1:{ports[1]}",
+                model_sub_addr=f"tcp://127.0.0.1:{ports[2]}")
+
+        _roundtrip(server, make_agent)
+
+    def test_handshake_timeout_when_no_server(self, cfg):
+        port = free_port()
+        agent = make_agent_transport(
+            "zmq", cfg,
+            agent_listener_addr=f"tcp://127.0.0.1:{port}",
+            trajectory_addr=f"tcp://127.0.0.1:{free_port()}",
+            model_sub_addr=f"tcp://127.0.0.1:{free_port()}")
+        try:
+            with pytest.raises(TimeoutError):
+                agent.fetch_model(timeout_s=1.0)
+        finally:
+            agent.close()
+
+    def test_multi_agent_broadcast(self, cfg):
+        # The reference's ZMQ plane cannot do this (agent-side bind,
+        # agent_zmq.rs:632-638); PUB/SUB must reach every agent.
+        ports = [free_port() for _ in range(3)]
+        server = make_server_transport(
+            "zmq", cfg,
+            agent_listener_addr=f"tcp://127.0.0.1:{ports[0]}",
+            trajectory_addr=f"tcp://127.0.0.1:{ports[1]}",
+            model_pub_addr=f"tcp://127.0.0.1:{ports[2]}")
+        server.get_model = lambda: (1, b"m1")
+        server.start()
+        agents, events = [], []
+        try:
+            for _ in range(3):
+                a = make_agent_transport(
+                    "zmq", cfg,
+                    agent_listener_addr=f"tcp://127.0.0.1:{ports[0]}",
+                    trajectory_addr=f"tcp://127.0.0.1:{ports[1]}",
+                    model_sub_addr=f"tcp://127.0.0.1:{ports[2]}")
+                ev = threading.Event()
+                a.on_model = lambda v, m, ev=ev: ev.set()
+                a.start_model_listener()
+                agents.append(a)
+                events.append(ev)
+            time.sleep(0.5)
+            server.publish_model(2, b"m2")
+            for i, ev in enumerate(events):
+                assert ev.wait(timeout=10), f"agent {i} missed the broadcast"
+        finally:
+            for a in agents:
+                a.close()
+            server.stop()
+
+
+class TestGrpcTransport:
+    def test_full_roundtrip(self, cfg):
+        port = free_port()
+        server = make_server_transport("grpc", cfg, bind_addr=f"127.0.0.1:{port}")
+        server.idle_timeout_s = 5.0
+
+        def make_agent():
+            return make_agent_transport("grpc", cfg, server_addr=f"127.0.0.1:{port}")
+
+        _roundtrip(server, make_agent)
+
+    def test_long_poll_times_out_cleanly(self, cfg):
+        port = free_port()
+        server = make_server_transport("grpc", cfg, bind_addr=f"127.0.0.1:{port}")
+        server.idle_timeout_s = 0.5
+        server.get_model = lambda: (1, b"m")
+        server.start()
+        try:
+            agent = make_agent_transport("grpc", cfg, server_addr=f"127.0.0.1:{port}")
+            try:
+                ver, _ = agent.fetch_model(timeout_s=5)
+                assert ver == 1
+                t0 = time.monotonic()
+                assert agent._poll_once(first=False, timeout_s=10) is None
+                assert time.monotonic() - t0 < 5, "long poll ignored idle timeout"
+            finally:
+                agent.close()
+        finally:
+            server.stop()
