@@ -1,0 +1,147 @@
+"""Headline benchmark: REINFORCE learner steps/sec/chip on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against a faithful torch-CPU implementation of the
+reference's learner epoch (one policy-gradient step + ``train_vf_iters``
+value MSE steps — relayrl_framework/src/native/python/algorithms/REINFORCE/
+REINFORCE.py:97-125) on the same data: the reference publishes no numbers
+(BASELINE.md), and its learner is CPU PyTorch, so "reference-shaped torch on
+this host's CPU" is the honest stand-in baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Bench shape: 64 trajectories × 256 steps (the north-star configs feed a
+# v4-8 learner from 64 actors; one epoch batch per update).
+B, T, OBS, ACT = 64, 256, 128, 18
+HIDDEN = [256, 256]
+VF_ITERS = 80
+WARMUP, ITERS = 3, 20
+
+
+def _batch(rng):
+    return {
+        "obs": rng.standard_normal((B, T, OBS)).astype(np.float32),
+        "act": rng.integers(0, ACT, (B, T)).astype(np.int32),
+        "act_mask": np.ones((B, T, ACT), np.float32),
+        "rew": rng.standard_normal((B, T)).astype(np.float32),
+        "val": rng.standard_normal((B, T)).astype(np.float32),
+        "logp": rng.standard_normal((B, T)).astype(np.float32),
+        "valid": np.ones((B, T), np.float32),
+        "last_val": np.zeros((B,), np.float32),
+    }
+
+
+def bench_jax() -> float:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from relayrl_tpu.algorithms.reinforce import (
+        ReinforceState,
+        _param_labels,
+        make_reinforce_update,
+    )
+    from relayrl_tpu.models import build_policy
+
+    arch = {"kind": "mlp_discrete", "obs_dim": OBS, "act_dim": ACT,
+            "hidden_sizes": HIDDEN, "has_critic": True, "precision": "bfloat16"}
+    policy = build_policy(arch)
+    params = policy.init_params(jax.random.PRNGKey(0))
+    labels = _param_labels(params)
+    tx_pi = optax.multi_transform(
+        {"pi": optax.adam(3e-4), "vf": optax.set_to_zero()}, labels)
+    tx_vf = optax.multi_transform(
+        {"pi": optax.set_to_zero(), "vf": optax.adam(1e-3)}, labels)
+    state = ReinforceState(params=params, pi_opt_state=tx_pi.init(params),
+                           vf_opt_state=tx_vf.init(params),
+                           rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+    update = jax.jit(
+        make_reinforce_update(policy, 3e-4, 1e-3, VF_ITERS, 0.99, 0.95,
+                              with_baseline=True),
+        donate_argnums=0)
+
+    rng = np.random.default_rng(0)
+    batch = {k: jnp.asarray(v) for k, v in _batch(rng).items()}
+    for _ in range(WARMUP):
+        state, metrics = update(state, batch)
+    float(metrics["LossPi"])  # host fence (block_until_ready is unreliable
+    # on the axon remote platform — it can return before execution finishes;
+    # a host readback of a value depending on the whole donated-state chain
+    # cannot)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = update(state, batch)
+    float(metrics["LossPi"])  # forces all ITERS sequential updates
+    dt = time.perf_counter() - t0
+    return ITERS / dt
+
+
+def bench_torch_reference() -> float:
+    """Reference-shaped learner epoch in torch on CPU: one pg step +
+    VF_ITERS value steps over the same flattened step set."""
+    import torch
+
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, (torch.get_num_threads())))
+
+    class MLP(torch.nn.Module):
+        def __init__(self, out):
+            super().__init__()
+            layers, prev = [], OBS
+            for h in HIDDEN:
+                layers += [torch.nn.Linear(prev, h), torch.nn.Tanh()]
+                prev = h
+            layers += [torch.nn.Linear(prev, out)]
+            self.net = torch.nn.Sequential(*layers)
+
+        def forward(self, x):
+            return self.net(x)
+
+    pi, vf = MLP(ACT), MLP(1)
+    pi_opt = torch.optim.Adam(pi.parameters(), lr=3e-4)
+    vf_opt = torch.optim.Adam(vf.parameters(), lr=1e-3)
+
+    rng = np.random.default_rng(0)
+    raw = _batch(rng)
+    obs = torch.from_numpy(raw["obs"].reshape(B * T, OBS))
+    act = torch.from_numpy(raw["act"].reshape(B * T)).long()
+    adv = torch.from_numpy(raw["rew"].reshape(B * T))
+    ret = torch.from_numpy(raw["val"].reshape(B * T))
+
+    def epoch():
+        logp = torch.log_softmax(pi(obs), dim=-1).gather(1, act[:, None]).squeeze(1)
+        loss_pi = -(logp * adv).mean()
+        pi_opt.zero_grad(); loss_pi.backward(); pi_opt.step()
+        for _ in range(VF_ITERS):
+            loss_v = ((vf(obs).squeeze(-1) - ret) ** 2).mean()
+            vf_opt.zero_grad(); loss_v.backward(); vf_opt.step()
+
+    epoch()  # warmup
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        epoch()
+    return iters / (time.perf_counter() - t0)
+
+
+def main():
+    jax_sps = bench_jax()
+    torch_sps = bench_torch_reference()
+    result = {
+        "metric": "learner_steps_per_sec_chip",
+        "value": round(jax_sps, 3),
+        "unit": "epoch_updates/s (B=64,T=256,obs=128,act=18,vf_iters=80)",
+        "vs_baseline": round(jax_sps / torch_sps, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

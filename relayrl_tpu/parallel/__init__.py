@@ -1,0 +1,44 @@
+"""Parallelism: meshes, sharding rules, sharded learner compilation.
+
+First-class in this framework where the reference has none (SURVEY.md §2.3
+"Parallelism strategies: none present"; §7.1 item 12 requires DP, sharded
+buffers, TP/FSDP, and sequence-parallel hooks).
+"""
+
+from relayrl_tpu.parallel.mesh import (
+    AXES,
+    data_axes,
+    make_mesh,
+    resolve_mesh_shape,
+    single_device_mesh,
+)
+from relayrl_tpu.parallel.sharding import (
+    batch_pspec,
+    batch_sharding,
+    param_pspec,
+    params_shardings,
+    replicated,
+    state_shardings,
+)
+from relayrl_tpu.parallel.learner import (
+    make_sharded_update,
+    place_batch,
+    place_state,
+)
+
+__all__ = [
+    "AXES",
+    "data_axes",
+    "make_mesh",
+    "resolve_mesh_shape",
+    "single_device_mesh",
+    "batch_pspec",
+    "batch_sharding",
+    "param_pspec",
+    "params_shardings",
+    "replicated",
+    "state_shardings",
+    "make_sharded_update",
+    "place_batch",
+    "place_state",
+]

@@ -1,0 +1,67 @@
+"""Sharded learner-step compilation.
+
+Takes the pure ``(state, batch) -> (state, metrics)`` update an algorithm
+already defines and re-jits it over a mesh with explicit in/out shardings:
+batch split over dp×fsdp, state placed by the param rules, metrics
+replicated. XLA GSPMD inserts every collective (SURVEY.md §5.8 — the
+reference's "communication backend" is sockets between processes; the
+TPU-native learner's backend is ICI/DCN collectives compiled by XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh
+
+from relayrl_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    state_shardings,
+)
+
+
+def make_sharded_update(update_fn: Callable, mesh: Mesh, state_template,
+                        donate_state: bool = True) -> Callable:
+    """Compile ``update_fn`` with mesh shardings.
+
+    ``state_template`` is an abstract or concrete state pytree used to derive
+    placements; the returned callable expects state already placed (use
+    :func:`place_state` once) and a host or device batch dict.
+    """
+    state_sh = state_shardings(state_template, mesh)
+    batch_sh = batch_sharding(mesh)
+
+    def batch_shardings_for(batch):
+        return {k: batch_sh for k in batch}
+
+    compiled_cache = {}
+
+    def sharded_update(state, batch):
+        key = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in batch.items()))
+        fn = compiled_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                update_fn,
+                in_shardings=(state_sh, batch_shardings_for(batch)),
+                out_shardings=(state_sh, replicated(mesh)),
+                donate_argnums=(0,) if donate_state else (),
+            )
+            compiled_cache[key] = fn
+        return fn(state, batch)
+
+    return sharded_update
+
+
+def place_state(state, mesh: Mesh):
+    """Device-put a host/single-device state onto the mesh per the rules."""
+    return jax.device_put(state, state_shardings(state, mesh))
+
+
+def place_batch(batch: dict, mesh: Mesh) -> dict:
+    """Host batch → device-sharded arrays (the jax.device_put ingest path —
+    BASELINE.md north-star names this explicitly)."""
+    sh = batch_sharding(mesh)
+    return {k: jax.device_put(v, sh) for k, v in batch.items()}
