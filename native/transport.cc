@@ -1,0 +1,595 @@
+// relayrl_tpu native transport core.
+//
+// The reference's transport/runtime layer is native Rust (tokio + zmq +
+// tonic; relayrl_framework/src/network/*). This is the TPU-framework's
+// native-code equivalent: a framed-TCP transport with an epoll event loop,
+// serving the same message surface as the Python ZMQ/gRPC backends
+// (handshake GET_MODEL -> MODEL, MODEL_SET -> ID_LOGGED, trajectory push,
+// model broadcast to subscribers).
+//
+// Frame layout (little-endian): u32 payload_len | u8 type | payload.
+// Model payloads: u64 version | bundle bytes.
+//
+// Threading model: one epoll loop thread owns all sockets; Python-facing
+// calls (set_model / broadcast / poll) touch mutex-protected state and wake
+// the loop through an eventfd. Incoming trajectories / registrations are
+// queued for the embedding process to drain via rl_server_poll.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kFrameTraj = 1;
+constexpr uint8_t kFrameGetModel = 2;
+constexpr uint8_t kFrameModel = 3;
+constexpr uint8_t kFrameModelSet = 4;
+constexpr uint8_t kFrameIdLogged = 5;
+constexpr uint8_t kFrameSubscribe = 6;
+constexpr uint8_t kFrameModelPush = 7;
+
+constexpr size_t kMaxFrame = 1ull << 30;  // 1 GiB hard cap
+constexpr size_t kHeader = 5;             // u32 len + u8 type
+
+struct Frame {
+  uint8_t type;
+  std::vector<uint8_t> payload;
+};
+
+std::vector<uint8_t> encode_frame(uint8_t type, const uint8_t* data,
+                                  size_t len) {
+  std::vector<uint8_t> out(kHeader + len);
+  uint32_t n = static_cast<uint32_t>(len);
+  memcpy(out.data(), &n, 4);
+  out[4] = type;
+  if (len) memcpy(out.data() + kHeader, data, len);
+  return out;
+}
+
+struct Conn {
+  int fd = -1;
+  bool subscriber = false;
+  std::vector<uint8_t> rbuf;
+  std::deque<std::vector<uint8_t>> wqueue;
+  size_t woff = 0;  // offset into wqueue.front()
+};
+
+struct Event {
+  int type;  // 1 = trajectory, 2 = register
+  std::vector<uint8_t> payload;
+};
+
+bool set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+class Server {
+ public:
+  Server() = default;
+  ~Server() { stop(); }
+
+  bool create(const char* host, uint16_t port) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    if (listen(listen_fd_, 128) != 0) return false;
+    socklen_t slen = sizeof(addr);
+    if (getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &slen) == 0)
+      port_ = ntohs(addr.sin_port);
+    return set_nonblocking(listen_fd_);
+  }
+
+  bool start() {
+    wake_fd_ = eventfd(0, EFD_NONBLOCK);
+    epoll_fd_ = epoll_create1(0);
+    if (wake_fd_ < 0 || epoll_fd_ < 0) return false;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    running_.store(true);
+    loop_ = std::thread([this] { run(); });
+    return true;
+  }
+
+  void stop() {
+    if (!running_.exchange(false)) {
+      cleanup_fds();
+      return;
+    }
+    wake();
+    if (loop_.joinable()) loop_.join();
+    cleanup_fds();
+  }
+
+  void set_model(uint64_t version, const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> g(model_mu_);
+    model_version_ = version;
+    model_.assign(data, data + len);
+  }
+
+  void broadcast(uint64_t version, const uint8_t* data, size_t len) {
+    set_model(version, data, len);
+    {
+      std::lock_guard<std::mutex> g(bcast_mu_);
+      pending_broadcast_ = true;
+    }
+    wake();
+  }
+
+  // Returns payload size and consumes the event when it fits in cap;
+  // returns required size (without consuming) when cap is too small;
+  // returns -1 on timeout.
+  long poll(int timeout_ms, int* ev_type, uint8_t* buf, size_t cap) {
+    std::unique_lock<std::mutex> lk(ev_mu_);
+    if (!ev_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                         [this] { return !events_.empty() || !running_.load(); }))
+      return -1;
+    if (events_.empty()) return -1;
+    Event& e = events_.front();
+    *ev_type = e.type;
+    if (e.payload.size() > cap) return static_cast<long>(e.payload.size());
+    memcpy(buf, e.payload.data(), e.payload.size());
+    long n = static_cast<long>(e.payload.size());
+    events_.pop_front();
+    return n;
+  }
+
+  uint16_t port() const { return port_; }
+
+ private:
+  void wake() {
+    if (wake_fd_ >= 0) {
+      uint64_t one = 1;
+      ssize_t r = write(wake_fd_, &one, sizeof(one));
+      (void)r;
+    }
+  }
+
+  void cleanup_fds() {
+    for (auto& [fd, conn] : conns_) close(fd);
+    conns_.clear();
+    if (listen_fd_ >= 0) close(listen_fd_), listen_fd_ = -1;
+    if (wake_fd_ >= 0) close(wake_fd_), wake_fd_ = -1;
+    if (epoll_fd_ >= 0) close(epoll_fd_), epoll_fd_ = -1;
+  }
+
+  void run() {
+    std::vector<epoll_event> evs(64);
+    while (running_.load()) {
+      int n = epoll_wait(epoll_fd_, evs.data(), evs.size(), 200);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      for (int i = 0; i < n; ++i) {
+        int fd = evs[i].data.fd;
+        if (fd == listen_fd_) {
+          accept_new();
+        } else if (fd == wake_fd_) {
+          uint64_t drain;
+          while (read(wake_fd_, &drain, sizeof(drain)) > 0) {
+          }
+        } else {
+          auto it = conns_.find(fd);
+          if (it == conns_.end()) continue;
+          bool ok = true;
+          if (evs[i].events & (EPOLLHUP | EPOLLERR))
+            ok = false;
+          else {
+            if (evs[i].events & EPOLLIN) ok = handle_read(it->second);
+            if (ok && (evs[i].events & EPOLLOUT)) ok = flush_writes(it->second);
+          }
+          if (!ok) drop(fd);
+        }
+      }
+      maybe_broadcast();
+    }
+  }
+
+  void accept_new() {
+    while (true) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      set_nonblocking(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conns_[fd].fd = fd;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  void drop(int fd) {
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns_.erase(fd);
+  }
+
+  bool handle_read(Conn& c) {
+    char tmp[65536];
+    while (true) {
+      ssize_t r = recv(c.fd, tmp, sizeof(tmp), 0);
+      if (r > 0) {
+        c.rbuf.insert(c.rbuf.end(), tmp, tmp + r);
+        if (c.rbuf.size() > kMaxFrame + kHeader) return false;
+      } else if (r == 0) {
+        return false;  // peer closed
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        return false;
+      }
+    }
+    // parse complete frames
+    size_t off = 0;
+    while (c.rbuf.size() - off >= kHeader) {
+      uint32_t len;
+      memcpy(&len, c.rbuf.data() + off, 4);
+      if (len > kMaxFrame) return false;
+      if (c.rbuf.size() - off < kHeader + len) break;
+      uint8_t type = c.rbuf[off + 4];
+      const uint8_t* payload = c.rbuf.data() + off + kHeader;
+      if (!handle_frame(c, type, payload, len)) return false;
+      off += kHeader + len;
+    }
+    if (off) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
+    return true;
+  }
+
+  bool handle_frame(Conn& c, uint8_t type, const uint8_t* payload,
+                    size_t len) {
+    switch (type) {
+      case kFrameTraj:
+        push_event(1, payload, len);
+        return true;
+      case kFrameGetModel: {
+        std::vector<uint8_t> body;
+        {
+          std::lock_guard<std::mutex> g(model_mu_);
+          body.resize(8 + model_.size());
+          memcpy(body.data(), &model_version_, 8);
+          if (!model_.empty())
+            memcpy(body.data() + 8, model_.data(), model_.size());
+        }
+        return send_frame(c, kFrameModel, body.data(), body.size());
+      }
+      case kFrameModelSet:
+        push_event(2, payload, len);
+        return send_frame(c, kFrameIdLogged, nullptr, 0);
+      case kFrameSubscribe:
+        c.subscriber = true;
+        return true;
+      default:
+        return true;  // ignore unknown frame types (forward compat)
+    }
+  }
+
+  void push_event(int type, const uint8_t* payload, size_t len) {
+    {
+      std::lock_guard<std::mutex> g(ev_mu_);
+      Event e;
+      e.type = type;
+      e.payload.assign(payload, payload + len);
+      events_.push_back(std::move(e));
+    }
+    ev_cv_.notify_one();
+  }
+
+  void maybe_broadcast() {
+    bool todo = false;
+    {
+      std::lock_guard<std::mutex> g(bcast_mu_);
+      todo = pending_broadcast_;
+      pending_broadcast_ = false;
+    }
+    if (!todo) return;
+    std::vector<uint8_t> body;
+    {
+      std::lock_guard<std::mutex> g(model_mu_);
+      body.resize(8 + model_.size());
+      memcpy(body.data(), &model_version_, 8);
+      if (!model_.empty()) memcpy(body.data() + 8, model_.data(), model_.size());
+    }
+    std::vector<int> dead;
+    for (auto& [fd, conn] : conns_) {
+      if (!conn.subscriber) continue;
+      if (!send_frame(conn, kFrameModelPush, body.data(), body.size()))
+        dead.push_back(fd);
+    }
+    for (int fd : dead) drop(fd);
+  }
+
+  bool send_frame(Conn& c, uint8_t type, const uint8_t* data, size_t len) {
+    c.wqueue.push_back(encode_frame(type, data, len));
+    return flush_writes(c);
+  }
+
+  bool flush_writes(Conn& c) {
+    while (!c.wqueue.empty()) {
+      auto& front = c.wqueue.front();
+      ssize_t r =
+          send(c.fd, front.data() + c.woff, front.size() - c.woff, MSG_NOSIGNAL);
+      if (r >= 0) {
+        c.woff += r;
+        if (c.woff == front.size()) {
+          c.wqueue.pop_front();
+          c.woff = 0;
+        }
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.fd = c.fd;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+        return true;  // wait for EPOLLOUT
+      } else if (errno == EINTR) {
+        continue;
+      } else {
+        return false;
+      }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = c.fd;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+    return true;
+  }
+
+  int listen_fd_ = -1, epoll_fd_ = -1, wake_fd_ = -1;
+  uint16_t port_ = 0;
+  std::atomic<bool> running_{false};
+  std::thread loop_;
+  std::map<int, Conn> conns_;
+
+  std::mutex model_mu_;
+  uint64_t model_version_ = 0;
+  std::vector<uint8_t> model_;
+
+  std::mutex bcast_mu_;
+  bool pending_broadcast_ = false;
+
+  std::mutex ev_mu_;
+  std::condition_variable ev_cv_;
+  std::deque<Event> events_;
+};
+
+// ---------------- client (blocking sockets) ----------------
+
+class Client {
+ public:
+  bool connect_to(const char* host, uint16_t port, int timeout_ms) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return true;
+  }
+
+  ~Client() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool send_frame(uint8_t type, const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto frame = encode_frame(type, data, len);
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t r = send(fd_, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      off += r;
+    }
+    return true;
+  }
+
+  // Blocking read of the next frame of the wanted type (discarding others),
+  // honoring the socket timeout. Returns false on timeout/error.
+  bool recv_frame(uint8_t want, Frame* out) {
+    while (true) {
+      uint8_t header[kHeader];
+      if (!read_exact(header, kHeader)) return false;
+      uint32_t len;
+      memcpy(&len, header, 4);
+      if (len > kMaxFrame) return false;
+      Frame f;
+      f.type = header[4];
+      f.payload.resize(len);
+      if (len && !read_exact(f.payload.data(), len)) return false;
+      if (f.type == want) {
+        *out = std::move(f);
+        return true;
+      }
+    }
+  }
+
+  void set_timeout(int timeout_ms) {
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+
+  // A frame held back because the caller's buffer was too small.
+  bool has_pending_ = false;
+  Frame pending_;
+
+ private:
+  bool read_exact(uint8_t* buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = recv(fd_, buf + off, n - off, 0);
+      if (r > 0) {
+        off += r;
+      } else if (r == 0) {
+        return false;
+      } else {
+        if (errno == EINTR) continue;
+        return false;  // includes EAGAIN from SO_RCVTIMEO
+      }
+    }
+    return true;
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* rl_server_create(const char* host, uint16_t port) {
+  auto* s = new Server();
+  if (!s->create(host, port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int rl_server_start(void* h) { return static_cast<Server*>(h)->start() ? 0 : -1; }
+void rl_server_stop(void* h) { static_cast<Server*>(h)->stop(); }
+void rl_server_destroy(void* h) { delete static_cast<Server*>(h); }
+uint16_t rl_server_port(void* h) { return static_cast<Server*>(h)->port(); }
+
+void rl_server_set_model(void* h, uint64_t version, const uint8_t* data,
+                         size_t len) {
+  static_cast<Server*>(h)->set_model(version, data, len);
+}
+
+void rl_server_broadcast(void* h, uint64_t version, const uint8_t* data,
+                         size_t len) {
+  static_cast<Server*>(h)->broadcast(version, data, len);
+}
+
+long rl_server_poll(void* h, int timeout_ms, int* ev_type, uint8_t* buf,
+                    size_t cap) {
+  return static_cast<Server*>(h)->poll(timeout_ms, ev_type, buf, cap);
+}
+
+// ---- client control channel ----
+void* rl_client_connect(const char* host, uint16_t port, int timeout_ms) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void rl_client_close(void* h) { delete static_cast<Client*>(h); }
+
+long rl_client_get_model(void* h, int timeout_ms, uint64_t* version,
+                         uint8_t* buf, size_t cap) {
+  auto* c = static_cast<Client*>(h);
+  Frame f;
+  if (c->has_pending_) {
+    f = std::move(c->pending_);
+    c->has_pending_ = false;
+  } else {
+    c->set_timeout(timeout_ms);
+    if (!c->send_frame(kFrameGetModel, nullptr, 0)) return -1;
+    if (!c->recv_frame(kFrameModel, &f) || f.payload.size() < 8) return -1;
+  }
+  memcpy(version, f.payload.data(), 8);
+  size_t n = f.payload.size() - 8;
+  if (n > cap) {  // hold for a retry with a bigger buffer
+    c->pending_ = std::move(f);
+    c->has_pending_ = true;
+    return static_cast<long>(n);
+  }
+  memcpy(buf, f.payload.data() + 8, n);
+  return static_cast<long>(n);
+}
+
+int rl_client_register(void* h, const char* id, int timeout_ms) {
+  auto* c = static_cast<Client*>(h);
+  c->set_timeout(timeout_ms);
+  if (!c->send_frame(kFrameModelSet, reinterpret_cast<const uint8_t*>(id),
+                     strlen(id)))
+    return -1;
+  Frame f;
+  return c->recv_frame(kFrameIdLogged, &f) ? 0 : -1;
+}
+
+int rl_client_send_traj(void* h, const uint8_t* data, size_t len) {
+  return static_cast<Client*>(h)->send_frame(kFrameTraj, data, len) ? 0 : -1;
+}
+
+// ---- client subscription channel ----
+void* rl_sub_connect(const char* host, uint16_t port, int timeout_ms) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_ms) ||
+      !c->send_frame(kFrameSubscribe, nullptr, 0)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+long rl_sub_poll(void* h, int timeout_ms, uint64_t* version, uint8_t* buf,
+                 size_t cap) {
+  auto* c = static_cast<Client*>(h);
+  Frame f;
+  if (c->has_pending_) {
+    f = std::move(c->pending_);
+    c->has_pending_ = false;
+  } else {
+    c->set_timeout(timeout_ms);
+    if (!c->recv_frame(kFrameModelPush, &f) || f.payload.size() < 8) return -1;
+  }
+  memcpy(version, f.payload.data(), 8);
+  size_t n = f.payload.size() - 8;
+  if (n > cap) {  // hold the frame for a retry with a bigger buffer
+    c->pending_ = std::move(f);
+    c->has_pending_ = true;
+    return static_cast<long>(n);
+  }
+  memcpy(buf, f.payload.data() + 8, n);
+  return static_cast<long>(n);
+}
+
+}  // extern "C"

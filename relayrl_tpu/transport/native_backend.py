@@ -24,16 +24,41 @@ def _find_library() -> str | None:
     return None
 
 
-def native_available() -> bool:
+def _try_build() -> None:
+    """Best-effort `make -C native` when the toolchain is present."""
+    import shutil
+    import subprocess
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    native_dir = os.path.join(here, "native")
+    if not os.path.isfile(os.path.join(native_dir, "Makefile")):
+        return
+    if shutil.which("make") is None:
+        return
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, OSError):
+        pass
+
+
+def native_available(build: bool = True) -> bool:
+    if _find_library() is not None:
+        return True
+    if build:
+        _try_build()
     return _find_library() is not None
 
 
 def _require_lib() -> str:
     path = _find_library()
     if path is None:
+        _try_build()
+        path = _find_library()
+    if path is None:
         raise RuntimeError(
-            "native transport library not built; run `make -C native` "
-            "(falls back: use server_type='zmq' or 'grpc')")
+            "native transport library not built and auto-build failed; run "
+            "`make -C native` (falls back: use server_type='zmq' or 'grpc')")
     return path
 
 

@@ -1,0 +1,245 @@
+"""ctypes bindings for the native C++ transport core (native/transport.cc).
+
+Implements the same :class:`ServerTransport`/:class:`AgentTransport`
+interfaces as the ZMQ/gRPC backends over the framed-TCP protocol: one
+control connection (handshake + trajectories) and one subscription
+connection (model broadcasts) per agent, one epoll loop thread per server.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+from relayrl_tpu.transport.base import (
+    AgentTransport,
+    ServerTransport,
+    unpack_trajectory_envelope,
+)
+
+_EV_TRAJECTORY = 1
+_EV_REGISTER = 2
+
+
+def _load(lib_path: str) -> ctypes.CDLL:
+    lib = ctypes.CDLL(lib_path)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.rl_server_create.restype = ctypes.c_void_p
+    lib.rl_server_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.rl_server_start.restype = ctypes.c_int
+    lib.rl_server_start.argtypes = [ctypes.c_void_p]
+    lib.rl_server_stop.argtypes = [ctypes.c_void_p]
+    lib.rl_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.rl_server_port.restype = ctypes.c_uint16
+    lib.rl_server_port.argtypes = [ctypes.c_void_p]
+    lib.rl_server_set_model.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
+    lib.rl_server_broadcast.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
+    lib.rl_server_poll.restype = ctypes.c_long
+    lib.rl_server_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int), u8p,
+        ctypes.c_size_t]
+    lib.rl_client_connect.restype = ctypes.c_void_p
+    lib.rl_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                      ctypes.c_int]
+    lib.rl_client_close.argtypes = [ctypes.c_void_p]
+    lib.rl_client_get_model.restype = ctypes.c_long
+    lib.rl_client_get_model.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), u8p,
+        ctypes.c_size_t]
+    lib.rl_client_register.restype = ctypes.c_int
+    lib.rl_client_register.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int]
+    lib.rl_client_send_traj.restype = ctypes.c_int
+    lib.rl_client_send_traj.argtypes = [ctypes.c_void_p, u8p, ctypes.c_size_t]
+    lib.rl_sub_connect.restype = ctypes.c_void_p
+    lib.rl_sub_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                   ctypes.c_int]
+    lib.rl_sub_poll.restype = ctypes.c_long
+    lib.rl_sub_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), u8p,
+        ctypes.c_size_t]
+    return lib
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else None
+
+
+def _parse_host_port(addr: str) -> tuple[str, int]:
+    addr = addr.split("//")[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class NativeServerTransportImpl(ServerTransport):
+    def __init__(self, lib_path: str, bind_addr: str):
+        super().__init__()
+        self._lib = _load(lib_path)
+        host, port = _parse_host_port(bind_addr)
+        self._handle = self._lib.rl_server_create(host.encode(), port)
+        if not self._handle:
+            raise RuntimeError(f"native server bind failed on {bind_addr}")
+        self._poller: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return int(self._lib.rl_server_port(self._handle))
+
+    def start(self) -> None:
+        if self._lib.rl_server_start(self._handle) != 0:
+            raise RuntimeError("native server start failed")
+        version, bundle = self.get_model()
+        data = _buf(bundle)
+        self._lib.rl_server_set_model(self._handle, version, data,
+                                      len(bundle))
+        self._stop.clear()
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        name="native-server-poll", daemon=True)
+        self._poller.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=5)
+            self._poller = None
+        self._lib.rl_server_stop(self._handle)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.rl_server_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    def publish_model(self, version: int, bundle_bytes: bytes) -> None:
+        data = _buf(bundle_bytes)
+        self._lib.rl_server_broadcast(self._handle, version, data,
+                                      len(bundle_bytes))
+
+    def _poll_loop(self) -> None:
+        cap = 1 << 20
+        buf = (ctypes.c_uint8 * cap)()
+        ev_type = ctypes.c_int(0)
+        while not self._stop.is_set():
+            n = self._lib.rl_server_poll(self._handle, 100,
+                                         ctypes.byref(ev_type), buf, cap)
+            if n < 0:
+                continue
+            if n > cap:  # grow and re-take (event was held back)
+                cap = int(n) * 2
+                buf = (ctypes.c_uint8 * cap)()
+                continue
+            payload = bytes(buf[: int(n)])
+            if ev_type.value == _EV_TRAJECTORY:
+                try:
+                    agent_id, traj = unpack_trajectory_envelope(payload)
+                except Exception:
+                    continue
+                self.on_trajectory(agent_id, traj)
+            elif ev_type.value == _EV_REGISTER:
+                self.on_register(payload.decode(errors="replace"))
+
+
+class NativeAgentTransportImpl(AgentTransport):
+    def __init__(self, lib_path: str, server_addr: str,
+                 identity: str | None = None):
+        super().__init__()
+        import os
+        import secrets
+
+        self._lib = _load(lib_path)
+        self.identity = identity or f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}"
+        self._host, self._port = _parse_host_port(server_addr)
+        self._ctrl = None
+        self._sub = None
+        self._listener: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def _ensure_ctrl(self, timeout_s: float):
+        if self._ctrl is None:
+            deadline = time.monotonic() + timeout_s
+            while self._ctrl is None:
+                self._ctrl = self._lib.rl_client_connect(
+                    self._host.encode(), self._port, 2000)
+                if self._ctrl:
+                    break
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"native transport: cannot connect to "
+                        f"{self._host}:{self._port}")
+                time.sleep(0.2)
+        return self._ctrl
+
+    def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
+        ctrl = self._ensure_ctrl(timeout_s)
+        cap = 1 << 20
+        deadline = time.monotonic() + timeout_s
+        version = ctypes.c_uint64(0)
+        while True:
+            remaining = max(100, int((deadline - time.monotonic()) * 1000))
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.rl_client_get_model(ctrl, min(remaining, 5000),
+                                              ctypes.byref(version), buf, cap)
+            if 0 <= n <= cap:
+                return int(version.value), bytes(buf[: int(n)])
+            if n > cap:
+                cap = int(n) * 2
+                continue
+            if time.monotonic() >= deadline:
+                raise TimeoutError("native model handshake timed out")
+
+    def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
+        ctrl = self._ensure_ctrl(timeout_s)
+        rc = self._lib.rl_client_register(
+            ctrl, (agent_id or self.identity).encode(), int(timeout_s * 1000))
+        return rc == 0
+
+    def send_trajectory(self, payload: bytes) -> None:
+        from relayrl_tpu.transport.base import pack_trajectory_envelope
+
+        ctrl = self._ensure_ctrl(5.0)
+        env = pack_trajectory_envelope(self.identity, payload)
+        data = _buf(env)
+        if self._lib.rl_client_send_traj(ctrl, data, len(env)) != 0:
+            raise RuntimeError("native trajectory send failed")
+
+    def start_model_listener(self) -> None:
+        if self._listener is not None:
+            return
+        self._sub = self._lib.rl_sub_connect(self._host.encode(), self._port,
+                                             5000)
+        if not self._sub:
+            raise RuntimeError("native subscribe connection failed")
+        self._stop.clear()
+        self._listener = threading.Thread(target=self._sub_loop,
+                                          name="native-model-sub", daemon=True)
+        self._listener.start()
+
+    def _sub_loop(self) -> None:
+        cap = 1 << 20
+        version = ctypes.c_uint64(0)
+        while not self._stop.is_set():
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.rl_sub_poll(self._sub, 200, ctypes.byref(version),
+                                      buf, cap)
+            if n < 0:
+                continue
+            if n > cap:
+                cap = int(n) * 2
+                continue
+            self.on_model(int(version.value), bytes(buf[: int(n)]))
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.join(timeout=5)
+            self._listener = None
+        for handle in (self._ctrl, self._sub):
+            if handle:
+                self._lib.rl_client_close(handle)
+        self._ctrl = self._sub = None

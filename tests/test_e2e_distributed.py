@@ -56,12 +56,17 @@ class _RandomEnv:
         return obs, 1.0, self._t >= self.horizon, False, {}
 
 
-@pytest.mark.parametrize("server_type", ["zmq", "grpc"])
+@pytest.mark.parametrize("server_type", ["zmq", "grpc", "native"])
 def test_full_loop_model_update_reaches_agent(tmp_cwd, server_type):
     if server_type == "zmq":
         server_addrs = _zmq_addrs()
         agent_addrs = _agent_addrs(server_addrs)
     else:
+        if server_type == "native":
+            from relayrl_tpu.transport.native_backend import native_available
+
+            if not native_available():
+                pytest.skip("native library not built")
         port = free_port()
         server_addrs = {"bind_addr": f"127.0.0.1:{port}"}
         agent_addrs = {"server_addr": f"127.0.0.1:{port}"}

@@ -155,6 +155,66 @@ class TestZmqTransport:
             server.stop()
 
 
+class TestNativeTransport:
+    @pytest.fixture(autouse=True)
+    def _require_lib(self):
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C native)")
+
+    def test_full_roundtrip(self, cfg):
+        port = free_port()
+        server = make_server_transport("native", cfg, bind_addr=f"127.0.0.1:{port}")
+
+        def make_agent():
+            return make_agent_transport("native", cfg,
+                                        server_addr=f"127.0.0.1:{port}")
+
+        _roundtrip(server, make_agent)
+
+    def test_handshake_timeout_when_no_server(self, cfg):
+        agent = make_agent_transport("native", cfg,
+                                     server_addr=f"127.0.0.1:{free_port()}")
+        try:
+            with pytest.raises(TimeoutError):
+                agent.fetch_model(timeout_s=1.0)
+        finally:
+            agent.close()
+
+    def test_large_model_broadcast(self, cfg):
+        # model bigger than the binding's initial 1 MiB buffer: exercises the
+        # grow-and-retry path on both handshake and subscription channels
+        port = free_port()
+        server = make_server_transport("native", cfg, bind_addr=f"127.0.0.1:{port}")
+        big = bytes(range(256)) * (8 * 1024 * 3)  # ~6 MiB
+        server.get_model = lambda: (1, big)
+        server.start()
+        try:
+            agent = make_agent_transport("native", cfg,
+                                         server_addr=f"127.0.0.1:{port}")
+            try:
+                ver, fetched = agent.fetch_model(timeout_s=15)
+                assert ver == 1 and fetched == big
+                got = threading.Event()
+                out = {}
+
+                def on_model(v, m):
+                    out["m"] = (v, m)
+                    got.set()
+
+                agent.on_model = on_model
+                agent.start_model_listener()
+                time.sleep(0.3)
+                server.publish_model(2, big + b"tail")
+                assert got.wait(timeout=15)
+                assert out["m"][0] == 2 and out["m"][1] == big + b"tail"
+            finally:
+                agent.close()
+        finally:
+            server.stop()
+
+
 class TestGrpcTransport:
     def test_full_roundtrip(self, cfg):
         port = free_port()
