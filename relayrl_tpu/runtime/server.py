@@ -46,6 +46,7 @@ class TrainingServer:
         hyperparams: Mapping[str, Any] | None = None,
         server_type: str = "zmq",
         start: bool = True,
+        resume: bool = False,
         **addr_overrides,
     ):
         self.config = ConfigLoader(algorithm_name, config_path)
@@ -72,6 +73,19 @@ class TrainingServer:
             **hp,
         )
 
+        if resume:
+            from relayrl_tpu.checkpoint import restore_algorithm
+
+            learner_cfg = self.config.get_learner_params()
+            try:
+                restore_algorithm(self.algorithm,
+                                  learner_cfg.get("checkpoint_dir", "checkpoints"))
+                print(f"[TrainingServer] resumed at version "
+                      f"{self.algorithm.version}", flush=True)
+            except FileNotFoundError:
+                print("[TrainingServer] no checkpoint to resume; fresh start",
+                      flush=True)
+
         # Multi-actor registry (ref: MultiactorParams,
         # training_server_wrapper.rs:159-163). Always multi-capable; the
         # flag only gates the registered-agents log.
@@ -90,8 +104,10 @@ class TrainingServer:
         self.transport.get_model = self._get_model
         self.transport.on_register = self._on_register
 
+        learner_cfg = self.config.get_learner_params()
         self._checkpoint_every = max(
-            1, int(self.config.get_learner_params().get("checkpoint_every_epochs", 10)))
+            1, int(learner_cfg.get("checkpoint_every_epochs", 10)))
+        self._checkpoint_dir = learner_cfg.get("checkpoint_dir")
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
         self.active = False
@@ -167,6 +183,15 @@ class TrainingServer:
                 os.replace(tmp, path)
             except OSError:
                 pass
+            if self._checkpoint_dir:
+                # Full-state checkpoint (params + optimizer + RNG + epoch);
+                # async orbax save — the learner loop is not blocked.
+                try:
+                    from relayrl_tpu.checkpoint import checkpoint_algorithm
+
+                    checkpoint_algorithm(self.algorithm, self._checkpoint_dir)
+                except Exception as e:
+                    print(f"[TrainingServer] checkpoint failed: {e!r}", flush=True)
 
     # -- lifecycle (ref: training_zmq.rs:322-465 / o3_training_server.rs:153-272) --
     def enable_server(self) -> None:

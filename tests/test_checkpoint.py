@@ -1,0 +1,117 @@
+"""Checkpoint/resume tests: full state survives, training continues."""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import build_algorithm
+from relayrl_tpu.checkpoint import (
+    CheckpointManager,
+    checkpoint_algorithm,
+    restore_algorithm,
+)
+from relayrl_tpu.types.action import ActionRecord
+
+
+def _episode(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [ActionRecord(
+        obs=rng.standard_normal(4).astype(np.float32),
+        act=np.int64(rng.integers(2)),
+        rew=float(rng.random()),
+        data={"logp_a": np.float32(-0.7), "v": np.float32(0.0)},
+        done=(i == n - 1)) for i in range(n)]
+
+
+def _algo(tmp_path, **kw):
+    kw.setdefault("traj_per_epoch", 1)
+    kw.setdefault("hidden_sizes", [8])
+    kw.setdefault("with_vf_baseline", True)
+    kw.setdefault("train_vf_iters", 2)
+    return build_algorithm("REINFORCE", obs_dim=4, act_dim=2,
+                           logger_kwargs={"output_dir": str(tmp_path / "logs")},
+                           **kw)
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(4)}
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(4, state, extra={"note": "hi"}, wait=True)
+        restored, extra = mgr.restore(state)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(6.0).reshape(2, 3))
+        assert extra["note"] == "hi"
+        assert mgr.latest_step() == 4
+        mgr.close()
+
+    def test_latest_of_many(self, tmp_path):
+        import jax.numpy as jnp
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        for s in (1, 2, 3):
+            mgr.save(s, {"x": jnp.float32(s)}, wait=True)
+        assert mgr.latest_step() == 3
+        restored, _ = mgr.restore({"x": jnp.float32(0)})
+        assert float(restored["x"]) == 3.0
+        mgr.close()
+
+    def test_restore_empty_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            mgr.restore({"x": 0})
+        mgr.close()
+
+
+class TestAlgorithmResume:
+    def test_full_state_resume(self, tmp_path, tmp_cwd):
+        import jax
+
+        algo = _algo(tmp_path)
+        algo.receive_trajectory(_episode(6, seed=1))
+        algo.receive_trajectory(_episode(6, seed=2))
+        assert algo.version == 2
+        ckpt_dir = str(tmp_path / "ckpt")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True)
+        before = jax.device_get(algo.state)
+
+        fresh = _algo(tmp_path)
+        assert fresh.version == 0
+        restore_algorithm(fresh, ckpt_dir)
+        assert fresh.version == 2
+        assert fresh.epoch == algo.epoch
+        after = jax.device_get(fresh.state)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # resumed algorithm keeps training (optimizer state intact)
+        assert fresh.receive_trajectory(_episode(6, seed=3)) is True
+        assert fresh.version == 3
+
+    def test_arch_mismatch_rejected(self, tmp_path, tmp_cwd):
+        algo = _algo(tmp_path)
+        algo.receive_trajectory(_episode(4, seed=1))
+        ckpt_dir = str(tmp_path / "ckpt")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True)
+        other = build_algorithm(
+            "REINFORCE", obs_dim=4, act_dim=2, traj_per_epoch=1,
+            hidden_sizes=[16], with_vf_baseline=True, train_vf_iters=2,
+            logger_kwargs={"output_dir": str(tmp_path / "logs2")})
+        with pytest.raises(Exception):  # arch or tree-structure mismatch
+            restore_algorithm(other, ckpt_dir)
+
+
+class TestPlot:
+    def test_plot_progress(self, tmp_path):
+        run = tmp_path / "logs" / "exp" / "exp_s1"
+        run.mkdir(parents=True)
+        (run / "progress.txt").write_text(
+            "Epoch\tAverageEpRet\n" + "".join(f"{i}\t{i*10}\n" for i in range(1, 6)))
+        from relayrl_tpu.utils.plot import get_newest_dataset, plot_progress
+
+        df = get_newest_dataset(str(tmp_path / "logs"))
+        assert df is not None and len(df) == 5
+        out = tmp_path / "plot.png"
+        plot_progress(str(tmp_path / "logs"), out_path=str(out), smooth=2)
+        assert out.is_file() and out.stat().st_size > 0

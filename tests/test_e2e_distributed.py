@@ -142,6 +142,42 @@ def test_multi_agent_zmq(tmp_cwd):
         server.disable_server()
 
 
+def test_server_checkpoint_resume(tmp_cwd):
+    """Kill the server after training; a resumed server continues at the
+    checkpointed version (beyond-reference capability, SURVEY.md §5.4)."""
+    server_addrs = _zmq_addrs()
+    hp = {"traj_per_epoch": 1, "hidden_sizes": [8], "with_vf_baseline": False,
+          "checkpoint_every_epochs": 1}
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, server_type="zmq",
+        env_dir=str(tmp_cwd), hyperparams=hp, **server_addrs)
+    try:
+        agent = Agent(server_type="zmq", handshake_timeout_s=20, seed=0,
+                      **_agent_addrs(server_addrs))
+        try:
+            run_gym_loop(agent, _RandomEnv(), episodes=3, max_steps=6)
+            deadline = time.monotonic() + 30
+            while server.stats["updates"] < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert server.stats["updates"] >= 3
+        finally:
+            agent.disable_agent()
+        trained_version = server.algorithm.version
+        from relayrl_tpu.checkpoint import checkpoint_algorithm
+
+        checkpoint_algorithm(server.algorithm, "checkpoints", wait=True)
+    finally:
+        server.disable_server()
+
+    resumed = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, server_type="zmq",
+        env_dir=str(tmp_cwd), hyperparams=hp, resume=True, **_zmq_addrs())
+    try:
+        assert resumed.algorithm.version == trained_version
+    finally:
+        resumed.disable_server()
+
+
 def test_server_restart(tmp_cwd):
     server_addrs = _zmq_addrs()
     server = TrainingServer(
