@@ -13,6 +13,7 @@ from relayrl_tpu.algorithms.base import (
     registered_algorithms,
 )
 from relayrl_tpu.algorithms.reinforce import REINFORCE, ReinforceState
+from relayrl_tpu.algorithms.ppo import PPO, PPOState
 
 __all__ = [
     "AlgorithmBase",
@@ -21,4 +22,6 @@ __all__ = [
     "registered_algorithms",
     "REINFORCE",
     "ReinforceState",
+    "PPO",
+    "PPOState",
 ]

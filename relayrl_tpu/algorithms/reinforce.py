@@ -62,21 +62,26 @@ def _param_labels(params) -> Any:
     return {"params": labeled}
 
 
+def make_optimizers(params, pi_lr: float, vf_lr: float):
+    """The (tx_pi, tx_vf) pair every actor-critic algorithm here uses: two
+    optimizers over ONE shared param tree, partitioned by the pi/vf labels —
+    the single source of truth for the partition (ctor and jitted update
+    must agree or opt-state structure silently drifts)."""
+    labels = _param_labels(params)
+    tx_pi = optax.multi_transform(
+        {"pi": optax.adam(pi_lr), "vf": optax.set_to_zero()}, labels)
+    tx_vf = optax.multi_transform(
+        {"pi": optax.set_to_zero(), "vf": optax.adam(vf_lr)}, labels)
+    return tx_pi, tx_vf
+
+
 def make_reinforce_update(policy, pi_lr: float, vf_lr: float,
                           train_vf_iters: int, gamma: float, lam: float,
                           with_baseline: bool):
     """Build the pure (state, batch) -> (state, metrics) epoch update."""
 
-    def make_txs(params):
-        labels = _param_labels(params)
-        tx_pi = optax.multi_transform(
-            {"pi": optax.adam(pi_lr), "vf": optax.set_to_zero()}, labels)
-        tx_vf = optax.multi_transform(
-            {"pi": optax.set_to_zero(), "vf": optax.adam(vf_lr)}, labels)
-        return tx_pi, tx_vf
-
     def update(state: ReinforceState, batch: Mapping[str, jax.Array]):
-        tx_pi, tx_vf = make_txs(state.params)
+        tx_pi, tx_vf = make_optimizers(state.params, pi_lr, vf_lr)
         obs, act, act_mask = batch["obs"], batch["act"], batch["act_mask"]
         rew, val, valid = batch["rew"], batch["val"], batch["valid"]
         last_val = batch["last_val"]
@@ -211,13 +216,9 @@ class REINFORCE(AlgorithmBase):
         )
         self._update = jax.jit(update, donate_argnums=0)
 
-        labels = _param_labels(net_params)
-        tx_pi = optax.multi_transform(
-            {"pi": optax.adam(float(params.get("pi_lr", 3e-4))),
-             "vf": optax.set_to_zero()}, labels)
-        tx_vf = optax.multi_transform(
-            {"pi": optax.set_to_zero(),
-             "vf": optax.adam(float(params.get("vf_lr", 1e-3)))}, labels)
+        tx_pi, tx_vf = make_optimizers(
+            net_params, float(params.get("pi_lr", 3e-4)),
+            float(params.get("vf_lr", 1e-3)))
         self.state = ReinforceState(
             params=net_params,
             pi_opt_state=tx_pi.init(net_params),

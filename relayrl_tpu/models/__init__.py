@@ -11,5 +11,6 @@ from relayrl_tpu.models.base import (
     validate_policy,
 )
 import relayrl_tpu.models.mlp  # noqa: F401  (registers mlp_discrete/continuous)
+import relayrl_tpu.models.cnn  # noqa: F401  (registers cnn_discrete)
 
 __all__ = ["Policy", "build_policy", "register_model", "validate_policy"]

@@ -73,13 +73,19 @@ class TrainingServer:
             **hp,
         )
 
-        if resume:
+        learner_cfg = self.config.get_learner_params()
+        # One resolution for save AND resume — a falsy configured value
+        # disables checkpointing entirely, anything else is used by both
+        # paths (a split default here would resume from a dir never written).
+        self._checkpoint_dir = learner_cfg.get("checkpoint_dir", "checkpoints")
+        self._checkpoint_every = max(
+            1, int(learner_cfg.get("checkpoint_every_epochs", 10)))
+
+        if resume and self._checkpoint_dir:
             from relayrl_tpu.checkpoint import restore_algorithm
 
-            learner_cfg = self.config.get_learner_params()
             try:
-                restore_algorithm(self.algorithm,
-                                  learner_cfg.get("checkpoint_dir", "checkpoints"))
+                restore_algorithm(self.algorithm, self._checkpoint_dir)
                 print(f"[TrainingServer] resumed at version "
                       f"{self.algorithm.version}", flush=True)
             except FileNotFoundError:
@@ -104,10 +110,6 @@ class TrainingServer:
         self.transport.get_model = self._get_model
         self.transport.on_register = self._on_register
 
-        learner_cfg = self.config.get_learner_params()
-        self._checkpoint_every = max(
-            1, int(learner_cfg.get("checkpoint_every_epochs", 10)))
-        self._checkpoint_dir = learner_cfg.get("checkpoint_dir")
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
         self.active = False
@@ -212,6 +214,15 @@ class TrainingServer:
         if self._learner_thread is not None:
             self._learner_thread.join(timeout=5)
             self._learner_thread = None
+        # Drain any in-flight async orbax save — the most recent checkpoint
+        # is exactly the one a subsequent resume needs.
+        mgr = getattr(self.algorithm, "_ckpt_mgr", None)
+        if mgr is not None:
+            try:
+                mgr.wait()
+            except Exception as e:
+                print(f"[TrainingServer] checkpoint drain failed: {e!r}",
+                      flush=True)
         self.active = False
 
     def restart_server(self, **addr_overrides) -> None:

@@ -1,0 +1,115 @@
+"""CNN (Atari-class) policy family: ABI, shapes, jit, PPO integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relayrl_tpu.models import build_policy, validate_policy
+
+ARCH = {
+    "kind": "cnn_discrete",
+    "obs_shape": [28, 28, 4],
+    "act_dim": 6,
+    # tiny conv spec so CPU tests stay fast
+    "conv_spec": [[8, 8, 4], [16, 4, 2]],
+    "dense": 64,
+}
+
+
+def _policy():
+    return build_policy(dict(ARCH))
+
+
+class TestCNNPolicy:
+    def test_obs_dim_derived_from_shape(self):
+        policy = _policy()
+        assert policy.input_dim == 28 * 28 * 4
+        assert policy.output_dim == 6
+
+    def test_step_single_and_batch(self):
+        policy = _policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        rng = jax.random.PRNGKey(1)
+        obs1 = jnp.zeros((policy.input_dim,), jnp.float32)
+        act, aux = jax.jit(policy.step)(params, rng, obs1, None)
+        assert np.asarray(act).shape == ()
+        assert set(aux) >= {"logp_a", "v"}
+
+        obsB = jnp.zeros((5, policy.input_dim), jnp.float32)
+        actB, auxB = jax.jit(policy.step)(params, rng, obsB, None)
+        assert np.asarray(actB).shape == (5,)
+        assert np.asarray(auxB["v"]).shape == (5,)
+
+    def test_evaluate_time_batched(self):
+        policy = _policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        obs = jnp.zeros((3, 7, policy.input_dim), jnp.float32)
+        act = jnp.zeros((3, 7), jnp.int32)
+        logp, ent, v = jax.jit(policy.evaluate)(params, obs, act, None)
+        assert logp.shape == (3, 7) and ent.shape == (3, 7) and v.shape == (3, 7)
+
+    def test_mask_suppresses_actions(self):
+        policy = _policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        obs = jnp.zeros((4, policy.input_dim), jnp.float32)
+        mask = jnp.zeros((4, 6), jnp.float32).at[:, 2].set(1.0)
+        act, _ = jax.jit(policy.step)(params, jax.random.PRNGKey(3), obs, mask)
+        assert np.all(np.asarray(act) == 2)
+
+    def test_validate_policy_abi(self):
+        policy = _policy()
+        params = policy.init_params(jax.random.PRNGKey(0))
+        validate_policy(policy, params)
+
+    def test_scale_obs_matches_manual(self):
+        """With scale_obs the net must see x/255 — check invariance."""
+        arch = dict(ARCH, scale_obs=True)
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        raw = np.full((policy.input_dim,), 255.0, np.float32)
+
+        arch_off = dict(ARCH, scale_obs=False)
+        policy_off = build_policy(arch_off)
+        logits_a = policy.evaluate(params, jnp.asarray(raw),
+                                   jnp.int32(0), None)[0]
+        logits_b = policy_off.evaluate(params, jnp.asarray(raw / 255.0),
+                                       jnp.int32(0), None)[0]
+        np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b),
+                                   rtol=1e-5)
+
+    def test_bad_obs_shape_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            build_policy({"kind": "cnn_discrete", "obs_shape": [28, 28],
+                          "act_dim": 4})
+
+
+def test_ppo_accepts_cnn_arch(tmp_cwd):
+    """PPO + obs_shape hyperparam selects the CNN family (the Atari-config
+    path from BASELINE.md)."""
+    from relayrl_tpu.algorithms import build_algorithm
+    from relayrl_tpu.types.action import ActionRecord
+
+    algo = build_algorithm(
+        "PPO", obs_dim=10 * 10 * 2, act_dim=4, traj_per_epoch=2,
+        minibatch_count=1, obs_shape=[10, 10, 2],
+        conv_spec=[[4, 4, 2]], dense=32, env_dir=str(tmp_cwd))
+    assert algo.arch["kind"] == "cnn_discrete"
+
+    rng = np.random.default_rng(0)
+    updated = False
+    for _ in range(2):
+        actions = [
+            ActionRecord(
+                obs=rng.integers(0, 255, 200).astype(np.float32),
+                act=np.int32(rng.integers(4)),
+                mask=np.ones(4, np.float32),
+                rew=1.0,
+                data={"logp_a": np.float32(-1.4), "v": np.float32(0.0)},
+                done=(i == 3),
+            )
+            for i in range(4)
+        ]
+        updated = algo.receive_trajectory(actions) or updated
+    assert updated and algo.version == 1

@@ -1,0 +1,82 @@
+"""Built-in classic-control envs: dynamics sanity + API shape."""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.envs import CartPoleEnv, PendulumEnv, make
+
+
+class TestCartPole:
+    def test_reset_and_step_shapes(self):
+        env = CartPoleEnv()
+        obs, info = env.reset(seed=0)
+        assert obs.shape == (4,) and obs.dtype == np.float32
+        obs, rew, term, trunc, _ = env.step(1)
+        assert obs.shape == (4,) and rew == 1.0
+        assert isinstance(term, bool) and isinstance(trunc, bool)
+
+    def test_seeding_is_deterministic(self):
+        a, _ = CartPoleEnv().reset(seed=7)
+        b, _ = CartPoleEnv().reset(seed=7)
+        np.testing.assert_array_equal(a, b)
+
+    def test_constant_action_terminates(self):
+        env = CartPoleEnv()
+        env.reset(seed=0)
+        for t in range(500):
+            _, _, term, trunc, _ = env.step(1)
+            if term:
+                break
+        assert term and t < 100  # always pushing right falls over fast
+
+    def test_truncates_at_max_steps(self):
+        env = CartPoleEnv(max_steps=5)
+        env.reset(seed=0)
+        # alternate to stay upright long enough
+        for i in range(5):
+            _, _, term, trunc, _ = env.step(i % 2)
+            if term:
+                pytest.skip("fell before truncation with this seed")
+        assert trunc
+
+    def test_random_policy_return_is_short(self):
+        env = CartPoleEnv()
+        rng = np.random.default_rng(0)
+        lengths = []
+        for ep in range(20):
+            env.reset(seed=ep)
+            for t in range(500):
+                _, _, term, trunc, _ = env.step(int(rng.integers(2)))
+                if term or trunc:
+                    break
+            lengths.append(t + 1)
+        assert 5 < np.mean(lengths) < 60  # gym's random-policy ballpark
+
+
+class TestPendulum:
+    def test_obs_is_cos_sin_thetadot(self):
+        env = PendulumEnv()
+        obs, _ = env.reset(seed=0)
+        assert obs.shape == (3,)
+        assert abs(obs[0] ** 2 + obs[1] ** 2 - 1.0) < 1e-5
+
+    def test_reward_is_negative_cost(self):
+        env = PendulumEnv()
+        env.reset(seed=0)
+        _, rew, term, trunc, _ = env.step([0.0])
+        assert rew <= 0.0 and not term
+
+    def test_truncation(self):
+        env = PendulumEnv(max_steps=3)
+        env.reset(seed=0)
+        for _ in range(3):
+            _, _, _, trunc, _ = env.step([0.0])
+        assert trunc
+
+
+def test_make_falls_back_to_builtin():
+    env = make("CartPole-v1")
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (4,)
+    with pytest.raises(ValueError):
+        make("NoSuchEnv-v0")
