@@ -18,12 +18,18 @@ from relayrl_tpu.parallel.sharding import (
     param_pspec,
     params_shardings,
     replicated,
+    sequence_batch_pspec,
     state_shardings,
 )
 from relayrl_tpu.parallel.learner import (
     make_sharded_update,
     place_batch,
     place_state,
+)
+from relayrl_tpu.parallel.context import current_mesh, use_mesh
+from relayrl_tpu.parallel.ring import (
+    make_ring_attention,
+    ring_attention_sharded,
 )
 
 __all__ = [
@@ -37,8 +43,13 @@ __all__ = [
     "param_pspec",
     "params_shardings",
     "replicated",
+    "sequence_batch_pspec",
     "state_shardings",
     "make_sharded_update",
     "place_batch",
     "place_state",
+    "current_mesh",
+    "use_mesh",
+    "make_ring_attention",
+    "ring_attention_sharded",
 ]

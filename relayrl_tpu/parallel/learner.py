@@ -15,26 +15,41 @@ from typing import Callable
 import jax
 from jax.sharding import Mesh
 
+from relayrl_tpu.parallel.context import use_mesh
 from relayrl_tpu.parallel.sharding import (
     batch_sharding,
     replicated,
+    sequence_batch_pspec,
     state_shardings,
 )
+from jax.sharding import NamedSharding
 
 
 def make_sharded_update(update_fn: Callable, mesh: Mesh, state_template,
-                        donate_state: bool = True) -> Callable:
+                        donate_state: bool = True,
+                        shard_time: bool = False) -> Callable:
     """Compile ``update_fn`` with mesh shardings.
 
     ``state_template`` is an abstract or concrete state pytree used to derive
     placements; the returned callable expects state already placed (use
     :func:`place_state` once) and a host or device batch dict.
+
+    ``shard_time=True`` additionally shards axis 1 (time) of rank>=2 batch
+    arrays over ``sp`` — the sequence-parallel path for transformer policies
+    whose attention runs as a ring over ``sp``. The mesh is installed as the
+    ambient mesh (:mod:`relayrl_tpu.parallel.context`) around tracing so
+    ``attention: "ring"`` models pick it up.
     """
     state_sh = state_shardings(state_template, mesh)
     batch_sh = batch_sharding(mesh)
 
     def batch_shardings_for(batch):
-        return {k: batch_sh for k in batch}
+        if not shard_time:
+            return {k: batch_sh for k in batch}
+        return {
+            k: NamedSharding(mesh, sequence_batch_pspec(mesh, v.ndim))
+            for k, v in batch.items()
+        }
 
     compiled_cache = {}
 
@@ -50,7 +65,8 @@ def make_sharded_update(update_fn: Callable, mesh: Mesh, state_template,
                 donate_argnums=(0,) if donate_state else (),
             )
             compiled_cache[key] = fn
-        return fn(state, batch)
+        with use_mesh(mesh):
+            return fn(state, batch)
 
     return sharded_update
 
@@ -60,8 +76,15 @@ def place_state(state, mesh: Mesh):
     return jax.device_put(state, state_shardings(state, mesh))
 
 
-def place_batch(batch: dict, mesh: Mesh) -> dict:
+def place_batch(batch: dict, mesh: Mesh, shard_time: bool = False) -> dict:
     """Host batch → device-sharded arrays (the jax.device_put ingest path —
-    BASELINE.md north-star names this explicitly)."""
+    BASELINE.md north-star names this explicitly). ``shard_time`` must match
+    the :func:`make_sharded_update` flag."""
+    if shard_time:
+        return {
+            k: jax.device_put(
+                v, NamedSharding(mesh, sequence_batch_pspec(mesh, v.ndim)))
+            for k, v in batch.items()
+        }
     sh = batch_sharding(mesh)
     return {k: jax.device_put(v, sh) for k, v in batch.items()}

@@ -37,6 +37,19 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_pspec(mesh))
 
 
+def sequence_batch_pspec(mesh: Mesh, ndim: int) -> P:
+    """Spec for a ``[B, T, ...]`` batch array: batch over dp×fsdp AND time
+    over ``sp`` (the sequence-parallel ingest path feeding ring attention).
+    Rank-1 arrays (per-episode scalars like ``last_val``) shard batch only."""
+    from relayrl_tpu.parallel.mesh import data_axes
+
+    axes = data_axes(mesh)
+    b = axes if axes else None
+    if ndim >= 2 and mesh.shape.get("sp", 1) > 1:
+        return P(b, "sp")
+    return P(b)
+
+
 _DENSE_LAYER = re.compile(r"dense_(\d+)$")
 
 
