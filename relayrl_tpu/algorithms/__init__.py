@@ -14,6 +14,12 @@ from relayrl_tpu.algorithms.base import (
 )
 from relayrl_tpu.algorithms.reinforce import REINFORCE, ReinforceState
 from relayrl_tpu.algorithms.ppo import PPO, PPOState
+from relayrl_tpu.algorithms.offpolicy import OffPolicyAlgorithm
+from relayrl_tpu.algorithms.dqn import DQN, DQNState
+from relayrl_tpu.algorithms.c51 import C51, C51State
+from relayrl_tpu.algorithms.ddpg import DDPG, DDPGState
+from relayrl_tpu.algorithms.td3 import TD3, TD3State
+from relayrl_tpu.algorithms.sac import SAC, SACState
 
 __all__ = [
     "AlgorithmBase",
@@ -24,4 +30,15 @@ __all__ = [
     "ReinforceState",
     "PPO",
     "PPOState",
+    "OffPolicyAlgorithm",
+    "DQN",
+    "DQNState",
+    "C51",
+    "C51State",
+    "DDPG",
+    "DDPGState",
+    "TD3",
+    "TD3State",
+    "SAC",
+    "SACState",
 ]

@@ -45,6 +45,94 @@ DEFAULT_CONFIG: dict = {
             "target_kl": 0.015,
             "hidden_sizes": [128, 128],
         },
+        "DQN": {
+            "discrete": True,
+            "seed": 1,
+            "gamma": 0.99,
+            "lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "double_q": True,
+            "epsilon_start": 1.0,
+            "epsilon_end": 0.05,
+            "epsilon_decay_steps": 10_000,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "C51": {
+            "discrete": True,
+            "seed": 1,
+            "gamma": 0.99,
+            "lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "n_atoms": 51,
+            "v_min": -10.0,
+            "v_max": 10.0,
+            "epsilon_start": 1.0,
+            "epsilon_end": 0.05,
+            "epsilon_decay_steps": 10_000,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "DDPG": {
+            "discrete": False,
+            "seed": 1,
+            "gamma": 0.99,
+            "pi_lr": 1e-3,
+            "q_lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "act_limit": 1.0,
+            "act_noise": 0.1,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "TD3": {
+            "discrete": False,
+            "seed": 1,
+            "gamma": 0.99,
+            "pi_lr": 1e-3,
+            "q_lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "act_limit": 1.0,
+            "act_noise": 0.1,
+            "target_noise": 0.2,
+            "noise_clip": 0.5,
+            "policy_delay": 2,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "SAC": {
+            "discrete": False,
+            "seed": 1,
+            "gamma": 0.99,
+            "pi_lr": 3e-4,
+            "q_lr": 3e-4,
+            "alpha_lr": 3e-4,
+            "alpha": 0.2,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "act_limit": 1.0,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
     },
     "grpc_idle_timeout_s": 30.0,
     "max_traj_length": 1000,

@@ -9,8 +9,10 @@ from relayrl_tpu.data.batching import (
     stack_trajectories,
 )
 from relayrl_tpu.data.replay_buffer import DEFAULT_BUCKETS, EpochBuffer
+from relayrl_tpu.data.step_buffer import StepReplayBuffer
 
 __all__ = [
+    "StepReplayBuffer",
     "PaddedTrajectory",
     "TrajectoryBatch",
     "pad_trajectory",

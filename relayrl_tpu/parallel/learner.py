@@ -41,15 +41,9 @@ def make_sharded_update(update_fn: Callable, mesh: Mesh, state_template,
     ``attention: "ring"`` models pick it up.
     """
     state_sh = state_shardings(state_template, mesh)
-    batch_sh = batch_sharding(mesh)
 
     def batch_shardings_for(batch):
-        if not shard_time:
-            return {k: batch_sh for k in batch}
-        return {
-            k: NamedSharding(mesh, sequence_batch_pspec(mesh, v.ndim))
-            for k, v in batch.items()
-        }
+        return batch_shardings(mesh, batch, shard_time)
 
     compiled_cache = {}
 
@@ -71,6 +65,18 @@ def make_sharded_update(update_fn: Callable, mesh: Mesh, state_template,
     return sharded_update
 
 
+def batch_shardings(mesh: Mesh, batch: dict, shard_time: bool = False) -> dict:
+    """Per-key NamedShardings for a batch dict: batch axis over dp×fsdp,
+    plus (``shard_time=True``) the time axis of rank>=2 arrays over ``sp``."""
+    if shard_time:
+        return {
+            k: NamedSharding(mesh, sequence_batch_pspec(mesh, v.ndim))
+            for k, v in batch.items()
+        }
+    sh = batch_sharding(mesh)
+    return {k: sh for k in batch}
+
+
 def place_state(state, mesh: Mesh):
     """Device-put a host/single-device state onto the mesh per the rules."""
     return jax.device_put(state, state_shardings(state, mesh))
@@ -80,11 +86,5 @@ def place_batch(batch: dict, mesh: Mesh, shard_time: bool = False) -> dict:
     """Host batch → device-sharded arrays (the jax.device_put ingest path —
     BASELINE.md north-star names this explicitly). ``shard_time`` must match
     the :func:`make_sharded_update` flag."""
-    if shard_time:
-        return {
-            k: jax.device_put(
-                v, NamedSharding(mesh, sequence_batch_pspec(mesh, v.ndim)))
-            for k, v in batch.items()
-        }
-    sh = batch_sharding(mesh)
-    return {k: jax.device_put(v, sh) for k, v in batch.items()}
+    sh = batch_shardings(mesh, batch, shard_time)
+    return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}

@@ -19,6 +19,7 @@ import threading
 from typing import Any, Mapping
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from relayrl_tpu.models import build_policy, validate_policy
@@ -46,8 +47,19 @@ class PolicyActor:
         self.params = bundle.params
         self.version = bundle.version
         self._step_fn = jax.jit(self.policy.step)
+        self._explore_kwargs = self._explore_from_arch(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
+
+    @staticmethod
+    def _explore_from_arch(arch: dict) -> dict:
+        """Exploration knobs present in the arch, as device scalars passed
+        to ``step`` per call — traced arguments, so the learner annealing
+        them across publishes never triggers a retrace."""
+        from relayrl_tpu.types.model_bundle import EXPLORATION_ARCH_KEYS
+
+        return {k: jnp.float32(arch[k]) for k in EXPLORATION_ARCH_KEYS
+                if k in arch}
 
     # -- reference API (agent_zmq.rs:458-571 / o3_agent.rs:117-182) --
     def request_for_action(
@@ -61,7 +73,8 @@ class PolicyActor:
         mask_arr = None if mask is None else np.asarray(mask, dtype=np.float32)
         with self._lock:
             self._rng, sub = jax.random.split(self._rng)
-            act, aux = self._step_fn(self.params, sub, obs, mask_arr)
+            act, aux = self._step_fn(self.params, sub, obs, mask_arr,
+                                     **self._explore_kwargs)
             record = ActionRecord(
                 obs=obs,
                 act=np.asarray(act),
@@ -99,6 +112,12 @@ class PolicyActor:
                 "actor refuses hot-swap (param-ABI guard)"
             )
         with self._lock:
+            if dict(bundle.arch) != self.arch:
+                # Exploration knobs (epsilon/act_noise) changed: they are
+                # traced step arguments, so only the scalar values refresh —
+                # no policy rebuild, no retrace.
+                self.arch = dict(bundle.arch)
+                self._explore_kwargs = self._explore_from_arch(self.arch)
             self.params = bundle.params
             self.version = bundle.version
         return True

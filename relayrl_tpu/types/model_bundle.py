@@ -82,8 +82,17 @@ class ModelBundle:
             return cls.from_bytes(f.read(), params_template)
 
 
+# Arch keys the learner may legitimately change between publishes without
+# changing the parameter ABI — exploration schedules ride the arch config
+# (e.g. DQN anneals `epsilon`, DDPG/TD3 tune `act_noise`). Everything else
+# is structural: a mismatch means the params won't fit the network.
+EXPLORATION_ARCH_KEYS = frozenset({"epsilon", "act_noise"})
+
+
 def arch_equal(a: Mapping[str, Any], b: Mapping[str, Any]) -> bool:
-    """Strict arch-config equality — the actor refuses a hot-swap whose arch
-    differs from the one it validated at handshake (param-ABI guard, SURVEY.md
-    §7.4 item 2)."""
-    return dict(a) == dict(b)
+    """Structural arch-config equality — the actor refuses a hot-swap whose
+    arch differs from the one it validated at handshake (param-ABI guard,
+    SURVEY.md §7.4 item 2). Exploration-only keys are exempt."""
+    sa = {k: v for k, v in a.items() if k not in EXPLORATION_ARCH_KEYS}
+    sb = {k: v for k, v in b.items() if k not in EXPLORATION_ARCH_KEYS}
+    return sa == sb

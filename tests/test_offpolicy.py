@@ -1,0 +1,268 @@
+"""Off-policy stack: step replay buffer, DQN/C51/DDPG/TD3/SAC.
+
+Learning checks use action-dependent-reward bandits (reward is a function
+of the action only), which every off-policy method must solve from randomly
+generated behavior data — exercising the replay path, targets, and the
+actor/critic updates without long environment rollouts.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import build_algorithm, registered_algorithms
+from relayrl_tpu.algorithms.c51 import categorical_projection
+from relayrl_tpu.data import StepReplayBuffer
+from relayrl_tpu.models import build_policy
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle
+
+import jax.numpy as jnp
+
+OBS_DIM = 4
+
+
+def _discrete_episode(n, act_fn, obs_dim=OBS_DIM, act_dim=2, seed=0):
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        obs = rng.standard_normal(obs_dim).astype(np.float32)
+        act = int(act_fn(rng))
+        records.append(ActionRecord(
+            obs=obs, act=np.int64(act), rew=1.0 if act == 1 else 0.0,
+            done=(i == n - 1)))
+    return records
+
+
+def _continuous_episode(n, obs_dim=OBS_DIM, act_dim=1, seed=0):
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        obs = rng.standard_normal(obs_dim).astype(np.float32)
+        act = rng.uniform(-1, 1, act_dim).astype(np.float32)
+        rew = float(-np.sum(np.square(act - 0.5)))
+        records.append(ActionRecord(
+            obs=obs, act=act, rew=rew, done=(i == n - 1)))
+    return records
+
+
+class TestStepReplayBuffer:
+    def test_transitions_link_successor_obs(self):
+        buf = StepReplayBuffer(OBS_DIM, 2, capacity=100)
+        ep = _discrete_episode(5, lambda r: r.integers(2), seed=3)
+        stored = buf.add_episode(ep)
+        assert stored == 5
+        np.testing.assert_array_equal(buf.obs[1], ep[1].obs)
+        np.testing.assert_array_equal(buf.obs2[0], ep[1].obs)
+        np.testing.assert_array_equal(buf.obs2[3], ep[4].obs)
+        assert buf.done[4] == 1.0 and buf.done[:4].sum() == 0
+
+    def test_terminal_marker_folds_reward(self):
+        buf = StepReplayBuffer(OBS_DIM, 2, capacity=100)
+        ep = _discrete_episode(3, lambda r: 1, seed=0)
+        ep[-1] = ActionRecord(obs=ep[-1].obs, act=ep[-1].act, rew=ep[-1].rew,
+                              done=False)
+        ep.append(ActionRecord(rew=5.0, done=True))  # flag_last_action marker
+        assert buf.add_episode(ep) == 3
+        assert buf.rew[2] == pytest.approx(1.0 + 5.0)
+        assert buf.done[2] == 1.0
+
+    def test_truncated_final_step_dropped(self):
+        buf = StepReplayBuffer(OBS_DIM, 2, capacity=100)
+        ep = _discrete_episode(4, lambda r: 0, seed=0)
+        ep[-1] = ActionRecord(obs=ep[-1].obs, act=ep[-1].act, rew=0.0,
+                              done=False)  # truncated, no successor
+        assert buf.add_episode(ep) == 3
+
+    def test_ring_wraparound(self):
+        buf = StepReplayBuffer(OBS_DIM, 2, capacity=8)
+        for s in range(4):
+            buf.add_episode(_discrete_episode(5, lambda r: 0, seed=s))
+        assert len(buf) == 8
+        assert buf.total_steps == 20
+        batch = buf.sample(16)
+        assert batch["obs"].shape == (16, OBS_DIM)
+        assert set(batch) == {"obs", "act", "rew", "obs2", "mask2", "done"}
+
+
+class TestCategoricalProjection:
+    def test_mass_conserved(self):
+        support = jnp.linspace(-5.0, 5.0, 11)
+        probs = jax.nn.softmax(
+            jnp.asarray(np.random.default_rng(0).standard_normal((6, 11))))
+        rew = jnp.asarray(np.random.default_rng(1).uniform(-3, 3, 6),
+                          jnp.float32)
+        done = jnp.asarray([0, 1, 0, 1, 0, 0], jnp.float32)
+        proj = categorical_projection(support, probs, rew, done, 0.9)
+        np.testing.assert_allclose(np.sum(proj, -1), 1.0, rtol=1e-5)
+
+    def test_terminal_projects_reward_delta(self):
+        """done=1 collapses the target onto the reward atom."""
+        support = jnp.linspace(0.0, 10.0, 11)  # dz = 1
+        probs = jnp.full((1, 11), 1.0 / 11)
+        proj = categorical_projection(
+            support, probs, jnp.asarray([3.0]), jnp.asarray([1.0]), 0.99)
+        expected = np.zeros(11)
+        expected[3] = 1.0
+        np.testing.assert_allclose(proj[0], expected, atol=1e-6)
+
+    def test_fractional_split(self):
+        support = jnp.linspace(0.0, 10.0, 11)
+        probs = jnp.zeros((1, 11)).at[0, 0].set(1.0)
+        # Tz = 2.5 for the only massive atom -> split 0.5/0.5 across bins 2,3
+        proj = categorical_projection(
+            support, probs, jnp.asarray([2.5]), jnp.asarray([1.0]), 0.99)
+        assert proj[0, 2] == pytest.approx(0.5)
+        assert proj[0, 3] == pytest.approx(0.5)
+
+
+def _feed(algo, episodes):
+    for i, ep in enumerate(episodes):
+        algo.receive_trajectory(ep)
+
+
+def _mk(tmp_cwd, name, **kw):
+    base = dict(
+        obs_dim=OBS_DIM, batch_size=64, update_after=200,
+        buffer_size=5000, hidden_sizes=[32], traj_per_epoch=4,
+        env_dir=str(tmp_cwd),
+        logger_kwargs={"output_dir": str(tmp_cwd / f"logs_{name}")})
+    base.update(kw)
+    return build_algorithm(name, **base)
+
+
+class TestDiscreteAlgorithms:
+    @pytest.mark.parametrize("name", ["DQN", "C51"])
+    def test_registered(self, name):
+        assert name in registered_algorithms()
+
+    @pytest.mark.parametrize("name,extra", [
+        ("DQN", {}),
+        ("C51", {"v_min": -1.0, "v_max": 30.0}),
+    ])
+    def test_learns_bandit(self, tmp_cwd, name, extra):
+        """Action 1 always pays 1; greedy policy must find it from random
+        behavior data."""
+        algo = _mk(tmp_cwd, name, act_dim=2, gamma=0.9, lr=3e-3,
+                   polyak=0.95, epsilon_decay_steps=500, **extra)
+        eps = [
+            _discrete_episode(25, lambda r: r.integers(2), seed=s)
+            for s in range(30)
+        ]
+        _feed(algo, eps)
+        assert algo.version > 0
+        obs = np.random.default_rng(9).standard_normal((16, OBS_DIM)).astype(
+            np.float32)
+        greedy = np.asarray(jax.jit(algo.policy.mode)(
+            algo._actor_params(), jnp.asarray(obs)))
+        assert (greedy == 1).mean() >= 0.9
+
+    def test_epsilon_anneals_into_bundle(self, tmp_cwd):
+        algo = _mk(tmp_cwd, "DQN", act_dim=2, epsilon_decay_steps=100)
+        assert algo.bundle().arch["epsilon"] == pytest.approx(1.0)
+        _feed(algo, [_discrete_episode(60, lambda r: 0, seed=s)
+                     for s in range(3)])
+        arch = algo.bundle().arch
+        assert arch["epsilon"] == pytest.approx(0.05)
+
+    def test_bundle_roundtrip_applies(self, tmp_cwd):
+        algo = _mk(tmp_cwd, "DQN", act_dim=3)
+        _feed(algo, [_discrete_episode(30, lambda r: r.integers(3), seed=s)
+                     for s in range(8)])
+        path = tmp_cwd / "m.rlx"
+        algo.save(path)
+        bundle = ModelBundle.load(path)
+        policy = build_policy(bundle.arch)
+        act, aux = policy.step(bundle.params, jax.random.PRNGKey(0),
+                               jnp.zeros((OBS_DIM,)))
+        assert int(act) in (0, 1, 2)
+        assert "v" in aux
+
+
+class TestExplorationHotSwap:
+    def test_epsilon_change_swaps_and_rebuilds(self):
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+
+        arch = {"kind": "qnet_discrete", "obs_dim": OBS_DIM, "act_dim": 2,
+                "hidden_sizes": [8], "epsilon": 1.0}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        actor = PolicyActor(ModelBundle(version=1, arch=arch, params=params))
+        new = ModelBundle(version=2, arch={**arch, "epsilon": 0.0},
+                          params=params)
+        assert actor.maybe_swap(new) is True
+        assert actor.arch["epsilon"] == 0.0
+        # epsilon=0 => greedy: repeated steps at a fixed obs must agree
+        obs = np.ones((OBS_DIM,), np.float32)
+        acts = {int(actor.request_for_action(obs).get_act().reshape(-1)[0])
+                for _ in range(8)}
+        assert len(acts) == 1
+
+    def test_structural_change_still_rejected(self):
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+
+        arch = {"kind": "qnet_discrete", "obs_dim": OBS_DIM, "act_dim": 2,
+                "hidden_sizes": [8], "epsilon": 1.0}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        actor = PolicyActor(ModelBundle(version=1, arch=arch, params=params))
+        bad = ModelBundle(version=2, arch={**arch, "hidden_sizes": [16]},
+                          params=params)
+        with pytest.raises(ValueError, match="param-ABI guard"):
+            actor.maybe_swap(bad)
+
+
+class TestContinuousAlgorithms:
+    @pytest.mark.parametrize("name", ["DDPG", "TD3", "SAC"])
+    def test_registered(self, name):
+        assert name in registered_algorithms()
+
+    @pytest.mark.parametrize("name", ["DDPG", "TD3", "SAC"])
+    def test_learns_target_action(self, tmp_cwd, name):
+        """reward = -(a - 0.5)^2 from uniform random behavior: the greedy
+        action must move to ~0.5. gamma=0 makes it a pure contextual bandit
+        so the critic fits the reward surface directly."""
+        algo = _mk(tmp_cwd, name, act_dim=1, gamma=0.0, polyak=0.9,
+                   pi_lr=1e-3, q_lr=3e-3, update_after=300,
+                   updates_per_step=2.0)
+        eps = [_continuous_episode(25, seed=s) for s in range(50)]
+        _feed(algo, eps)
+        assert algo.version > 0
+        obs = np.random.default_rng(7).standard_normal((16, OBS_DIM)).astype(
+            np.float32)
+        a = np.asarray(jax.jit(algo.policy.mode)(
+            algo._actor_params(), jnp.asarray(obs)))
+        assert np.abs(a - 0.5).mean() < 0.25, a.ravel()
+
+    def test_sac_alpha_adapts(self, tmp_cwd):
+        algo = _mk(tmp_cwd, "SAC", act_dim=1, update_after=100)
+        alpha0 = float(jnp.exp(algo.state.log_alpha))
+        _feed(algo, [_continuous_episode(25, seed=s) for s in range(10)])
+        assert float(jnp.exp(algo.state.log_alpha)) != pytest.approx(alpha0)
+        assert "Alpha" in algo._last_metrics
+
+    def test_td3_delayed_actor(self, tmp_cwd):
+        """With policy_delay=2, LossPi is 0 on odd steps (skipped branch)."""
+        algo = _mk(tmp_cwd, "TD3", act_dim=1, update_after=1,
+                   updates_per_step=0.04, policy_delay=2)
+        # One update per episode: version parity decides the actor branch.
+        algo.receive_trajectory(_continuous_episode(25, seed=0))  # step 0: update
+        first = algo._last_metrics["LossPi"]
+        algo.receive_trajectory(_continuous_episode(25, seed=1))  # step 1: skip
+        second = algo._last_metrics["LossPi"]
+        assert first != 0.0
+        assert second == 0.0
+
+    def test_bundle_roundtrip_applies(self, tmp_cwd):
+        algo = _mk(tmp_cwd, "SAC", act_dim=2, act_limit=2.0)
+        _feed(algo, [_continuous_episode(20, act_dim=2, seed=s)
+                     for s in range(8)])
+        path = tmp_cwd / "m.rlx"
+        algo.save(path)
+        bundle = ModelBundle.load(path)
+        policy = build_policy(bundle.arch)
+        act, aux = policy.step(bundle.params, jax.random.PRNGKey(0),
+                               jnp.zeros((OBS_DIM,)))
+        assert act.shape == (2,)
+        assert float(jnp.max(jnp.abs(act))) <= 2.0
+        assert "logp_a" in aux
