@@ -20,6 +20,7 @@ from relayrl_tpu.algorithms.c51 import C51, C51State
 from relayrl_tpu.algorithms.ddpg import DDPG, DDPGState
 from relayrl_tpu.algorithms.td3 import TD3, TD3State
 from relayrl_tpu.algorithms.sac import SAC, SACState
+from relayrl_tpu.algorithms.impala import IMPALA, ImpalaState
 
 __all__ = [
     "AlgorithmBase",
@@ -41,4 +42,6 @@ __all__ = [
     "TD3State",
     "SAC",
     "SACState",
+    "IMPALA",
+    "ImpalaState",
 ]

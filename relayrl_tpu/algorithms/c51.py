@@ -24,7 +24,7 @@ from relayrl_tpu.algorithms.offpolicy import (
     polyak_update,
 )
 from relayrl_tpu.models import build_policy
-from relayrl_tpu.models.mlp import _MASK_FILL
+from relayrl_tpu.models.mlp import _MASK_FILL, _compute_dtype
 from relayrl_tpu.models.q_networks import DistributionalQNet
 
 
@@ -125,7 +125,8 @@ class C51(EpsilonGreedyMixin, OffPolicyAlgorithm):
         self._module = DistributionalQNet(
             act_dim=self.act_dim,
             n_atoms=n_atoms,
-            hidden_sizes=tuple(self.arch["hidden_sizes"]))
+            hidden_sizes=tuple(self.arch["hidden_sizes"]),
+            compute_dtype=_compute_dtype(self.arch))
         support = jnp.linspace(self.arch["v_min"], self.arch["v_max"], n_atoms)
         net_params = self.policy.init_params(self._rng_init)
         tx = optax.adam(float(params.get("lr", 1e-3)))

@@ -20,6 +20,7 @@ from flax import struct
 from relayrl_tpu.algorithms.base import register_algorithm
 from relayrl_tpu.algorithms.offpolicy import OffPolicyAlgorithm, polyak_update
 from relayrl_tpu.models import build_policy
+from relayrl_tpu.models.mlp import _compute_dtype
 from relayrl_tpu.models.q_networks import DeterministicActor, QValueNet
 
 
@@ -100,9 +101,11 @@ class DDPG(OffPolicyAlgorithm):
         }
         self.policy = build_policy(self.arch)
         hidden = tuple(self.arch["hidden_sizes"])
+        dtype = _compute_dtype(self.arch)
         self._actor = DeterministicActor(
-            act_dim=self.act_dim, act_limit=act_limit, hidden_sizes=hidden)
-        self._critic = QValueNet(hidden_sizes=hidden)
+            act_dim=self.act_dim, act_limit=act_limit, hidden_sizes=hidden,
+            compute_dtype=dtype)
+        self._critic = QValueNet(hidden_sizes=hidden, compute_dtype=dtype)
 
         a_rng, c_rng = jax.random.split(self._rng_init)
         obs0 = jnp.zeros((1, self.obs_dim), jnp.float32)

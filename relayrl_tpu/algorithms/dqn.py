@@ -22,11 +22,10 @@ from relayrl_tpu.algorithms.base import register_algorithm
 from relayrl_tpu.algorithms.offpolicy import (
     EpsilonGreedyMixin,
     OffPolicyAlgorithm,
-    huber,
     polyak_update,
 )
 from relayrl_tpu.models import build_policy
-from relayrl_tpu.models.mlp import _MASK_FILL
+from relayrl_tpu.models.mlp import _MASK_FILL, _compute_dtype
 from relayrl_tpu.models.q_networks import DiscreteQNet
 
 
@@ -60,7 +59,7 @@ def make_dqn_update(module: DiscreteQNet, gamma: float, lr: float,
             q = module.apply(params, obs)
             q_a = jnp.take_along_axis(
                 q, act[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
-            return jnp.mean(huber(q_a - target)), q_a
+            return jnp.mean(optax.huber_loss(q_a, target)), q_a
 
         (loss, q_a), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)
@@ -92,7 +91,8 @@ class DQN(EpsilonGreedyMixin, OffPolicyAlgorithm):
         self.policy = build_policy(self.arch)
         self._module = DiscreteQNet(
             act_dim=self.act_dim,
-            hidden_sizes=tuple(self.arch["hidden_sizes"]))
+            hidden_sizes=tuple(self.arch["hidden_sizes"]),
+            compute_dtype=_compute_dtype(self.arch))
         net_params = self.policy.init_params(self._rng_init)
         tx = optax.adam(float(params.get("lr", 1e-3)))
         self.state = DQNState(

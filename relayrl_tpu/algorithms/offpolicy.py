@@ -39,12 +39,6 @@ def polyak_update(online_params, target_params, polyak: float):
                                     step_size=1.0 - polyak)
 
 
-def huber(x: jax.Array, delta: float = 1.0) -> jax.Array:
-    absx = jnp.abs(x)
-    return jnp.where(absx <= delta, 0.5 * x * x,
-                     delta * (absx - 0.5 * delta))
-
-
 class OffPolicyAlgorithm(AlgorithmBase):
     """Transition-replay learner loop shared by DQN/C51/DDPG/TD3/SAC."""
 
@@ -175,13 +169,11 @@ class OffPolicyAlgorithm(AlgorithmBase):
 
     # convenience for in-process actors/tests
     def act(self, obs, mask=None):
-        from relayrl_tpu.types.model_bundle import EXPLORATION_ARCH_KEYS
+        from relayrl_tpu.types.model_bundle import exploration_kwargs
 
         self._rng_state, sub = jax.random.split(self._rng_state)
         # Current (possibly annealed) exploration knobs ride as traced args.
-        arch = self._publish_arch()
-        explore = {k: jnp.float32(arch[k]) for k in EXPLORATION_ARCH_KEYS
-                   if k in arch}
+        explore = exploration_kwargs(self._publish_arch())
         act, aux = jax.jit(self.policy.step)(
             self._actor_params(), sub, jnp.asarray(obs), mask, **explore)
         return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}

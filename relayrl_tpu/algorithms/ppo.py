@@ -20,24 +20,18 @@ for PPO on Atari (BASELINE.md). This is the full algorithm, TPU-first:
 
 from __future__ import annotations
 
-import os
-from typing import Any, Mapping, Sequence
+from typing import Any, Mapping
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from flax import struct
 
-from relayrl_tpu.algorithms.base import AlgorithmBase, register_algorithm
+from relayrl_tpu.algorithms.base import register_algorithm
+from relayrl_tpu.algorithms.onpolicy import OnPolicyAlgorithm
 from relayrl_tpu.algorithms.reinforce import make_optimizers
-from relayrl_tpu.config import ConfigLoader
-from relayrl_tpu.data import EpochBuffer
 from relayrl_tpu.models import build_policy
 from relayrl_tpu.ops import gae_advantages, masked_mean_std, normalize_advantages
-from relayrl_tpu.types.action import ActionRecord
-from relayrl_tpu.types.model_bundle import ModelBundle
-from relayrl_tpu.utils import EpochLogger, setup_logger_kwargs
 
 
 class PPOState(struct.PyTreeNode):
@@ -163,38 +157,20 @@ def make_ppo_update(
 
 
 @register_algorithm("PPO")
-class PPO(AlgorithmBase):
+class PPO(OnPolicyAlgorithm):
     """Host-side PPO orchestration (same ctor shape as REINFORCE —
     reference REINFORCE.py:16-62 — so the training server treats all
     algorithms uniformly)."""
 
-    def __init__(
-        self,
-        env_dir: str | None = None,
-        config_path: str | None = None,
-        obs_dim: int = 4,
-        act_dim: int = 2,
-        buf_size: int | None = None,
-        logger_kwargs: Mapping[str, Any] | None = None,
-        **overrides,
-    ):
-        loader = ConfigLoader("PPO", config_path, create_if_missing=False)
-        params = loader.get_algorithm_params()
-        params.update(overrides)
-        learner = loader.get_learner_params()
+    ALGO_NAME = "PPO"
 
-        self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
-        self.discrete = bool(params.get("discrete", True))
-        self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
+    def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
         self.minibatch_count = int(params.get("minibatch_count", 4))
         if self.traj_per_epoch % self.minibatch_count:
             raise ValueError(
                 f"traj_per_epoch ({self.traj_per_epoch}) must be divisible by "
                 f"minibatch_count ({self.minibatch_count})")
-        self.gamma = float(params.get("gamma", 0.99))
         self.lam = float(params.get("lam", 0.95))
-        seed = int(params.get("seed", 1))
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), os.getpid())
 
         obs_shape = params.get("obs_shape")
         if obs_shape is not None:
@@ -246,68 +222,6 @@ class PPO(AlgorithmBase):
             step=jnp.int32(0),
         )
 
-        self.buffer = EpochBuffer(
-            obs_dim=self.obs_dim,
-            act_dim=self.act_dim,
-            traj_per_epoch=self.traj_per_epoch,
-            discrete=self.discrete,
-            buckets=learner.get("bucket_lengths", (64, 256, 1000)),
-            max_traj_length=loader.get_max_traj_length(),
-        )
-
-        lk = dict(logger_kwargs) if logger_kwargs else setup_logger_kwargs(
-            "relayrl-ppo", seed, data_dir=os.path.join(env_dir or ".", "logs"))
-        self.logger = EpochLogger(**lk)
-        self.logger.save_config({"algorithm": "PPO", **params,
-                                 "obs_dim": obs_dim, "act_dim": act_dim})
-        self.epoch = 0
-        self._last_metrics: dict[str, float] = {}
-        self.server_model_path = loader.get_server_model_path()
-
-    # -- reference contract --
-    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
-        if not actions:
-            return False
-        if self.buffer.add_episode(actions):
-            self.train_model()
-            self.log_epoch()
-            return True
-        return False
-
-    def train_model(self) -> Mapping[str, float]:
-        batch = self.buffer.drain()
-        device_batch = {k: jnp.asarray(v) for k, v in batch.as_dict().items()}
-        self.state, metrics = self._update(self.state, device_batch)
-        self._last_metrics = {k: float(v) for k, v in metrics.items()}
-        return self._last_metrics
-
-    def log_epoch(self) -> None:
-        rets, lens = self.buffer.pop_episode_stats()
-        self.epoch += 1
-        self.logger.store(EpRet=rets or [0.0], EpLen=lens or [0])
-        self.logger.log_tabular("Epoch", self.epoch)
-        self.logger.log_tabular("EpRet", with_min_and_max=True)
-        self.logger.log_tabular("EpLen", average_only=True)
-        for key in ("LossPi", "DeltaLossPi", "LossV", "DeltaLossV", "KL",
-                    "Entropy", "ClipFrac"):
-            self.logger.log_tabular(key, self._last_metrics.get(key, 0.0))
-        self.logger.dump_tabular()
-
-    def save(self, path=None) -> None:
-        self.bundle().save(path or self.server_model_path)
-
-    def bundle(self) -> ModelBundle:
-        host_params = jax.device_get(self.state.params)
-        return ModelBundle(version=self.version, arch=self.arch,
-                           params=host_params)
-
-    @property
-    def version(self) -> int:
-        return int(self.state.step)
-
-    def act(self, obs, mask=None):
-        rng, sub = jax.random.split(self.state.rng)
-        self.state = self.state.replace(rng=rng)
-        act, aux = jax.jit(self.policy.step)(self.state.params, sub,
-                                             jnp.asarray(obs), mask)
-        return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}
+    def _log_keys(self):
+        return ("LossPi", "DeltaLossPi", "LossV", "DeltaLossV", "KL",
+                "Entropy", "ClipFrac")

@@ -21,23 +21,17 @@ TPU-first redesign:
 
 from __future__ import annotations
 
-import os
-from typing import Any, Mapping, Sequence
+from typing import Any, Mapping
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from flax import struct
 
-from relayrl_tpu.algorithms.base import AlgorithmBase, register_algorithm
-from relayrl_tpu.config import ConfigLoader
-from relayrl_tpu.data import EpochBuffer, TrajectoryBatch
+from relayrl_tpu.algorithms.base import register_algorithm
+from relayrl_tpu.algorithms.onpolicy import OnPolicyAlgorithm
 from relayrl_tpu.models import build_policy
 from relayrl_tpu.ops import gae_advantages, masked_mean_std, normalize_advantages
-from relayrl_tpu.types.action import ActionRecord
-from relayrl_tpu.types.model_bundle import ModelBundle
-from relayrl_tpu.utils import EpochLogger, setup_logger_kwargs
 
 
 class ReinforceState(struct.PyTreeNode):
@@ -159,36 +153,17 @@ def make_reinforce_update(policy, pi_lr: float, vf_lr: float,
 
 
 @register_algorithm("REINFORCE")
-class REINFORCE(AlgorithmBase):
+class REINFORCE(OnPolicyAlgorithm):
     """Host-side REINFORCE orchestration (ctor parity with
     REINFORCE.py:16-62: ``REINFORCE(env_dir, config_path, obs_dim, act_dim,
     buf_size, **hyperparam overrides)``)."""
 
-    def __init__(
-        self,
-        env_dir: str | None = None,
-        config_path: str | None = None,
-        obs_dim: int = 4,
-        act_dim: int = 2,
-        buf_size: int | None = None,
-        logger_kwargs: Mapping[str, Any] | None = None,
-        **overrides,
-    ):
-        loader = ConfigLoader("REINFORCE", config_path, create_if_missing=False)
-        params = loader.get_algorithm_params()
-        params.update(overrides)
-        learner = loader.get_learner_params()
+    ALGO_NAME = "REINFORCE"
 
-        self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
-        self.discrete = bool(params.get("discrete", True))
+    def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
         self.with_baseline = bool(params.get("with_vf_baseline", False))
-        self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
         self.gamma = float(params.get("gamma", 0.98))
         self.lam = float(params.get("lam", 0.97))
-        seed = int(params.get("seed", 1))
-        # Ref seeds `seed + 10000 * proc_id` (REINFORCE.py:40-42); fold_in is
-        # the JAX-native equivalent with better key hygiene.
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), os.getpid())
 
         self.arch = {
             "kind": "mlp_discrete" if self.discrete else "mlp_continuous",
@@ -227,74 +202,8 @@ class REINFORCE(AlgorithmBase):
             step=jnp.int32(0),
         )
 
-        self.buffer = EpochBuffer(
-            obs_dim=self.obs_dim,
-            act_dim=self.act_dim,
-            traj_per_epoch=self.traj_per_epoch,
-            discrete=self.discrete,
-            buckets=learner.get("bucket_lengths", (64, 256, 1000)),
-            max_traj_length=loader.get_max_traj_length(),
-        )
-
-        lk = dict(logger_kwargs) if logger_kwargs else setup_logger_kwargs(
-            "relayrl-reinforce", seed, data_dir=os.path.join(env_dir or ".", "logs"))
-        self.logger = EpochLogger(**lk)
-        self.logger.save_config({"algorithm": "REINFORCE", **params,
-                                 "obs_dim": obs_dim, "act_dim": act_dim})
-        self.epoch = 0
-        self._last_metrics: dict[str, float] = {}
-        self.server_model_path = loader.get_server_model_path()
-
-    # -- reference contract --
-    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
-        if not actions:
-            return False
-        ready = self.buffer.add_episode(actions)
-        if ready:
-            self.train_model()
-            self.log_epoch()
-            return True
-        return False
-
-    def train_model(self) -> Mapping[str, float]:
-        batch = self.buffer.drain()
-        device_batch = {k: jnp.asarray(v) for k, v in batch.as_dict().items()}
-        self.state, metrics = self._update(self.state, device_batch)
-        self._last_metrics = {k: float(v) for k, v in metrics.items()}
-        return self._last_metrics
-
-    def log_epoch(self) -> None:
-        rets, lens = self.buffer.pop_episode_stats()
-        self.epoch += 1
-        self.logger.store(EpRet=rets or [0.0], EpLen=lens or [0])
-        self.logger.log_tabular("Epoch", self.epoch)
-        self.logger.log_tabular("EpRet", with_min_and_max=True)
-        self.logger.log_tabular("EpLen", average_only=True)
-        for key in ("LossPi", "DeltaLossPi", "KL", "Entropy"):
-            self.logger.log_tabular(key, self._last_metrics.get(key, 0.0))
+    def _log_keys(self):
+        keys = ["LossPi", "DeltaLossPi", "KL", "Entropy"]
         if self.with_baseline:
-            self.logger.log_tabular("LossV", self._last_metrics.get("LossV", 0.0))
-            self.logger.log_tabular("DeltaLossV", self._last_metrics.get("DeltaLossV", 0.0))
-        self.logger.dump_tabular()
-
-    def save(self, path=None) -> None:
-        self.bundle().save(path or self.server_model_path)
-
-    def bundle(self) -> ModelBundle:
-        host_params = jax.device_get(self.state.params)
-        return ModelBundle(version=self.version, arch=self.arch, params=host_params)
-
-    @property
-    def version(self) -> int:
-        return int(self.state.step)
-
-    # convenience for in-process actors/tests
-    def act(self, obs, mask=None):
-        rng, self.state = self._split_rng()
-        act, aux = jax.jit(self.policy.step)(self.state.params, rng,
-                                             jnp.asarray(obs), mask)
-        return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}
-
-    def _split_rng(self):
-        rng, sub = jax.random.split(self.state.rng)
-        return sub, self.state.replace(rng=rng)
+            keys += ["LossV", "DeltaLossV"]
+        return keys

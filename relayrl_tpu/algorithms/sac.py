@@ -20,6 +20,7 @@ from flax import struct
 from relayrl_tpu.algorithms.base import register_algorithm
 from relayrl_tpu.algorithms.offpolicy import OffPolicyAlgorithm, polyak_update
 from relayrl_tpu.models import build_policy
+from relayrl_tpu.models.mlp import _compute_dtype
 from relayrl_tpu.models.q_networks import (
     SquashedGaussianActor,
     TwinQNet,
@@ -139,9 +140,10 @@ class SAC(OffPolicyAlgorithm):
         }
         self.policy = build_policy(self.arch)
         hidden = tuple(self.arch["hidden_sizes"])
+        dtype = _compute_dtype(self.arch)
         self._actor = SquashedGaussianActor(
-            act_dim=self.act_dim, hidden_sizes=hidden)
-        self._critic = TwinQNet(hidden_sizes=hidden)
+            act_dim=self.act_dim, hidden_sizes=hidden, compute_dtype=dtype)
+        self._critic = TwinQNet(hidden_sizes=hidden, compute_dtype=dtype)
 
         a_rng, c_rng, s_rng = jax.random.split(self._rng_init, 3)
         obs0 = jnp.zeros((1, self.obs_dim), jnp.float32)

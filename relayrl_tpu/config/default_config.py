@@ -116,6 +116,19 @@ DEFAULT_CONFIG: dict = {
             "traj_per_epoch": 8,
             "hidden_sizes": [128, 128],
         },
+        "IMPALA": {
+            "discrete": True,
+            "seed": 1,
+            "traj_per_epoch": 16,
+            "gamma": 0.99,
+            "lr": 3e-4,
+            "vf_coef": 0.5,
+            "ent_coef": 0.01,
+            "rho_bar": 1.0,
+            "c_bar": 1.0,
+            "max_grad_norm": 40.0,
+            "hidden_sizes": [128, 128],
+        },
         "SAC": {
             "discrete": False,
             "seed": 1,
@@ -165,7 +178,9 @@ DEFAULT_CONFIG: dict = {
 # Algorithm whitelist, matching the reference's registry
 # (config_loader.rs:397-433 lists C51/DDPG/DQN/PPO/REINFORCE/SAC/TD3 even
 # though only REINFORCE is implemented there).
-SUPPORTED_ALGORITHMS = ("C51", "DDPG", "DQN", "PPO", "REINFORCE", "SAC", "TD3")
+SUPPORTED_ALGORITHMS = (
+    "C51", "DDPG", "DQN", "IMPALA", "PPO", "REINFORCE", "SAC", "TD3",
+)
 
 
 def default_config() -> dict:

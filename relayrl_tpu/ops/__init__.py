@@ -7,6 +7,8 @@ from relayrl_tpu.ops.gae import (
     normalize_advantages,
     rewards_to_go,
 )
+from relayrl_tpu.ops.attention import blockwise_attention, dense_attention
+from relayrl_tpu.ops.vtrace import VTraceReturns, vtrace
 
 __all__ = [
     "discount_cumsum",
@@ -14,4 +16,8 @@ __all__ = [
     "masked_mean_std",
     "normalize_advantages",
     "rewards_to_go",
+    "blockwise_attention",
+    "dense_attention",
+    "VTraceReturns",
+    "vtrace",
 ]

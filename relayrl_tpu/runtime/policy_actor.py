@@ -24,7 +24,11 @@ import numpy as np
 
 from relayrl_tpu.models import build_policy, validate_policy
 from relayrl_tpu.types.action import ActionRecord
-from relayrl_tpu.types.model_bundle import ModelBundle, arch_equal
+from relayrl_tpu.types.model_bundle import (
+    ModelBundle,
+    arch_equal,
+    exploration_kwargs,
+)
 from relayrl_tpu.types.trajectory import Trajectory
 
 
@@ -47,19 +51,9 @@ class PolicyActor:
         self.params = bundle.params
         self.version = bundle.version
         self._step_fn = jax.jit(self.policy.step)
-        self._explore_kwargs = self._explore_from_arch(self.arch)
+        self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
-
-    @staticmethod
-    def _explore_from_arch(arch: dict) -> dict:
-        """Exploration knobs present in the arch, as device scalars passed
-        to ``step`` per call — traced arguments, so the learner annealing
-        them across publishes never triggers a retrace."""
-        from relayrl_tpu.types.model_bundle import EXPLORATION_ARCH_KEYS
-
-        return {k: jnp.float32(arch[k]) for k in EXPLORATION_ARCH_KEYS
-                if k in arch}
 
     # -- reference API (agent_zmq.rs:458-571 / o3_agent.rs:117-182) --
     def request_for_action(
@@ -117,7 +111,7 @@ class PolicyActor:
                 # traced step arguments, so only the scalar values refresh —
                 # no policy rebuild, no retrace.
                 self.arch = dict(bundle.arch)
-                self._explore_kwargs = self._explore_from_arch(self.arch)
+                self._explore_kwargs = exploration_kwargs(self.arch)
             self.params = bundle.params
             self.version = bundle.version
         return True

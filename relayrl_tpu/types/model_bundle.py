@@ -89,6 +89,16 @@ class ModelBundle:
 EXPLORATION_ARCH_KEYS = frozenset({"epsilon", "act_noise"})
 
 
+def exploration_kwargs(arch: Mapping[str, Any]) -> dict[str, Any]:
+    """Exploration knobs present in ``arch`` as device scalars, to pass as
+    traced ``step`` kwargs — the single construction both in-process actors
+    and the networked PolicyActor use, so annealing a knob never retraces."""
+    import jax.numpy as jnp
+
+    return {k: jnp.float32(arch[k]) for k in EXPLORATION_ARCH_KEYS
+            if k in arch}
+
+
 def arch_equal(a: Mapping[str, Any], b: Mapping[str, Any]) -> bool:
     """Structural arch-config equality — the actor refuses a hot-swap whose
     arch differs from the one it validated at handshake (param-ABI guard,

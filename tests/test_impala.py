@@ -1,0 +1,141 @@
+"""V-trace op + IMPALA learner tests.
+
+Key invariant: with behavior == target and rho_bar, c_bar >= 1, the V-trace
+recursion telescopes to the on-policy n-step return — that anchors the op
+against ops.gae.rewards_to_go. Off-policy behavior is checked via ratio
+clipping and staleness tolerance (training on trajectories produced by an
+older model version).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import IMPALA, build_algorithm, registered_algorithms
+from relayrl_tpu.ops import rewards_to_go, vtrace
+from relayrl_tpu.types.action import ActionRecord
+
+B, T = 3, 12
+
+
+def _batch(seed=0, lengths=(12, 7, 10)):
+    rng = np.random.default_rng(seed)
+    valid = np.zeros((B, T), np.float32)
+    for i, n in enumerate(lengths):
+        valid[i, :n] = 1.0
+    return {
+        "behavior_logp": rng.uniform(-2, -0.5, (B, T)).astype(np.float32) * valid,
+        "rew": rng.standard_normal((B, T)).astype(np.float32) * valid,
+        "val": rng.standard_normal((B, T)).astype(np.float32) * valid,
+        "valid": valid,
+        "last_val": rng.standard_normal(B).astype(np.float32),
+    }
+
+
+class TestVTrace:
+    def test_on_policy_telescopes_to_nstep_return(self):
+        b = _batch()
+        out = vtrace(
+            jnp.asarray(b["behavior_logp"]), jnp.asarray(b["behavior_logp"]),
+            jnp.asarray(b["rew"]), jnp.asarray(b["val"]),
+            jnp.asarray(b["valid"]), gamma=0.9,
+            last_val=jnp.asarray(b["last_val"]))
+        # Expected: discounted rewards-to-go + gamma^(L-t) * last_val.
+        rtg = rewards_to_go(jnp.asarray(b["rew"]), jnp.asarray(b["valid"]), 0.9)
+        lengths = b["valid"].sum(-1).astype(int)
+        boot = np.zeros((B, T), np.float32)
+        for i, L in enumerate(lengths):
+            for t in range(L):
+                boot[i, t] = 0.9 ** (L - t) * b["last_val"][i]
+        np.testing.assert_allclose(
+            np.asarray(out.vs), np.asarray(rtg) + boot, rtol=1e-4, atol=1e-5)
+
+    def test_rho_clipped(self):
+        b = _batch(1)
+        target = b["behavior_logp"] + 3.0  # ratio e^3 >> rho_bar
+        out = vtrace(
+            jnp.asarray(b["behavior_logp"]), jnp.asarray(target),
+            jnp.asarray(b["rew"]), jnp.asarray(b["val"]),
+            jnp.asarray(b["valid"]), gamma=0.9, rho_bar=1.0, c_bar=1.0)
+        assert float(jnp.max(out.rho)) <= 1.0 + 1e-6
+
+    def test_zero_ratio_kills_corrections(self):
+        """target far below behavior => rho ~ 0 => vs collapses to val."""
+        b = _batch(2)
+        target = b["behavior_logp"] - 20.0
+        out = vtrace(
+            jnp.asarray(b["behavior_logp"]), jnp.asarray(target),
+            jnp.asarray(b["rew"]), jnp.asarray(b["val"]),
+            jnp.asarray(b["valid"]), gamma=0.9)
+        np.testing.assert_allclose(
+            np.asarray(out.vs), b["val"] * b["valid"], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out.pg_adv), 0.0, atol=1e-4)
+
+    def test_padding_untouched(self):
+        b = _batch(3)
+        out = vtrace(
+            jnp.asarray(b["behavior_logp"]), jnp.asarray(b["behavior_logp"]),
+            jnp.asarray(b["rew"]), jnp.asarray(b["val"]),
+            jnp.asarray(b["valid"]), gamma=0.95)
+        pad = b["valid"] == 0
+        assert np.all(np.asarray(out.vs)[pad] == 0)
+        assert np.all(np.asarray(out.pg_adv)[pad] == 0)
+
+
+def _episode(policy_bias, n=10, obs_dim=4, act_dim=2, seed=0):
+    """Behavior data from a fake stale policy: logp reflects policy_bias."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        act = int(rng.random() < policy_bias)
+        logp = np.log(policy_bias if act == 1 else 1 - policy_bias)
+        recs.append(ActionRecord(
+            obs=rng.standard_normal(obs_dim).astype(np.float32),
+            act=np.int64(act),
+            rew=1.0 if act == 1 else 0.0,
+            data={"logp_a": np.float32(logp), "v": np.float32(0.0)},
+            done=(i == n - 1)))
+    return recs
+
+
+class TestImpala:
+    def test_registered(self):
+        assert "IMPALA" in registered_algorithms()
+
+    def test_trains_and_versions(self, tmp_cwd):
+        algo = build_algorithm(
+            "IMPALA", obs_dim=4, act_dim=2, traj_per_epoch=2,
+            hidden_sizes=[16], env_dir=str(tmp_cwd),
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        assert algo.receive_trajectory(_episode(0.5, seed=1)) is False
+        assert algo.receive_trajectory(_episode(0.5, seed=2)) is True
+        assert algo.version == 1
+        for key in ("LossPi", "LossV", "RhoMean", "KL"):
+            assert key in algo._last_metrics
+
+    def test_learns_from_stale_behavior(self, tmp_cwd):
+        """Trajectories from a biased stale policy (70% action 0) where
+        action 1 pays: the learner must still shift toward action 1."""
+        algo = build_algorithm(
+            "IMPALA", obs_dim=4, act_dim=2, traj_per_epoch=4,
+            hidden_sizes=[32], lr=1e-2, ent_coef=0.0, env_dir=str(tmp_cwd),
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        for s in range(160):
+            algo.receive_trajectory(_episode(0.3, n=12, seed=s))
+        obs = np.random.default_rng(5).standard_normal((16, 4)).astype(
+            np.float32)
+        logp, _, _ = jax.jit(algo.policy.evaluate)(
+            algo.state.params, jnp.asarray(obs),
+            jnp.ones((16,), jnp.int32))
+        # P(action 1) should now dominate.
+        assert float(jnp.exp(logp).mean()) > 0.6
+
+    def test_rho_mean_below_one_for_stale_data(self, tmp_cwd):
+        algo = build_algorithm(
+            "IMPALA", obs_dim=4, act_dim=2, traj_per_epoch=2,
+            hidden_sizes=[16], env_dir=str(tmp_cwd),
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        for s in range(4):
+            algo.receive_trajectory(_episode(0.9, seed=s))
+        assert 0.0 < algo._last_metrics["RhoMean"] <= 1.0 + 1e-6
