@@ -1,0 +1,67 @@
+"""Codec round-trip bench: tensor sizes x dtypes (+ action/trajectory wire).
+
+Mirrors the reference's runtime_benchmarks.rs:18-80 shape (safetensors
+round-trips over sizes {1..10000} x 7 dtypes; that bench is disabled in its
+Cargo.toml — BASELINE.md) plus the pickle/proto trajectory codecs the
+network path uses (types/trajectory.rs:50-55, sys_utils/grpc_utils.rs).
+"""
+
+import numpy as np
+
+from common import emit, quick, setup_platform, time_fn
+
+setup_platform()
+
+from relayrl_tpu.types.action import ActionRecord  # noqa: E402
+from relayrl_tpu.types.tensor import decode_tensor, encode_tensor  # noqa: E402
+from relayrl_tpu.types.trajectory import Trajectory  # noqa: E402
+
+SIZES = [1, 100, 10_000] if quick() else [1, 10, 100, 1000, 10_000]
+# The reference's 7 DTypes (action.rs:92-191): Byte/Short/Int/Long/Float/
+# Double/Bool -> numpy equivalents.
+DTYPES = ["uint8", "int16", "int32", "int64", "float32", "float64", "bool"]
+
+
+def bench_tensor_codec():
+    for dtype in DTYPES:
+        for size in SIZES:
+            rng = np.random.default_rng(0)
+            if dtype == "bool":
+                arr = rng.random(size) > 0.5
+            else:
+                arr = rng.standard_normal(size).astype(dtype) if "float" in dtype \
+                    else rng.integers(0, 100, size).astype(dtype)
+
+            def roundtrip():
+                out = decode_tensor(encode_tensor(arr))
+                assert out.shape == arr.shape
+
+            t = time_fn(roundtrip, warmup=2, iters=50)
+            emit("codec_tensor_roundtrip", {"dtype": dtype, "size": size},
+                 t["median_s"] * 1e6, "us")
+
+
+def bench_trajectory_codec():
+    for n in ([10, 100] if quick() else [10, 50, 100, 250, 500, 1000]):
+        rng = np.random.default_rng(0)
+        traj = Trajectory(max_length=n + 1)
+        for i in range(n):
+            traj.add_action(ActionRecord(
+                obs=rng.standard_normal(8).astype(np.float32),
+                act=np.int64(1), rew=1.0,
+                data={"logp_a": np.float32(-0.7), "v": np.float32(0.1)},
+                done=False), send_if_done=False)
+
+        def roundtrip():
+            buf = traj.to_bytes()
+            out = Trajectory.from_bytes(buf)
+            assert len(out) == n
+
+        t = time_fn(roundtrip, warmup=2, iters=30)
+        emit("codec_trajectory_roundtrip", {"actions": n},
+             t["median_s"] * 1e3, "ms")
+
+
+if __name__ == "__main__":
+    bench_tensor_codec()
+    bench_trajectory_codec()

@@ -1,0 +1,60 @@
+"""Agent inference latency: per-``request_for_action`` cost.
+
+Mirrors the reference's inference bench (network_benchmarks.rs:24-123 —
+TorchScript ``step`` per call on the agent's local model). Here it's the
+jitted policy apply + ActionRecord assembly of PolicyActor, per model
+family — the per-step cost model of SURVEY.md §3.2.
+"""
+
+import numpy as np
+
+from common import emit, quick, setup_platform, time_fn
+
+setup_platform()
+
+import jax  # noqa: E402
+
+from relayrl_tpu.models import build_policy  # noqa: E402
+from relayrl_tpu.runtime.policy_actor import PolicyActor  # noqa: E402
+from relayrl_tpu.types.model_bundle import ModelBundle  # noqa: E402
+
+ARCHS = {
+    "mlp_2x128": {"kind": "mlp_discrete", "obs_dim": 8, "act_dim": 4,
+                  "hidden_sizes": [128, 128], "has_critic": True},
+    "mlp_2x256": {"kind": "mlp_discrete", "obs_dim": 128, "act_dim": 18,
+                  "hidden_sizes": [256, 256], "has_critic": True},
+    "qnet": {"kind": "qnet_discrete", "obs_dim": 8, "act_dim": 4,
+             "hidden_sizes": [128, 128], "epsilon": 0.05},
+    "sac": {"kind": "sac_continuous", "obs_dim": 17, "act_dim": 6,
+            "hidden_sizes": [256, 256], "act_limit": 1.0},
+    "transformer_t64": {"kind": "transformer_discrete", "obs_dim": 8,
+                        "act_dim": 4, "d_model": 64, "n_layers": 2,
+                        "n_heads": 4, "max_seq_len": 64},
+}
+
+
+def main():
+    names = list(ARCHS) if not quick() else ["mlp_2x128", "qnet"]
+    for name in names:
+        arch = ARCHS[name]
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        actor = PolicyActor(
+            ModelBundle(version=1, arch=arch, params=params),
+            max_traj_length=10_000)
+        if name.startswith("transformer"):
+            obs = np.zeros((16, arch["obs_dim"]), np.float32)  # 16-step ctx
+        else:
+            obs = np.zeros((arch["obs_dim"],), np.float32)
+
+        def step():
+            actor.request_for_action(obs)
+
+        t = time_fn(step, warmup=5, iters=200 if quick() else 1000)
+        emit("agent_inference", {"model": name}, t["median_s"] * 1e6, "us")
+        emit("agent_inference_throughput", {"model": name},
+             1.0 / t["mean_s"], "steps/s")
+
+
+if __name__ == "__main__":
+    main()

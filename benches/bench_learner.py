@@ -1,0 +1,132 @@
+"""Learner update throughput per algorithm (steps/s of the jitted update).
+
+The reference publishes no learner numbers (BASELINE.md); its learner is a
+single serialized stdio pipe into CPU torch. This bench times each
+algorithm's pure jitted update on fixed batches — the number that scales
+with chips. Runs on CPU by default; RELAYRL_BENCH_TPU=1 to target the real
+chip (the root bench.py is the recorded headline).
+"""
+
+import numpy as np
+
+from common import emit, quick, setup_platform, time_fn
+
+setup_platform()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def onpolicy_batch(B, T, obs_dim, act_dim, rng):
+    return {
+        "obs": rng.standard_normal((B, T, obs_dim)).astype(np.float32),
+        "act": rng.integers(0, act_dim, (B, T)).astype(np.int32),
+        "act_mask": np.ones((B, T, act_dim), np.float32),
+        "rew": rng.standard_normal((B, T)).astype(np.float32),
+        "val": np.zeros((B, T), np.float32),
+        "logp": np.full((B, T), -1.0, np.float32),
+        "valid": np.ones((B, T), np.float32),
+        "last_val": np.zeros((B,), np.float32),
+    }
+
+
+def offpolicy_batch(B, obs_dim, act_dim, discrete, rng):
+    return {
+        "obs": rng.standard_normal((B, obs_dim)).astype(np.float32),
+        "act": (rng.integers(0, act_dim, B).astype(np.int32) if discrete
+                else rng.uniform(-1, 1, (B, act_dim)).astype(np.float32)),
+        "rew": rng.standard_normal(B).astype(np.float32),
+        "obs2": rng.standard_normal((B, obs_dim)).astype(np.float32),
+        "mask2": np.ones((B, act_dim), np.float32),
+        "done": (rng.random(B) < 0.05).astype(np.float32),
+    }
+
+
+def bench_algo(name, make_state_update, batch):
+    state, update = make_state_update()
+    jitted = jax.jit(update)
+    device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+
+    def step():
+        nonlocal state
+        state, metrics = jitted(state, device_batch)
+        jax.block_until_ready(metrics)
+
+    t = time_fn(step, warmup=3, iters=10 if quick() else 30)
+    emit("learner_update", {"algorithm": name}, 1.0 / t["mean_s"], "updates/s")
+
+
+def main():
+    from relayrl_tpu.algorithms.reinforce import (
+        ReinforceState, make_optimizers, make_reinforce_update)
+    from relayrl_tpu.algorithms.dqn import DQNState, make_dqn_update
+    from relayrl_tpu.algorithms.sac import SACState, make_sac_update
+    from relayrl_tpu.algorithms.impala import ImpalaState, make_impala_update
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.models.q_networks import (
+        DiscreteQNet, SquashedGaussianActor, TwinQNet)
+    import optax
+
+    rng = np.random.default_rng(0)
+    B, T, OBS, ACT = 64, 128, 32, 8
+
+    def mk_reinforce():
+        arch = {"kind": "mlp_discrete", "obs_dim": OBS, "act_dim": ACT,
+                "hidden_sizes": [128, 128], "has_critic": True}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        tx_pi, tx_vf = make_optimizers(params, 3e-4, 1e-3)
+        state = ReinforceState(params=params, pi_opt_state=tx_pi.init(params),
+                               vf_opt_state=tx_vf.init(params),
+                               rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+        update = make_reinforce_update(policy, 3e-4, 1e-3, 20, 0.99, 0.95, True)
+        return state, update
+
+    def mk_impala():
+        arch = {"kind": "mlp_discrete", "obs_dim": OBS, "act_dim": ACT,
+                "hidden_sizes": [128, 128], "has_critic": True}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        tx = optax.chain(optax.clip_by_global_norm(40.0), optax.adam(3e-4))
+        state = ImpalaState(params=params, opt_state=tx.init(params),
+                            rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+        update = make_impala_update(policy, 3e-4, 0.99, 0.5, 0.01, 1.0, 1.0,
+                                    40.0)
+        return state, update
+
+    def mk_dqn():
+        module = DiscreteQNet(act_dim=ACT, hidden_sizes=(128, 128))
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, OBS), jnp.float32))
+        tx = optax.adam(1e-3)
+        state = DQNState(params=params,
+                         target_params=jax.tree.map(jnp.copy, params),
+                         opt_state=tx.init(params), step=jnp.int32(0))
+        return state, make_dqn_update(module, 0.99, 1e-3, 0.995, True)
+
+    def mk_sac():
+        actor = SquashedGaussianActor(act_dim=ACT, hidden_sizes=(128, 128))
+        critic = TwinQNet(hidden_sizes=(128, 128))
+        a = actor.init(jax.random.PRNGKey(0), jnp.zeros((1, OBS)))
+        c = critic.init(jax.random.PRNGKey(1), jnp.zeros((1, OBS)),
+                        jnp.zeros((1, ACT)))
+        log_alpha = jnp.float32(np.log(0.2))
+        state = SACState(
+            actor_params=a, critic_params=c,
+            target_critic_params=jax.tree.map(jnp.copy, c),
+            log_alpha=log_alpha,
+            actor_opt_state=optax.adam(3e-4).init(a),
+            critic_opt_state=optax.adam(3e-4).init(c),
+            alpha_opt_state=optax.adam(3e-4).init(log_alpha),
+            rng=jax.random.PRNGKey(2), step=jnp.int32(0))
+        return state, make_sac_update(actor, critic, 1.0, 0.99, 3e-4, 3e-4,
+                                      3e-4, 0.995, -float(ACT))
+
+    bench_algo("REINFORCE", mk_reinforce, onpolicy_batch(B, T, OBS, ACT, rng))
+    bench_algo("IMPALA", mk_impala, onpolicy_batch(B, T, OBS, ACT, rng))
+    bench_algo("DQN", mk_dqn, offpolicy_batch(256, OBS, ACT, True, rng))
+    bench_algo("SAC", mk_sac, offpolicy_batch(256, OBS, ACT, False, rng))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Round-trip latency vs trajectory size, per transport.
+
+Mirrors network_benchmarks.rs:127-274: stand up a real TrainingServer +
+Agent on localhost, drive one trajectory of N actions, and time from episode
+start to the *model update arriving back at the agent* (the full loop of
+SURVEY.md §3.3: trajectory -> learner step -> publish -> hot-swap).
+The reference's loops poll sockets on a 50 ms sleep cadence
+(training_zmq.rs:860,1053) putting a hard floor under its latency; this
+framework's transports block on epoll/recv, so the floor is the learner
+step itself.
+"""
+
+import time
+
+import numpy as np
+
+from common import bench_cwd, emit, free_port, quick, setup_platform, time_fn
+
+setup_platform()
+
+from relayrl_tpu.runtime.agent import Agent  # noqa: E402
+from relayrl_tpu.runtime.server import TrainingServer  # noqa: E402
+
+TRAJ_SIZES = [10, 100] if quick() else [10, 50, 100, 250, 500, 1000]
+
+
+def run_transport(server_type: str):
+    if server_type == "zmq":
+        server_addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        agent_addrs = {
+            "agent_listener_addr": server_addrs["agent_listener_addr"],
+            "trajectory_addr": server_addrs["trajectory_addr"],
+            "model_sub_addr": server_addrs["model_pub_addr"],
+        }
+    else:
+        port = free_port()
+        server_addrs = {"bind_addr": f"127.0.0.1:{port}"}
+        agent_addrs = {"server_addr": f"127.0.0.1:{port}"}
+
+    server = TrainingServer(
+        "REINFORCE", obs_dim=8, act_dim=4, server_type=server_type,
+        env_dir=".",
+        hyperparams={"traj_per_epoch": 1, "hidden_sizes": [64],
+                     "with_vf_baseline": False, "train_vf_iters": 1},
+        **server_addrs)
+    agent = Agent(server_type=server_type, **agent_addrs)
+    rng = np.random.default_rng(0)
+
+    try:
+        for n in TRAJ_SIZES:
+            def roundtrip():
+                v0 = agent.model_version
+                rew = 0.0
+                for _ in range(n):
+                    agent.request_for_action(
+                        rng.standard_normal(8).astype(np.float32), reward=rew)
+                    rew = 1.0
+                agent.flag_last_action(rew)
+                deadline = time.time() + 30
+                while agent.model_version == v0:
+                    if time.time() > deadline:
+                        raise TimeoutError("model update never arrived")
+                    time.sleep(0.0005)
+
+            t = time_fn(roundtrip, warmup=2, iters=5 if quick() else 15)
+            emit("roundtrip_latency",
+                 {"transport": server_type, "traj_size": n},
+                 t["median_s"] * 1e3, "ms")
+    finally:
+        agent.disable_agent()
+        server.disable_server()
+
+
+if __name__ == "__main__":
+    bench_cwd()
+    transports = ["zmq"] if quick() else ["zmq", "grpc"]
+    try:
+        from relayrl_tpu.transport.native_backend import native_available
+        if not quick() and native_available():
+            transports.append("native")
+    except Exception:
+        pass
+    for t in transports:
+        run_transport(t)

@@ -1,0 +1,82 @@
+"""Sustained throughput vs inter-action wait x trajectory size.
+
+Mirrors network_benchmarks.rs:278-443 (throughput over action intervals
+{25..1000} ms). Real RL actors are env-bound, so the bench injects an
+artificial per-action delay and measures achieved env-steps/s end-to-end
+through a live server+agent pair, including trajectory sends and model
+hot-swaps. The interesting number is how close achieved steps/s gets to
+the 1/wait ceiling — transport+learner overhead is the gap.
+"""
+
+import time
+
+import numpy as np
+
+from common import bench_cwd, emit, free_port, quick, setup_platform
+
+setup_platform()
+
+from relayrl_tpu.runtime.agent import Agent  # noqa: E402
+from relayrl_tpu.runtime.server import TrainingServer  # noqa: E402
+
+WAITS_MS = [0, 25] if quick() else [0, 5, 25, 100]
+TRAJ_SIZE = 50
+EPISODES = 3 if quick() else 10
+
+
+def main():
+    server_addrs = {
+        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+    }
+    server = TrainingServer(
+        "REINFORCE", obs_dim=8, act_dim=4, server_type="zmq", env_dir=".",
+        hyperparams={"traj_per_epoch": 2, "hidden_sizes": [64],
+                     "with_vf_baseline": False, "train_vf_iters": 1},
+        **server_addrs)
+    agent = Agent(
+        server_type="zmq",
+        agent_listener_addr=server_addrs["agent_listener_addr"],
+        trajectory_addr=server_addrs["trajectory_addr"],
+        model_sub_addr=server_addrs["model_pub_addr"])
+    rng = np.random.default_rng(0)
+
+    try:
+        for wait_ms in WAITS_MS:
+            # warmup episode
+            for _ in range(TRAJ_SIZE):
+                agent.request_for_action(
+                    rng.standard_normal(8).astype(np.float32))
+            agent.flag_last_action(1.0)
+
+            steps = 0
+            t0 = time.perf_counter()
+            for _ in range(EPISODES):
+                rew = 0.0
+                for _ in range(TRAJ_SIZE):
+                    agent.request_for_action(
+                        rng.standard_normal(8).astype(np.float32), reward=rew)
+                    rew = 1.0
+                    steps += 1
+                    if wait_ms:
+                        time.sleep(wait_ms / 1e3)
+                agent.flag_last_action(rew)
+            elapsed = time.perf_counter() - t0
+            achieved = steps / elapsed
+            ceiling = 1e3 / wait_ms if wait_ms else float("inf")
+            emit("actor_throughput",
+                 {"wait_ms": wait_ms, "traj_size": TRAJ_SIZE},
+                 achieved, "env-steps/s")
+            if wait_ms:
+                emit("actor_throughput_efficiency",
+                     {"wait_ms": wait_ms, "traj_size": TRAJ_SIZE},
+                     achieved / ceiling, "fraction-of-ceiling")
+    finally:
+        agent.disable_agent()
+        server.disable_server()
+
+
+if __name__ == "__main__":
+    bench_cwd()
+    main()

@@ -1,0 +1,84 @@
+"""Shared bench harness utilities.
+
+The reference's bench suite is criterion (relayrl_framework/benches/
+network_benchmarks.rs, runtime_benchmarks.rs); these scripts reproduce its
+measurement *shapes* (BASELINE.md) as standalone Python programs. Every
+bench prints one JSON line per configuration:
+
+    {"bench": ..., "config": {...}, "value": N, "unit": ...}
+
+Run any file directly; ``--quick`` shrinks the grid for smoke runs.
+All benches force CPU JAX unless RELAYRL_BENCH_TPU=1 (the headline
+``bench.py`` at the repo root owns the real chip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import statistics
+import sys
+import time
+
+
+def setup_platform() -> None:
+    """Pick the JAX platform BEFORE jax initializes. Forced (not
+    setdefault): the ambient environment may point JAX_PLATFORMS at a
+    tunneled TPU backend that only the headline bench should use."""
+    if os.environ.get("RELAYRL_BENCH_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def quick() -> bool:
+    return "--quick" in sys.argv
+
+
+def bench_cwd() -> str:
+    """Chdir into a throwaway dir with checkpointing disabled, so timed
+    samples exclude orbax/model-file saves and no artifacts land in the
+    repo (config auto-create + server model writes go to cwd)."""
+    import tempfile
+
+    from relayrl_tpu.config import default_config
+
+    d = tempfile.mkdtemp(prefix="relayrl_bench_")
+    cfg = default_config()
+    cfg["learner"]["checkpoint_dir"] = ""
+    cfg["learner"]["checkpoint_every_epochs"] = 1_000_000
+    with open(os.path.join(d, "relayrl_config.json"), "w") as f:
+        json.dump(cfg, f)
+    os.chdir(d)
+    return d
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def emit(bench: str, config: dict, value: float, unit: str) -> None:
+    print(json.dumps({"bench": bench, "config": config,
+                      "value": round(value, 6), "unit": unit}), flush=True)
+
+
+def time_fn(fn, warmup: int = 3, iters: int = 20) -> dict:
+    """Median/mean/p99 wall time of ``fn()`` in seconds."""
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    import math
+
+    ordered = sorted(samples)
+    # Correct order statistic: ceil(0.99 n) - 1 — the max for n < 100.
+    p99_idx = min(len(ordered) - 1, math.ceil(0.99 * len(ordered)) - 1)
+    return {
+        "median_s": statistics.median(samples),
+        "mean_s": statistics.fmean(samples),
+        "p99_s": ordered[p99_idx],
+    }

@@ -172,6 +172,15 @@ DEFAULT_CONFIG: dict = {
         "precision": "float32",
         "checkpoint_dir": "checkpoints",
         "checkpoint_every_epochs": 10,
+        # multi-host learner bring-up (jax.distributed); single-process when
+        # coordinator is null. Env overrides: RELAYRL_COORDINATOR,
+        # RELAYRL_NUM_PROCESSES. The per-host rank is deliberately NOT a
+        # config key (configs are shared between hosts): set
+        # RELAYRL_PROCESS_ID per host or pass process_id= explicitly.
+        "distributed": {
+            "coordinator": None,
+            "num_processes": 1,
+        },
     },
 }
 

@@ -27,6 +27,10 @@ from relayrl_tpu.parallel.learner import (
     place_state,
 )
 from relayrl_tpu.parallel.context import current_mesh, use_mesh
+from relayrl_tpu.parallel.distributed import (
+    initialize_distributed,
+    is_coordinator,
+)
 from relayrl_tpu.parallel.ring import (
     make_ring_attention,
     ring_attention_sharded,
@@ -50,6 +54,8 @@ __all__ = [
     "place_state",
     "current_mesh",
     "use_mesh",
+    "initialize_distributed",
+    "is_coordinator",
     "make_ring_attention",
     "ring_attention_sharded",
 ]

@@ -53,6 +53,17 @@ class TrainingServer:
         self.server_type = server_type
         self._addr_overrides = addr_overrides
 
+        # Multi-host bring-up must precede any other JAX use (no-op for the
+        # default single-host config; RELAYRL_COORDINATOR etc. override).
+        from relayrl_tpu.parallel.distributed import initialize_distributed
+
+        self.distributed_info = initialize_distributed(
+            config=self.config.get_learner_params())
+        if self.distributed_info["multi_host"]:
+            print(f"[TrainingServer] multi-host learner: process "
+                  f"{self.distributed_info['process_id']}/"
+                  f"{self.distributed_info['num_processes']}", flush=True)
+
         if algorithm_dir:
             _load_plugin_algorithms(algorithm_dir)
         # Reference parity: hyperparams may arrive as a dict or as

@@ -7,6 +7,12 @@ from relayrl_tpu.utils.logger import (
     setup_logger_kwargs,
     statistics_scalar,
 )
+from relayrl_tpu.utils.profiling import (
+    annotate,
+    start_trace_server,
+    timed,
+    trace,
+)
 
 __all__ = [
     "EpochLogger",
@@ -14,4 +20,8 @@ __all__ = [
     "colorize",
     "setup_logger_kwargs",
     "statistics_scalar",
+    "annotate",
+    "start_trace_server",
+    "timed",
+    "trace",
 ]

@@ -1,0 +1,30 @@
+"""Smoke tests: bench scripts emit well-formed JSON lines in --quick mode.
+
+Only the cheap benches run here (codec); the socket/learner benches are
+exercised manually and by the driver — this guards the harness contract
+(one JSON object per line with bench/config/value/unit keys).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+BENCH_DIR = Path(__file__).resolve().parent.parent / "benches"
+
+
+def test_bench_codec_quick_emits_json(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(BENCH_DIR / "bench_codec.py"), "--quick"],
+        capture_output=True, text=True, timeout=240,
+        cwd=tmp_path,
+        env={"PYTHONPATH": f"{BENCH_DIR.parent}:{BENCH_DIR}",
+             "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) >= 7 * 3 + 2  # dtypes x sizes + trajectory rows
+    for line in lines:
+        rec = json.loads(line)
+        assert set(rec) == {"bench", "config", "value", "unit"}
+        assert rec["value"] > 0
