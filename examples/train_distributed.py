@@ -1,0 +1,139 @@
+"""The full distributed loop: TrainingServer + N actor processes.
+
+Covers the reference's whole example matrix (12 notebooks: REINFORCE
+with/without baseline x envs x zmq/grpc — reference: examples/ tree) from
+one driver, and extends it to every registered algorithm and the native
+C++ transport:
+
+    # reference cartpole_zmq.ipynb equivalent
+    python examples/train_distributed.py --algo REINFORCE --baseline \
+        --env cartpole --transport zmq --episodes 300
+
+    # IMPALA-style async fleet (BASELINE.md north-star shape, scaled down)
+    python examples/train_distributed.py --algo IMPALA --env cartpole \
+        --actors 8 --episodes 100
+
+    # off-policy continuous control over gRPC
+    python examples/train_distributed.py --algo SAC --env pendulum \
+        --transport grpc --episodes 100
+
+Actors are OS processes (like the reference's separate agent processes),
+each with its own policy copy, streaming trajectories to the one server and
+hot-swapping on every publish.
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+import socket
+import time
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
+               episodes: int, max_steps: int, queue):
+    os.environ["JAX_PLATFORMS"] = "cpu"  # actors are CPU hosts
+    from relayrl_tpu.envs import make
+    from relayrl_tpu.runtime.agent import Agent, run_gym_loop
+
+    agent = Agent(server_type=server_type, seed=idx, **agent_addrs)
+    env = make({"cartpole": "CartPole-v1",
+                "pendulum": "Pendulum-v1"}[env_id])
+    returns = run_gym_loop(agent, env, episodes=episodes, max_steps=max_steps)
+    queue.put((idx, returns, agent.model_version))
+    agent.disable_agent()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", default="REINFORCE")
+    ap.add_argument("--env", default="cartpole",
+                    choices=["cartpole", "pendulum"])
+    ap.add_argument("--transport", default="zmq",
+                    choices=["zmq", "grpc", "native"])
+    ap.add_argument("--actors", type=int, default=1)
+    ap.add_argument("--episodes", type=int, default=200,
+                    help="episodes PER actor")
+    ap.add_argument("--max-steps", type=int, default=500)
+    ap.add_argument("--baseline", action="store_true")
+    ap.add_argument("--tensorboard", action="store_true")
+    args = ap.parse_args()
+
+    if os.environ.get("RELAYRL_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    if args.transport == "zmq":
+        server_addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        agent_addrs = {
+            "agent_listener_addr": server_addrs["agent_listener_addr"],
+            "trajectory_addr": server_addrs["trajectory_addr"],
+            "model_sub_addr": server_addrs["model_pub_addr"],
+        }
+    else:
+        port = free_port()
+        server_addrs = {"bind_addr": f"127.0.0.1:{port}"}
+        agent_addrs = {"server_addr": f"127.0.0.1:{port}"}
+
+    hp: dict = {}
+    if args.algo.upper() == "REINFORCE":
+        hp["with_vf_baseline"] = args.baseline
+    if args.env == "pendulum":
+        hp["discrete"] = False
+        hp["act_limit"] = 2.0
+
+    env_dims = {"cartpole": (4, 2), "pendulum": (3, 1)}
+    obs_dim, act_dim = env_dims[args.env]
+
+    server = TrainingServer(
+        args.algo, obs_dim=obs_dim, act_dim=act_dim,
+        server_type=args.transport, env_dir=".",
+        tensorboard=args.tensorboard, hyperparams=hp, **server_addrs)
+
+    ctx = mp.get_context("spawn")
+    queue = ctx.Queue()
+    procs = [
+        ctx.Process(target=actor_proc,
+                    args=(i, args.transport, agent_addrs, args.env,
+                          args.episodes, args.max_steps, queue))
+        for i in range(args.actors)
+    ]
+    t0 = time.time()
+    for p in procs:
+        p.start()
+    results = [queue.get() for _ in procs]
+    for p in procs:
+        p.join()
+    elapsed = time.time() - t0
+
+    # Actors just finished: wait for the last episodes to arrive off the
+    # sockets, then drain the learner.
+    total_expected = args.actors * args.episodes
+    deadline = time.time() + 10
+    while (server.stats["trajectories"] < total_expected
+           and time.time() < deadline):
+        time.sleep(0.05)
+    server.drain()
+    total_eps = sum(len(r) for _, r, _ in results)
+    last = [r[-1] for _, r, _ in sorted(results)]
+    print(f"\n[distributed] {args.actors} actor(s) x {args.episodes} eps in "
+          f"{elapsed:.1f}s ({total_eps / elapsed:.1f} eps/s); final returns "
+          f"per actor: {[round(x, 1) for x in last]}; server version "
+          f"{server.algorithm.version}", flush=True)
+    server.disable_server()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Minimum end-to-end slice: in-process actor + jitted learner, no sockets.
+
+Equivalent of the reference's single-kernel notebook loop
+(reference: examples/README.md:125-152 — request_for_action -> env.step ->
+flag_last_action) with the network replaced by the in-memory wire codec.
+
+    python examples/train_local.py --algo REINFORCE --env cartpole \
+        --baseline --updates 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+if os.environ.get("RELAYRL_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"  # CPU by default; RELAYRL_TPU=1 for the chip
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", default="REINFORCE",
+                    help="any registered algorithm (REINFORCE/PPO/IMPALA/"
+                         "DQN/C51 for cartpole; DDPG/TD3/SAC for pendulum)")
+    ap.add_argument("--env", default="cartpole",
+                    choices=["cartpole", "pendulum"])
+    ap.add_argument("--baseline", action="store_true",
+                    help="REINFORCE: add the value baseline")
+    ap.add_argument("--updates", type=int, default=40)
+    ap.add_argument("--target", type=float, default=None,
+                    help="stop early once the rolling avg return passes this")
+    args = ap.parse_args()
+
+    from relayrl_tpu.envs import make
+    from relayrl_tpu.runtime.local_runner import LocalRunner
+
+    hp = {}
+    if args.algo.upper() == "REINFORCE":
+        hp["with_vf_baseline"] = args.baseline
+    if args.env == "pendulum":
+        hp.setdefault("discrete", False)
+        hp.setdefault("act_limit", 2.0)
+
+    env_ids = {"cartpole": "CartPole-v1", "pendulum": "Pendulum-v1"}
+    runner = LocalRunner(make(env_ids[args.env]), algorithm_name=args.algo,
+                         **hp)
+    done_updates = 0
+    while done_updates < args.updates:
+        result = runner.train(epochs=min(5, args.updates - done_updates))
+        done_updates = runner.updates
+        avg = result["avg_return_last_window"]
+        print(f"[local] updates={done_updates} avg_return={avg:.1f}",
+              flush=True)
+        if args.target is not None and avg >= args.target:
+            print(f"[local] target {args.target} reached", flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Any, Mapping
 
 from relayrl_tpu.algorithms import build_algorithm, registered_algorithms
@@ -160,21 +161,48 @@ class TrainingServer:
             except queue.Empty:
                 continue
             try:
-                actions = deserialize_actions(payload)
-            except Exception:
-                self.stats["dropped"] += 1
-                continue
-            self.stats["trajectories"] += 1
+                self._process_one(payload)
+            finally:
+                self._ingest.task_done()
+
+    def _process_one(self, payload: bytes) -> None:
+        try:
+            actions = deserialize_actions(payload)
+        except Exception:
+            self.stats["dropped"] += 1
+            return
+        self.stats["trajectories"] += 1
+        try:
+            updated = self.algorithm.receive_trajectory(actions)
+        except Exception as e:  # never kill the loop on one bad batch
+            print(f"[TrainingServer] learner error: {e!r}", flush=True)
+            return
+        if updated:
+            self.stats["updates"] += 1
             try:
-                updated = self.algorithm.receive_trajectory(actions)
-            except Exception as e:  # never kill the loop on one bad batch
-                print(f"[TrainingServer] learner error: {e!r}", flush=True)
-                continue
-            if updated:
-                self.stats["updates"] += 1
                 self._publish()
-                if self._tb is not None:
+            except Exception as e:  # transient socket/fs errors must not
+                print(f"[TrainingServer] publish error: {e!r}", flush=True)
+            if self._tb is not None:
+                try:
                     self._tb.poll()
+                except Exception as e:
+                    print(f"[TrainingServer] tensorboard error: {e!r}",
+                          flush=True)
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every trajectory already in the ingest queue has been
+        processed (trained + published). True if drained within timeout.
+
+        Note this covers trajectories the server has *received*; bytes still
+        in transit in socket buffers are invisible here, so to observe an
+        exact update count poll ``stats['updates']`` first, then drain."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._ingest.unfinished_tasks == 0:
+                return True
+            time.sleep(0.05)
+        return False
 
     def _publish(self) -> None:
         bundle = self.algorithm.bundle()
@@ -221,10 +249,12 @@ class TrainingServer:
         if not self.active:
             return
         self._stop.set()
-        self.transport.stop()
+        # Join the learner BEFORE stopping the transport: a trajectory being
+        # processed right now may still publish, which needs a live socket.
         if self._learner_thread is not None:
-            self._learner_thread.join(timeout=5)
+            self._learner_thread.join(timeout=30)
             self._learner_thread = None
+        self.transport.stop()
         # Drain any in-flight async orbax save — the most recent checkpoint
         # is exactly the one a subsequent resume needs.
         mgr = getattr(self.algorithm, "_ckpt_mgr", None)

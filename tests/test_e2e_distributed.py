@@ -105,6 +105,38 @@ def test_full_loop_model_update_reaches_agent(tmp_cwd, server_type):
         server.disable_server()
 
 
+def test_drain_then_shutdown_processes_inflight(tmp_cwd):
+    """drain() must finish every already-sent trajectory (train + publish),
+    and disable_server immediately after must not kill a mid-flight publish
+    (the learner joins before the transport stops)."""
+    server_addrs = _zmq_addrs()
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, server_type="zmq",
+        env_dir=str(tmp_cwd),
+        hyperparams={"traj_per_epoch": 2, "hidden_sizes": [16],
+                     "with_vf_baseline": False},
+        **server_addrs,
+    )
+    agent = Agent(server_type="zmq", handshake_timeout_s=20, seed=0,
+                  **_agent_addrs(server_addrs))
+    try:
+        env = _RandomEnv()
+        run_gym_loop(agent, env, episodes=6, max_steps=10)
+        # In-flight socket bytes are invisible to drain(): wait for arrival
+        # first (6 episodes / traj_per_epoch 2 => exactly 3 updates)...
+        deadline = time.monotonic() + 60
+        while server.stats["trajectories"] < 6 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # ...then drain guarantees processing/publishing has finished.
+        assert server.drain(timeout=60)
+        assert server.stats["updates"] == 3
+        assert server.algorithm.version == 3
+    finally:
+        agent.disable_agent()
+        server.disable_server()
+    assert server.stats["dropped"] == 0
+
+
 def test_multi_agent_zmq(tmp_cwd):
     """Several ZMQ agents against one server — the topology the reference's
     ZMQ plane cannot serve (SURVEY.md §2.3 socket-topology note)."""
