@@ -13,9 +13,34 @@ this host's CPU" is the honest stand-in baseline.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _ensure_live_backend(probe_timeout_s: float = 240.0) -> str:
+    """Guard against a dead accelerator tunnel: probe backend init in a
+    subprocess with a timeout, falling back to CPU so the bench always
+    prints its JSON line instead of hanging forever. Returns the platform
+    used."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=probe_timeout_s)
+        if out.returncode == 0 and "ok" in out.stdout:
+            return os.environ.get("JAX_PLATFORMS", "default")
+    except subprocess.TimeoutExpired:
+        pass
+    print("bench: accelerator backend unreachable; falling back to CPU",
+          file=sys.stderr, flush=True)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
 
 # Bench shape: 64 trajectories × 256 steps (the north-star configs feed a
 # v4-8 learner from 64 actors; one epoch batch per update).
@@ -38,7 +63,7 @@ def _batch(rng):
     }
 
 
-def bench_jax() -> float:
+def bench_jax(warmup: int = WARMUP, iters: int = ITERS) -> float:
     import jax
     import jax.numpy as jnp
     import optax
@@ -69,18 +94,18 @@ def bench_jax() -> float:
 
     rng = np.random.default_rng(0)
     batch = {k: jnp.asarray(v) for k, v in _batch(rng).items()}
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         state, metrics = update(state, batch)
     float(metrics["LossPi"])  # host fence (block_until_ready is unreliable
     # on the axon remote platform — it can return before execution finishes;
     # a host readback of a value depending on the whole donated-state chain
     # cannot)
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters):
         state, metrics = update(state, batch)
     float(metrics["LossPi"])  # forces all ITERS sequential updates
     dt = time.perf_counter() - t0
-    return ITERS / dt
+    return iters / dt
 
 
 def bench_torch_reference() -> float:
@@ -132,12 +157,19 @@ def bench_torch_reference() -> float:
 
 
 def main():
-    jax_sps = bench_jax()
+    platform = _ensure_live_backend()
+    if platform == "cpu":
+        # Fallback exists to record a number, not to race the torch
+        # reference on equal hardware — keep it short.
+        jax_sps = bench_jax(warmup=1, iters=3)
+    else:
+        jax_sps = bench_jax()
     torch_sps = bench_torch_reference()
     result = {
         "metric": "learner_steps_per_sec_chip",
         "value": round(jax_sps, 3),
-        "unit": "epoch_updates/s (B=64,T=256,obs=128,act=18,vf_iters=80)",
+        "unit": (f"epoch_updates/s (B=64,T=256,obs=128,act=18,vf_iters=80,"
+                 f"platform={platform})"),
         "vs_baseline": round(jax_sps / torch_sps, 2),
     }
     print(json.dumps(result))
