@@ -67,10 +67,22 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self.batch_size = int(params.get("batch_size", 256))
         self.update_after = int(params.get("update_after", 1000))
         self.updates_per_step = float(params.get("updates_per_step", 1.0))
+        # Bound on jitted updates per receive_trajectory call: a long
+        # episode past warmup owes stored*updates_per_step updates, but
+        # running them all inside one ingest call starves the ingest queue
+        # and delays the model publish for the whole burst. The backlog is
+        # carried in ``_update_debt`` and amortized across future calls.
+        self.max_updates_per_ingest = int(
+            params.get("max_updates_per_ingest", 64))
+        self._update_debt = 0.0
         self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
         seed = int(params.get("seed", 1))
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), os.getpid())
-        self._rng_init, self._rng_state = jax.random.split(rng)
+        # Param init is deterministic given the seed (reproducible learners);
+        # only the action-sampling stream folds in the pid so concurrent
+        # actor processes explore differently.
+        self._rng_init = jax.random.PRNGKey(seed)
+        self._rng_state = jax.random.fold_in(
+            jax.random.PRNGKey(seed ^ 0x5EED), os.getpid())
 
         self.buffer = StepReplayBuffer(
             obs_dim=self.obs_dim,
@@ -122,8 +134,11 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._traj_since_log += 1
         trained = False
         if self.buffer.total_steps >= self.update_after and stored > 0:
-            n = max(1, int(round(stored * self.updates_per_step)))
+            self._update_debt += stored * self.updates_per_step
+            n = min(self.max_updates_per_ingest,
+                    max(1, int(self._update_debt)))
             self._train_batches(n)
+            self._update_debt = max(0.0, self._update_debt - n)
             trained = True
         if self._traj_since_log >= self.traj_per_epoch:
             self.log_epoch()
@@ -174,7 +189,11 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._rng_state, sub = jax.random.split(self._rng_state)
         # Current (possibly annealed) exploration knobs ride as traced args.
         explore = exploration_kwargs(self._publish_arch())
-        act, aux = jax.jit(self.policy.step)(
+        if not hasattr(self, "_jit_step"):
+            # Jit once; rebuilding the wrapper per call would bypass the
+            # compile cache and retrace every action.
+            self._jit_step = jax.jit(self.policy.step)
+        act, aux = self._jit_step(
             self._actor_params(), sub, jnp.asarray(obs), mask, **explore)
         return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}
 

@@ -130,6 +130,10 @@ class OnPolicyAlgorithm(AlgorithmBase):
     def act(self, obs, mask=None):
         rng, sub = jax.random.split(self.state.rng)
         self.state = self.state.replace(rng=rng)
-        act, aux = jax.jit(self.policy.step)(self.state.params, sub,
-                                             jnp.asarray(obs), mask)
+        if not hasattr(self, "_jit_step"):
+            # Jit once; rebuilding the wrapper per call would bypass the
+            # compile cache and retrace every action.
+            self._jit_step = jax.jit(self.policy.step)
+        act, aux = self._jit_step(self.state.params, sub,
+                                  jnp.asarray(obs), mask)
         return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}

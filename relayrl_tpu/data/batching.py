@@ -60,6 +60,38 @@ class TrajectoryBatch:
         return dataclasses.asdict(self)
 
 
+def fold_trailing_markers(
+    actions: Sequence[ActionRecord],
+) -> tuple[list[ActionRecord], np.ndarray | None, bool]:
+    """Fold ``flag_last_action`` markers (act-less records) into the last
+    real step.
+
+    The marker's reward is added to the preceding step and its done /
+    truncated flags OR-merged in. Returns ``(steps, final_obs, truncated)``
+    where ``final_obs`` is the post-step observation a truncation marker may
+    carry (the off-policy bootstrap successor) and ``truncated`` is True if
+    any marker flagged a time-limit ending. Shared by the epoch and step
+    replay buffers so marker semantics cannot diverge between them.
+    """
+    steps = list(actions)
+    final_obs: np.ndarray | None = None
+    truncated = False
+    while steps and steps[-1].act is None:
+        marker = steps.pop()
+        truncated = truncated or marker.truncated
+        if marker.obs is not None:
+            final_obs = np.asarray(marker.obs, np.float32)
+        if steps:
+            last = steps[-1]
+            steps[-1] = ActionRecord(
+                obs=last.obs, act=last.act, mask=last.mask,
+                rew=last.rew + marker.rew, data=last.data,
+                done=last.done or marker.done,
+                truncated=last.truncated or marker.truncated,
+            )
+    return steps, final_obs, truncated
+
+
 def pick_bucket(length: int, buckets: Sequence[int]) -> int:
     """Smallest bucket ≥ length (lengths above the last bucket clamp to it)."""
     for b in sorted(buckets):
@@ -89,15 +121,7 @@ def pad_trajectory(
     # agent_zmq.rs:605-610). Markers are not steps: fold their reward into
     # the preceding real step so the policy-gradient loss never sees a
     # fictitious action at a zero observation.
-    actions = list(actions)
-    while actions and actions[-1].obs is None and actions[-1].act is None:
-        marker = actions.pop()
-        if actions:
-            actions[-1] = ActionRecord(
-                obs=actions[-1].obs, act=actions[-1].act, mask=actions[-1].mask,
-                rew=actions[-1].rew + marker.rew, data=actions[-1].data,
-                done=actions[-1].done or marker.done,
-            )
+    actions, _, _ = fold_trailing_markers(actions)
     if not actions:
         raise ValueError("trajectory contained only terminal markers")
     n = min(len(actions), horizon)
@@ -132,10 +156,14 @@ def pad_trajectory(
         )
         valid[t] = 1.0
 
-    terminated = bool(actions[n - 1].done) and n == len(actions)
-    # Truncated episode: bootstrap from the last stored value — v(s_{T+1}) is
-    # unavailable on the wire, the stored v(s_T) is the standard stand-in
-    # (the reference simply never bootstraps: finish_path(last_val=0)).
+    # ``terminated`` means a true terminal state: the value target stops
+    # there. A time-limit truncation (Gymnasium ``truncated``) must still
+    # bootstrap — v(s_{T+1}) is unavailable on the wire, so the stored
+    # v(s_T) is the standard stand-in (the reference never bootstraps:
+    # finish_path(last_val=0)).
+    terminated = (bool(actions[n - 1].done)
+                  and not bool(actions[n - 1].truncated)
+                  and n == len(actions))
     last_val = 0.0 if terminated else float(val[n - 1])
     return PaddedTrajectory(
         obs=obs, act=act, act_mask=act_mask, rew=rew, val=val, logp=logp,

@@ -25,8 +25,10 @@ class StepReplayBuffer:
     ``add_episode`` unrolls an ActionRecord trajectory: record ``t`` holds
     ``(obs_t, act_t, rew_t)`` (terminal markers already folded by the caller
     or carrying their reward here), ``obs2`` comes from record ``t+1``. A
-    truncated final step (no successor, episode not done) is dropped — its
-    bootstrap target is unknowable without ``obs_{T+1}``.
+    time-limit truncation whose marker carries the post-step observation is
+    stored with ``done=0`` and that observation as the bootstrap successor;
+    a truncated final step without one is dropped — its bootstrap target is
+    unknowable without ``obs_{T+1}``.
     """
 
     def __init__(self, obs_dim: int, act_dim: int, capacity: int,
@@ -68,18 +70,11 @@ class StepReplayBuffer:
 
     def add_episode(self, actions: Sequence[ActionRecord]) -> int:
         """Unroll one trajectory into transitions; returns how many stored."""
-        steps = list(actions)
-        # Fold trailing terminal markers (obs=None records from
-        # flag_last_action) into the preceding real step, as the epoch
-        # buffer does (data/batching.py pad_trajectory).
-        while steps and steps[-1].obs is None and steps[-1].act is None:
-            marker = steps.pop()
-            if steps:
-                last = steps[-1]
-                steps[-1] = ActionRecord(
-                    obs=last.obs, act=last.act, mask=last.mask,
-                    rew=last.rew + marker.rew, data=last.data,
-                    done=last.done or marker.done)
+        from relayrl_tpu.data.batching import fold_trailing_markers
+
+        # A truncation marker may carry the post-step observation — the
+        # bootstrap successor for the final transition.
+        steps, final_obs, truncated = fold_trailing_markers(actions)
         stored = 0
         ones = np.ones((self.act_dim,), np.float32)
         for t, rec in enumerate(steps):
@@ -87,10 +82,20 @@ class StepReplayBuffer:
                 continue
             is_last = t == len(steps) - 1
             if is_last:
-                if not rec.done:
-                    break  # truncated: no successor obs to bootstrap from
-                obs2 = np.zeros((self.obs_dim,), np.float32)
-                mask2 = ones
+                if truncated or rec.truncated or not rec.done:
+                    # Time-limit ending: the value target must bootstrap
+                    # through the boundary (done=0). That needs a real
+                    # successor obs — without one the transition is
+                    # unknowable and dropped.
+                    if final_obs is None:
+                        break
+                    obs2 = final_obs.reshape(-1)[: self.obs_dim]
+                    mask2 = ones
+                    done = 0.0
+                else:
+                    obs2 = np.zeros((self.obs_dim,), np.float32)
+                    mask2 = ones
+                    done = 1.0
             else:
                 nxt = steps[t + 1]
                 if nxt.obs is None:
@@ -98,9 +103,9 @@ class StepReplayBuffer:
                 obs2 = np.asarray(nxt.obs, np.float32).reshape(-1)[: self.obs_dim]
                 mask2 = (np.asarray(nxt.mask, np.float32).reshape(-1)[: self.act_dim]
                          if nxt.mask is not None else ones)
+                done = 0.0
             obs = np.asarray(rec.obs, np.float32).reshape(-1)[: self.obs_dim]
-            self._put(obs, rec.act, rec.rew, obs2,
-                      rec.done and is_last, mask2)
+            self._put(obs, rec.act, rec.rew, obs2, done, mask2)
             stored += 1
         return stored
 

@@ -113,9 +113,11 @@ class Agent:
         self._require_active()
         return self.actor.request_for_action(obs, mask, reward)
 
-    def flag_last_action(self, reward: float = 0.0) -> None:
+    def flag_last_action(self, reward: float = 0.0, truncated: bool = False,
+                         final_obs=None) -> None:
         self._require_active()
-        self.actor.flag_last_action(reward)
+        self.actor.flag_last_action(reward, truncated=truncated,
+                                    final_obs=final_obs)
 
     def record_action(self, action: ActionRecord) -> None:
         self._require_active()
@@ -144,6 +146,7 @@ def run_gym_loop(agent: Agent, env, episodes: int, max_steps: int = 1000,
     for ep in range(episodes):
         obs, _ = env.reset(seed=None if seed is None else seed + ep)
         ep_ret, reward = 0.0, 0.0
+        terminated = truncated = False
         for _ in range(max_steps):
             record = agent.request_for_action(obs, reward=reward)
             act = record.act
@@ -152,6 +155,11 @@ def run_gym_loop(agent: Agent, env, episodes: int, max_steps: int = 1000,
             ep_ret += float(reward)
             if terminated or truncated:
                 break
-        agent.flag_last_action(reward)
+        # A time-limit ending (env truncation or this loop's max_steps cap)
+        # ships the post-step obs so value targets bootstrap through it; a
+        # genuine terminal takes precedence even when both flags are set.
+        time_limited = not terminated
+        agent.flag_last_action(reward, truncated=time_limited,
+                               final_obs=obs if time_limited else None)
         returns.append(ep_ret)
     return returns

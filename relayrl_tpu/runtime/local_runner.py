@@ -51,9 +51,12 @@ class LocalRunner:
             **hyperparams,
         )
         self._episode_bytes: list[bytes] = []
+        # On-policy epoch buffers expose length buckets; the off-policy step
+        # replay ring has none — cap trajectories at a fixed horizon there.
+        buckets = getattr(self.algorithm.buffer, "buckets", None)
         self.actor = PolicyActor(
             self.algorithm.bundle(),
-            max_traj_length=self.algorithm.buffer.buckets[-1],
+            max_traj_length=buckets[-1] if buckets else 1000,
             on_send=self._episode_bytes.append,
             seed=seed,
         )
@@ -64,6 +67,7 @@ class LocalRunner:
         obs, _ = self.env.reset(seed=None)
         ep_ret, ep_len = 0.0, 0
         reward = 0.0
+        terminated = truncated = False
         for _ in range(max_steps):
             record = self.actor.request_for_action(obs, reward=reward)
             obs, reward, terminated, truncated, _ = self.env.step(
@@ -72,10 +76,15 @@ class LocalRunner:
             ep_ret += float(reward)
             ep_len += 1
             if terminated or truncated:
-                self.actor.flag_last_action(reward)
                 break
-        else:
-            self.actor.flag_last_action(reward)
+        # Ending by time limit (env truncation or the max_steps cap here)
+        # is not a terminal state: ship the post-step obs so value targets
+        # bootstrap through it. A genuine terminal takes precedence even if
+        # it coincides with the time limit (Gymnasium allows both True).
+        time_limited = not terminated
+        self.actor.flag_last_action(
+            reward, truncated=time_limited,
+            final_obs=obs if time_limited else None)
 
         # Hand the wire bytes to the learner exactly as the server would.
         for buf in self._episode_bytes:

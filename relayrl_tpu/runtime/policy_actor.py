@@ -80,11 +80,26 @@ class PolicyActor:
             self.trajectory.add_action(record, send_if_done=True)
         return record
 
-    def flag_last_action(self, reward: float = 0.0) -> None:
+    def flag_last_action(
+        self,
+        reward: float = 0.0,
+        truncated: bool = False,
+        final_obs=None,
+    ) -> None:
         """Terminal marker: appends a done action carrying the final reward,
-        which triggers the trajectory send (ref: agent_zmq.rs:605-610)."""
+        which triggers the trajectory send (ref: agent_zmq.rs:605-610).
+
+        ``truncated=True`` marks a time-limit ending (Gymnasium semantics):
+        the learner then bootstraps the value target through the boundary
+        instead of zeroing it. Pass the post-step observation as
+        ``final_obs`` so off-policy learners have a successor state to
+        bootstrap from.
+        """
         with self._lock:
-            record = ActionRecord(rew=float(reward), done=True)
+            record = ActionRecord(
+                obs=(None if final_obs is None
+                     else np.asarray(final_obs, np.float32)),
+                rew=float(reward), done=True, truncated=bool(truncated))
             self.trajectory.add_action(record, send_if_done=True)
 
     def record_action(self, action: ActionRecord) -> None:

@@ -43,6 +43,11 @@ class ActionRecord:
     data: dict[str, AuxValue] | None = None
     done: bool = False
     reward_updated: bool = False
+    # Terminated-vs-truncated distinction the reference lacks: ``done`` says
+    # the episode ended; ``truncated`` says it ended by time limit, not by
+    # reaching a terminal state — value targets must still bootstrap through
+    # a truncation (Gymnasium step() semantics).
+    truncated: bool = False
 
     # -- reference getter parity (action.rs:454-525) --
     def get_obs(self) -> np.ndarray | None:
@@ -63,6 +68,9 @@ class ActionRecord:
     def get_done(self) -> bool:
         return self.done
 
+    def get_truncated(self) -> bool:
+        return self.truncated
+
     def update_reward(self, reward: float) -> None:
         self.rew = float(reward)
         self.reward_updated = True
@@ -77,6 +85,7 @@ class ActionRecord:
             "d": _pack_aux(self.data),
             "t": bool(self.done),
             "u": bool(self.reward_updated),
+            "x": bool(self.truncated),
         }
 
     @classmethod
@@ -89,6 +98,7 @@ class ActionRecord:
             data=_unpack_aux(wire.get("d")),
             done=bool(wire.get("t", False)),
             reward_updated=bool(wire.get("u", False)),
+            truncated=bool(wire.get("x", False)),
         )
 
     def to_bytes(self) -> bytes:

@@ -107,18 +107,20 @@ class Logger:
             f.write(serialized)
 
     def dump_tabular(self) -> None:
-        vals = []
-        key_lens = [len(key) for key in self.log_headers]
-        max_key_len = max(15, max(key_lens) if key_lens else 15)
-        fmt = "| %" + str(max_key_len) + "s | %15s |"
-        n_slashes = 22 + max_key_len
-        print("-" * n_slashes)
-        for key in self.log_headers:
-            val = self.log_current_row.get(key, "")
-            valstr = f"{val:8.3g}" if hasattr(val, "__float__") else val
-            print(fmt % (key, valstr))
-            vals.append(val)
-        print("-" * n_slashes, flush=True)
+        # Console rendering: left-aligned keys dot-padded to the value
+        # column, values right-aligned — an original layout; only the TSV
+        # half below preserves the reference's progress.txt schema.
+        vals = [self.log_current_row.get(key, "") for key in self.log_headers]
+        rendered = [
+            f"{v:.4g}" if hasattr(v, "__float__") else str(v) for v in vals
+        ]
+        key_w = max((len(k) for k in self.log_headers), default=0)
+        val_w = max((len(s) for s in rendered), default=0)
+        lines = [f"epoch {'=' * max(4, key_w + val_w)}"]
+        for key, valstr in zip(self.log_headers, rendered):
+            pad = "." * (key_w - len(key) + 2)
+            lines.append(f"  {key} {pad} {valstr:>{val_w}}")
+        print("\n".join(lines), flush=True)
         if self.output_file is not None:
             if self.first_row:
                 self.output_file.write("\t".join(self.log_headers) + "\n")

@@ -77,6 +77,19 @@ class TestPadTrajectory:
         assert padded.last_val == 0.0
         assert padded.valid.sum() == 3
 
+    def test_truncation_marker_keeps_bootstrap(self):
+        # A time-limit truncation (marker with truncated=True) is an
+        # episode end but NOT a terminal state: last_val must bootstrap
+        # from the stored value instead of zeroing.
+        acts = _episode(3, done=False)
+        acts.append(ActionRecord(obs=np.full(4, 9, np.float32), rew=2.0,
+                                 done=True, truncated=True))
+        padded = pad_trajectory(acts, horizon=8, obs_dim=4, act_dim=2)
+        assert padded.length == 3
+        assert padded.rew[2] == pytest.approx(1.0 + 2.0)
+        assert padded.terminated is False
+        assert padded.last_val == pytest.approx(0.2, rel=1e-5)
+
     def test_marker_only_trajectory_rejected(self):
         with pytest.raises(ValueError, match="terminal markers"):
             pad_trajectory([ActionRecord(rew=1.0, done=True)],
