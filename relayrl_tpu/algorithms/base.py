@@ -70,6 +70,16 @@ class AlgorithmBase(abc.ABC):
         """Dump the epoch's tabular diagnostics."""
 
     # -- TPU-native surface --
+    def _jitted_policy_step(self):
+        """``self.policy.step`` jitted once per instance — rebuilding the
+        wrapper per call would bypass the compile cache and retrace every
+        action."""
+        if getattr(self, "_jit_step_fn", None) is None:
+            import jax
+
+            self._jit_step_fn = jax.jit(self.policy.step)
+        return self._jit_step_fn
+
     @abc.abstractmethod
     def bundle(self) -> ModelBundle:
         """Current policy as a versioned transportable bundle."""

@@ -74,6 +74,11 @@ class OffPolicyAlgorithm(AlgorithmBase):
         # carried in ``_update_debt`` and amortized across future calls.
         self.max_updates_per_ingest = int(
             params.get("max_updates_per_ingest", 64))
+        if self.max_updates_per_ingest < 1:
+            raise ValueError(
+                "max_updates_per_ingest must be >= 1 (it bounds the jitted "
+                "updates run per ingest call; use updates_per_step=0 to "
+                "disable training on ingest)")
         self._update_debt = 0.0
         self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
         seed = int(params.get("seed", 1))
@@ -125,7 +130,10 @@ class OffPolicyAlgorithm(AlgorithmBase):
 
     # -- reference contract --
     def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
-        if not actions:
+        if not actions or all(a.act is None for a in actions):
+            # Empty or marker-only (a capacity flush can strand the
+            # terminal marker in its own send) — no steps to store, and
+            # logging it would record a phantom zero-length episode.
             return False
         rew_total = float(sum(a.rew for a in actions))
         stored = self.buffer.add_episode(actions)
@@ -133,7 +141,9 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._ep_lengths.append(stored)
         self._traj_since_log += 1
         trained = False
-        if self.buffer.total_steps >= self.update_after and stored > 0:
+        if (self.updates_per_step > 0
+                and self.buffer.total_steps >= self.update_after
+                and stored > 0):
             self._update_debt += stored * self.updates_per_step
             n = min(self.max_updates_per_ingest,
                     max(1, int(self._update_debt)))
@@ -189,11 +199,7 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._rng_state, sub = jax.random.split(self._rng_state)
         # Current (possibly annealed) exploration knobs ride as traced args.
         explore = exploration_kwargs(self._publish_arch())
-        if not hasattr(self, "_jit_step"):
-            # Jit once; rebuilding the wrapper per call would bypass the
-            # compile cache and retrace every action.
-            self._jit_step = jax.jit(self.policy.step)
-        act, aux = self._jit_step(
+        act, aux = self._jitted_policy_step()(
             self._actor_params(), sub, jnp.asarray(obs), mask, **explore)
         return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}
 

@@ -88,7 +88,9 @@ class OnPolicyAlgorithm(AlgorithmBase):
 
     # -- reference contract --
     def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
-        if not actions:
+        if not actions or all(a.act is None for a in actions):
+            # Marker-only trajectories (stranded by a capacity flush)
+            # carry no steps; padding would raise on the empty fold.
             return False
         if self.buffer.add_episode(actions):
             self.train_model()
@@ -130,10 +132,6 @@ class OnPolicyAlgorithm(AlgorithmBase):
     def act(self, obs, mask=None):
         rng, sub = jax.random.split(self.state.rng)
         self.state = self.state.replace(rng=rng)
-        if not hasattr(self, "_jit_step"):
-            # Jit once; rebuilding the wrapper per call would bypass the
-            # compile cache and retrace every action.
-            self._jit_step = jax.jit(self.policy.step)
-        act, aux = self._jit_step(self.state.params, sub,
-                                  jnp.asarray(obs), mask)
+        act, aux = self._jitted_policy_step()(self.state.params, sub,
+                                              jnp.asarray(obs), mask)
         return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}

@@ -62,25 +62,30 @@ class TrajectoryBatch:
 
 def fold_trailing_markers(
     actions: Sequence[ActionRecord],
-) -> tuple[list[ActionRecord], np.ndarray | None, bool]:
+) -> tuple[list[ActionRecord], np.ndarray | None, bool, np.ndarray | None]:
     """Fold ``flag_last_action`` markers (act-less records) into the last
     real step.
 
     The marker's reward is added to the preceding step and its done /
-    truncated flags OR-merged in. Returns ``(steps, final_obs, truncated)``
-    where ``final_obs`` is the post-step observation a truncation marker may
-    carry (the off-policy bootstrap successor) and ``truncated`` is True if
-    any marker flagged a time-limit ending. Shared by the epoch and step
-    replay buffers so marker semantics cannot diverge between them.
+    truncated flags OR-merged in. Returns ``(steps, final_obs, truncated,
+    final_mask)`` where ``final_obs`` is the post-step observation a
+    truncation marker may carry (the off-policy bootstrap successor),
+    ``truncated`` is True if any marker flagged a time-limit ending, and
+    ``final_mask`` is the marker's action mask for that successor state
+    (action-masked envs). Shared by the epoch and step replay buffers so
+    marker semantics cannot diverge between them.
     """
     steps = list(actions)
     final_obs: np.ndarray | None = None
+    final_mask: np.ndarray | None = None
     truncated = False
     while steps and steps[-1].act is None:
         marker = steps.pop()
         truncated = truncated or marker.truncated
         if marker.obs is not None:
             final_obs = np.asarray(marker.obs, np.float32)
+        if marker.mask is not None:
+            final_mask = np.asarray(marker.mask, np.float32)
         if steps:
             last = steps[-1]
             steps[-1] = ActionRecord(
@@ -89,7 +94,7 @@ def fold_trailing_markers(
                 done=last.done or marker.done,
                 truncated=last.truncated or marker.truncated,
             )
-    return steps, final_obs, truncated
+    return steps, final_obs, truncated, final_mask
 
 
 def pick_bucket(length: int, buckets: Sequence[int]) -> int:
@@ -121,7 +126,7 @@ def pad_trajectory(
     # agent_zmq.rs:605-610). Markers are not steps: fold their reward into
     # the preceding real step so the policy-gradient loss never sees a
     # fictitious action at a zero observation.
-    actions, _, _ = fold_trailing_markers(actions)
+    actions, _, _, _ = fold_trailing_markers(actions)
     if not actions:
         raise ValueError("trajectory contained only terminal markers")
     n = min(len(actions), horizon)

@@ -73,8 +73,9 @@ class StepReplayBuffer:
         from relayrl_tpu.data.batching import fold_trailing_markers
 
         # A truncation marker may carry the post-step observation — the
-        # bootstrap successor for the final transition.
-        steps, final_obs, truncated = fold_trailing_markers(actions)
+        # bootstrap successor for the final transition — and its action
+        # mask, so masked bootstrap targets stay legal.
+        steps, final_obs, truncated, final_mask = fold_trailing_markers(actions)
         stored = 0
         ones = np.ones((self.act_dim,), np.float32)
         for t, rec in enumerate(steps):
@@ -90,7 +91,9 @@ class StepReplayBuffer:
                     if final_obs is None:
                         break
                     obs2 = final_obs.reshape(-1)[: self.obs_dim]
-                    mask2 = ones
+                    mask2 = (ones if final_mask is None
+                             else np.asarray(final_mask, np.float32)
+                             .reshape(-1)[: self.act_dim])
                     done = 0.0
                 else:
                     obs2 = np.zeros((self.obs_dim,), np.float32)

@@ -114,10 +114,12 @@ class Agent:
         return self.actor.request_for_action(obs, mask, reward)
 
     def flag_last_action(self, reward: float = 0.0, truncated: bool = False,
-                         final_obs=None) -> None:
+                         final_obs=None, terminated: bool | None = None,
+                         final_mask=None) -> None:
         self._require_active()
         self.actor.flag_last_action(reward, truncated=truncated,
-                                    final_obs=final_obs)
+                                    final_obs=final_obs, terminated=terminated,
+                                    final_mask=final_mask)
 
     def record_action(self, action: ActionRecord) -> None:
         self._require_active()

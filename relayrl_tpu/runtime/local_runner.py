@@ -53,6 +53,7 @@ class LocalRunner:
         self._episode_bytes: list[bytes] = []
         # On-policy epoch buffers expose length buckets; the off-policy step
         # replay ring has none — cap trajectories at a fixed horizon there.
+        # (PolicyActor adds marker headroom on top of this cap.)
         buckets = getattr(self.algorithm.buffer, "buckets", None)
         self.actor = PolicyActor(
             self.algorithm.bundle(),

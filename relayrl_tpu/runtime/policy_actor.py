@@ -85,6 +85,8 @@ class PolicyActor:
         reward: float = 0.0,
         truncated: bool = False,
         final_obs=None,
+        terminated: bool | None = None,
+        final_mask=None,
     ) -> None:
         """Terminal marker: appends a done action carrying the final reward,
         which triggers the trajectory send (ref: agent_zmq.rs:605-610).
@@ -93,12 +95,22 @@ class PolicyActor:
         the learner then bootstraps the value target through the boundary
         instead of zeroing it. Pass the post-step observation as
         ``final_obs`` so off-policy learners have a successor state to
-        bootstrap from.
+        bootstrap from (plus ``final_mask`` in action-masked envs, so the
+        bootstrap max ranges only over actions legal in that state).
+        Gymnasium can report ``terminated`` and ``truncated`` both True; a
+        genuine terminal must win (no bootstrapping past a real end
+        state), so callers mapping ``env.step`` output directly can pass
+        ``terminated`` and let this method resolve the precedence instead
+        of pre-computing it.
         """
+        if terminated:
+            truncated = False
         with self._lock:
             record = ActionRecord(
                 obs=(None if final_obs is None
                      else np.asarray(final_obs, np.float32)),
+                mask=(None if final_mask is None
+                      else np.asarray(final_mask, np.float32)),
                 rew=float(reward), done=True, truncated=bool(truncated))
             self.trajectory.add_action(record, send_if_done=True)
 

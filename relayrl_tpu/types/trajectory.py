@@ -62,15 +62,27 @@ class Trajectory:
         transport. Without an ``on_send`` hook the actions are retained for
         the caller to read (local/offline collection), bounded by eviction of
         the oldest entries at capacity.
+
+        Capacity is enforced *before* appending a real step, so chunks
+        never exceed ``max_length`` steps — but a terminal marker (act-less
+        record from ``flag_last_action``) always joins the chunk it ends:
+        markers fold into the preceding step learner-side, so the chunk
+        still pads into its ``max_length`` bucket, and flushing before the
+        marker instead would strand it in a marker-only send that loses
+        the final reward and bootstrap obs.
         """
+        is_marker = action.act is None
+        if not is_marker and len(self._actions) >= self.max_length:
+            if send_if_done and self._on_send is not None:
+                self.flush()
+            else:
+                # No transport attached: evict oldest rather than grow
+                # unbounded.
+                del self._actions[: max(1, self.max_length // 2)]
         self._actions.append(action)
-        hit_capacity = len(self._actions) >= self.max_length
-        if (action.done or hit_capacity) and send_if_done and self._on_send is not None:
+        if action.done and send_if_done and self._on_send is not None:
             self.flush()
             return True
-        if hit_capacity:
-            # No transport attached: evict oldest rather than grow unbounded.
-            del self._actions[: max(1, self.max_length // 2)]
         return False
 
     def flush(self) -> None:

@@ -156,6 +156,36 @@ def _mk(tmp_cwd, name, **kw):
     return build_algorithm(name, **base)
 
 
+class TestMarkerHandling:
+    def test_marker_only_trajectory_skipped(self, tmp_cwd):
+        # A capacity flush can strand the terminal marker in its own send;
+        # it carries no steps and must not log a phantom episode.
+        algo = _mk(tmp_cwd, "DQN", act_dim=2)
+        assert algo.receive_trajectory(
+            [ActionRecord(rew=3.0, done=True)]) is False
+        assert algo._ep_returns == [] and algo._ep_lengths == []
+
+    def test_terminated_wins_over_truncated(self):
+        # Gymnasium can report terminated and truncated both True; the
+        # genuine terminal must win so value targets don't bootstrap past
+        # a real end state.
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.trajectory import deserialize_actions
+
+        arch = {"kind": "qnet_discrete", "obs_dim": OBS_DIM, "act_dim": 2,
+                "hidden_sizes": [8], "epsilon": 1.0}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        sent = []
+        actor = PolicyActor(ModelBundle(version=1, arch=arch, params=params),
+                            on_send=sent.append)
+        actor.request_for_action(np.zeros(OBS_DIM, np.float32))
+        actor.flag_last_action(1.0, truncated=True, terminated=True,
+                               final_obs=np.ones(OBS_DIM, np.float32))
+        marker = deserialize_actions(sent[-1])[-1]
+        assert marker.done is True and marker.truncated is False
+
+
 class TestDiscreteAlgorithms:
     @pytest.mark.parametrize("name", ["DQN", "C51"])
     def test_registered(self, name):

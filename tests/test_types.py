@@ -195,11 +195,31 @@ class TestTrajectory:
         assert [len(deserialize_actions(s)) for s in sent] == [2, 2]
 
     def test_overflow_flush(self):
+        # Capacity is enforced before appending a real step: the 5th step
+        # flushes the first 4 and starts the next chunk, so no chunk ever
+        # exceeds max_length real steps (bucket-overflow guard).
+        sent = []
+        traj = Trajectory(max_length=4, on_send=sent.append)
+        for i in range(5):
+            traj.add_action(self._action(i), send_if_done=True)
+        assert len(sent) == 1 and len(traj) == 1
+        assert len(deserialize_actions(sent[0])) == 4
+
+    def test_full_length_episode_keeps_marker(self):
+        # An episode of exactly max_length steps must ship its terminal
+        # marker WITH the steps (a stranded marker-only send loses the
+        # final reward + bootstrap obs); the marker folds learner-side so
+        # the chunk still fits its bucket.
         sent = []
         traj = Trajectory(max_length=4, on_send=sent.append)
         for i in range(4):
             traj.add_action(self._action(i), send_if_done=True)
-        assert len(sent) == 1 and len(traj) == 0
+        marker = ActionRecord(rew=7.0, done=True, truncated=True)
+        assert traj.add_action(marker, send_if_done=True) is True
+        assert len(sent) == 1
+        out = deserialize_actions(sent[0])
+        assert len(out) == 5
+        assert out[-1].act is None and out[-1].truncated is True
 
     def test_from_bytes(self):
         actions = [self._action(i) for i in range(3)]
