@@ -28,6 +28,7 @@ from relayrl_tpu.parallel.learner import (
 )
 from relayrl_tpu.parallel.context import current_mesh, use_mesh
 from relayrl_tpu.parallel.distributed import (
+    broadcast_from_coordinator,
     initialize_distributed,
     is_coordinator,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "place_state",
     "current_mesh",
     "use_mesh",
+    "broadcast_from_coordinator",
     "initialize_distributed",
     "is_coordinator",
     "make_ring_attention",

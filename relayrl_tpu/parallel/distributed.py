@@ -106,6 +106,23 @@ def process_index() -> int:
     return 0
 
 
+def broadcast_from_coordinator(tree):
+    """Ship a host pytree from the coordinator to every process.
+
+    The actor plane is asymmetric (trajectory sockets bind on the
+    coordinator only — SURVEY.md §7.4 item 5) while the learner step is
+    SPMD: every process must hold the same host batch before
+    ``place_batch`` builds the global device array. Single-process: the
+    tree is returned unchanged. Multi-host: rank 0's values win
+    (non-coordinators pass zeros_like or their stale copy).
+    """
+    if _info is None or not _info["multi_host"]:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
 def is_coordinator() -> bool:
     """True on the host that should run ingest/logging (process 0) — the
     asymmetric actor-plane side of SURVEY.md §7.4 item 5: trajectory

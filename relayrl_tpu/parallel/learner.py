@@ -77,14 +77,35 @@ def batch_shardings(mesh: Mesh, batch: dict, shard_time: bool = False) -> dict:
     return {k: sh for k in batch}
 
 
+def _global_put(x, sharding):
+    """Place one host array under a sharding that may span processes.
+
+    Single-process (and any fully-addressable sharding): plain
+    ``jax.device_put``. Multi-host: the mesh's devices are not all
+    addressable from this process, so build the global array from this
+    process's copy of the (host-global) data — each process contributes
+    the slices its local devices own. Callers must hold the same host
+    values on every process (the coordinator-ingest path broadcasts the
+    batch first; states are constructed identically from shared seeds).
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
 def place_state(state, mesh: Mesh):
     """Device-put a host/single-device state onto the mesh per the rules."""
-    return jax.device_put(state, state_shardings(state, mesh))
+    return jax.tree_util.tree_map(_global_put, state,
+                                  state_shardings(state, mesh))
 
 
 def place_batch(batch: dict, mesh: Mesh, shard_time: bool = False) -> dict:
     """Host batch → device-sharded arrays (the jax.device_put ingest path —
     BASELINE.md north-star names this explicitly). ``shard_time`` must match
-    the :func:`make_sharded_update` flag."""
+    the :func:`make_sharded_update` flag. Works on multi-host meshes (the
+    batch must be host-global and identical across processes — see
+    :func:`relayrl_tpu.parallel.distributed.broadcast_from_coordinator`)."""
     sh = batch_shardings(mesh, batch, shard_time)
-    return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+    return {k: _global_put(v, sh[k]) for k, v in batch.items()}

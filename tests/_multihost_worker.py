@@ -1,0 +1,131 @@
+"""Worker for the 2-process ``jax.distributed`` CPU test.
+
+Each of two OS processes runs this script with 4 virtual CPU devices,
+forming an 8-device global mesh across a real coordinator barrier — the
+CPU-simulation equivalent SURVEY.md §4 prescribes for multi-host learner
+validation (no 2-host TPU pod is available to CI). Exercises the paths
+`tests/test_distributed_init.py` can only argument-check in one process:
+
+* ``initialize_distributed`` actually reaching ``jax.distributed.initialize``
+* coordinator-asymmetric ingest: rank 0 builds the batch,
+  ``broadcast_from_coordinator`` ships it, every rank places + steps
+* a dp×fsdp-sharded REINFORCE update executing across processes
+* checkpoint save on the shared dir + restore with identical state
+
+Usage: _multihost_worker.py <rank> <coordinator_port> <ckpt_dir>
+Prints "MULTIHOST_OK rank=<r>" on success; any assert kills the process.
+"""
+
+import os
+import sys
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+ckpt_dir = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=4 "
+    + os.environ.get("XLA_FLAGS", ""))
+
+# A sitecustomize may have imported jax (snapshotting the platform) before
+# this script ran; force the live config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from relayrl_tpu.parallel import (  # noqa: E402
+    broadcast_from_coordinator,
+    initialize_distributed,
+    is_coordinator,
+    make_mesh,
+    make_sharded_update,
+    place_batch,
+    place_state,
+)
+
+info = initialize_distributed(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank)
+assert info == {"multi_host": True, "process_id": rank, "num_processes": 2}, info
+assert is_coordinator() == (rank == 0)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+from relayrl_tpu.algorithms.reinforce import (  # noqa: E402
+    ReinforceState,
+    make_optimizers,
+    make_reinforce_update,
+)
+from relayrl_tpu.models import build_policy  # noqa: E402
+
+B, T, OBS, ACT = 8, 16, 6, 3
+arch = {"kind": "mlp_discrete", "obs_dim": OBS, "act_dim": ACT,
+        "hidden_sizes": [16, 16], "has_critic": True}
+policy = build_policy(arch)
+params = policy.init_params(jax.random.PRNGKey(0))
+tx_pi, tx_vf = make_optimizers(params, 3e-4, 1e-3)
+state = ReinforceState(params=params, pi_opt_state=tx_pi.init(params),
+                       vf_opt_state=tx_vf.init(params),
+                       rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+
+mesh = make_mesh({"dp": -1, "fsdp": 2, "tp": 1, "sp": 1})
+update = make_reinforce_update(policy, 3e-4, 1e-3, train_vf_iters=4,
+                               gamma=0.99, lam=0.95, with_baseline=True)
+sharded_update = make_sharded_update(update, mesh, state)
+state = place_state(state, mesh)
+
+# Coordinator-asymmetric ingest: only rank 0 "receives" the batch (the
+# trajectory sockets bind there); everyone else contributes zeros and takes
+# the coordinator's copy from the broadcast.
+rng = np.random.default_rng(42 if is_coordinator() else 7)
+host_batch = {
+    "obs": rng.standard_normal((B, T, OBS)).astype(np.float32),
+    "act": rng.integers(0, ACT, (B, T)).astype(np.int32),
+    "act_mask": np.ones((B, T, ACT), np.float32),
+    "rew": rng.standard_normal((B, T)).astype(np.float32),
+    "val": rng.standard_normal((B, T)).astype(np.float32),
+    "logp": rng.standard_normal((B, T)).astype(np.float32),
+    "valid": np.ones((B, T), np.float32),
+    "last_val": np.zeros((B,), np.float32),
+}
+if not is_coordinator():
+    host_batch = {k: np.zeros_like(v) for k, v in host_batch.items()}
+host_batch = broadcast_from_coordinator(host_batch)
+# Both ranks must now hold the coordinator's data.
+coord_rng = np.random.default_rng(42)
+np.testing.assert_array_equal(
+    host_batch["obs"], coord_rng.standard_normal((B, T, OBS)).astype(np.float32))
+
+batch = place_batch(host_batch, mesh)
+state, metrics = sharded_update(state, batch)
+loss_pi = float(metrics["LossPi"])
+assert np.isfinite(loss_pi)
+
+# SPMD agreement: the replicated metric must be identical on both ranks.
+from jax.experimental import multihost_utils  # noqa: E402
+
+gathered = multihost_utils.process_allgather(np.float32(loss_pi))
+assert gathered.shape[0] == 2
+np.testing.assert_allclose(gathered[0], gathered[1], rtol=0, atol=0)
+
+# Checkpoint under multi-host: all processes participate in the orbax save
+# on the shared directory, then restore and compare.
+from relayrl_tpu.checkpoint import CheckpointManager  # noqa: E402
+
+mgr = CheckpointManager(ckpt_dir)
+mgr.save(1, state, wait=True)
+restored, _ = mgr.restore(state)
+for a, b in zip(jax.tree_util.tree_leaves(state),
+                jax.tree_util.tree_leaves(restored)):
+    # Multi-host arrays are not fully addressable; compare the local shards.
+    np.testing.assert_array_equal(np.asarray(a.addressable_data(0)),
+                                  np.asarray(b.addressable_data(0)))
+mgr.close()
+
+print(f"MULTIHOST_OK rank={rank} loss_pi={loss_pi:.6f}", flush=True)

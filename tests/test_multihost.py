@@ -1,0 +1,65 @@
+"""Real 2-process ``jax.distributed`` execution (CPU simulation).
+
+Spawns two OS processes running ``tests/_multihost_worker.py`` against a
+real coordinator barrier — the multi-host CPU simulation SURVEY.md §4
+prescribes. This covers what `test_distributed_init.py` cannot: the
+``jax.distributed.initialize`` call itself, the coordinator-asymmetric
+ingest broadcast, a cross-process sharded update, and orbax save/restore
+with all processes participating.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    # Repo root ONLY: an inherited PYTHONPATH can carry a sitecustomize
+    # that registers an accelerator PJRT plugin in the workers (the axon
+    # harness does), overriding the CPU simulation this test needs.
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    # The workers set their own XLA_FLAGS; scrub the conftest's
+    # single-process settings so they don't double-apply.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers hung:\n" + "\n---\n".join(
+            p.stdout.read() if p.stdout else "" for p in procs))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"MULTIHOST_OK rank={rank}" in out, out
+    # Both ranks computed the identical replicated loss.
+    losses = {line.split("loss_pi=")[1]
+              for out in outs for line in out.splitlines()
+              if "MULTIHOST_OK" in line}
+    assert len(losses) == 1, losses

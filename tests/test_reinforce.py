@@ -5,6 +5,8 @@ The CartPole improvement test is the Stage-2 north-star check
 clear improvement within a bounded budget, full convergence runs in the
 bench/examples)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -121,3 +123,34 @@ class TestLearning:
         assert final > baseline + 30, (
             f"no learning: first-window {baseline:.1f} -> final {final:.1f}")
         assert final > 100, f"final avg return too low: {final:.1f}"
+
+    @pytest.mark.skipif(
+        not os.environ.get("RELAYRL_SOLVE_TEST"),
+        reason="full CartPole solve takes tens of minutes; set "
+               "RELAYRL_SOLVE_TEST=1 (CI learning job / release gate)")
+    def test_cartpole_solved(self, tmp_cwd):
+        """BASELINE.md north star: CartPole-v1 avg return >= 475.
+
+        The committed golden curve from this exact configuration is
+        examples/golden/cartpole_reinforce_baseline/progress.txt (solved
+        at epoch ~105-115). Budget: 400 updates (3200 episodes) with
+        early stop once the rolling 50-episode average crosses the bar.
+        """
+        import gymnasium as gym
+
+        from relayrl_tpu.runtime import LocalRunner
+
+        env = gym.make("CartPole-v1")
+        env.reset(seed=0)
+        runner = LocalRunner(
+            env, "REINFORCE", env_dir=str(tmp_cwd), seed=1,
+            with_vf_baseline=True,
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")},
+        )
+        best = -float("inf")
+        for _ in range(80):  # 80 x 5 updates = 400-update budget
+            result = runner.train(epochs=5, max_steps=500)
+            best = max(best, result["avg_return_last_window"])
+            if best >= 475.0:
+                break
+        assert best >= 475.0, f"not solved within budget: best {best:.1f}"
