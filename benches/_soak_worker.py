@@ -1,0 +1,94 @@
+"""Worker process for the multi-actor ZMQ soak bench.
+
+Runs N real :class:`relayrl_tpu.runtime.Agent` instances in threads (each
+with its own DEALER/PUSH/SUB sockets — the process count is collapsed only
+because the bench host has one core; the socket topology the server sees is
+identical to N separate actor processes). Each agent drives the synthetic
+env loop of the e2e tests: request_for_action per step, flag_last_action at
+episode end, model hot-swap via SUB.
+
+Usage: _soak_worker.py <json-config>  (see bench_soak.py)
+Writes a JSON result file: per-agent step counts + model receipt times.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier):
+    import numpy as np
+
+    from relayrl_tpu.runtime.agent import Agent
+
+    ident = f"soak-{cfg['worker_id']}-{agent_idx}"
+    agent = Agent(
+        model_path=os.path.join(cfg["scratch"], f"model_{ident}.msgpack"),
+        seed=cfg["worker_id"] * 1000 + agent_idx,
+        handshake_timeout_s=cfg["handshake_timeout_s"],
+        agent_listener_addr=cfg["agent_listener_addr"],
+        trajectory_addr=cfg["trajectory_addr"],
+        model_sub_addr=cfg["model_sub_addr"],
+    )
+    # Observe model fan-out: timestamp every SUB receipt (before the swap
+    # work) keyed by version.
+    receipts: list[tuple[int, float]] = []
+    orig_on_model = agent.transport.on_model
+
+    def on_model(version, bundle_bytes):
+        receipts.append((int(version), time.time()))
+        orig_on_model(version, bundle_bytes)
+
+    agent.transport.on_model = on_model
+
+    rng = np.random.default_rng(agent_idx)
+    obs_dim, ep_len = cfg["obs_dim"], cfg["episode_len"]
+    steps = episodes = 0
+    barrier.wait()  # line up all agents in this process before timing
+    deadline = time.time() + cfg["duration_s"]
+    while time.time() < deadline:
+        obs = rng.standard_normal(obs_dim).astype(np.float32)
+        reward = 0.0
+        for _ in range(ep_len):
+            agent.request_for_action(obs, reward=reward)
+            obs = rng.standard_normal(obs_dim).astype(np.float32)
+            reward = 1.0
+            steps += 1
+        agent.flag_last_action(reward, terminated=True)
+        episodes += 1
+    out[agent_idx] = {
+        "identity": ident,
+        "steps": steps,
+        "episodes": episodes,
+        "final_version": agent.model_version,
+        "receipts": receipts,
+    }
+    agent.disable_agent()
+
+
+def main():
+    cfg = json.loads(sys.argv[1])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    out: dict = {}
+    barrier = threading.Barrier(cfg["agents_per_proc"])
+    threads = [
+        threading.Thread(target=agent_loop, args=(cfg, i, out, barrier),
+                         daemon=True)
+        for i in range(cfg["agents_per_proc"])
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"] + 120)
+    with open(cfg["result_path"], "w") as f:
+        json.dump({"worker_id": cfg["worker_id"],
+                   "agents": list(out.values())}, f)
+
+
+if __name__ == "__main__":
+    main()

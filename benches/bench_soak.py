@@ -1,0 +1,244 @@
+"""Multi-actor ZMQ soak: the BASELINE.md "64 ZMQ actors -> one learner" shape.
+
+One TrainingServer, N real agents (default 64) spread over worker
+processes, each driving the synthetic gym loop for a fixed duration.
+Measures what the reference's criterion throughput bench
+(relayrl_framework/benches/network_benchmarks.rs:278-443) measures for ONE
+agent, at fleet scale, plus the two SLOs the reference cannot express:
+
+* ingest soundness — server-side drop counter must stay 0 while the fleet
+  saturates the trajectory PULL socket;
+* model fan-out latency — time from ``publish_model`` to each agent's SUB
+  receipt, per version, across the whole fleet.
+
+Prints one JSON line. ``--quick`` runs 16 actors for 8 s; ``--write``
+commits the result to benches/results/soak64.json.
+
+Note the bench host has ONE core: agents run as threads inside a few
+processes (socket topology per agent is unchanged — own DEALER/PUSH/SUB),
+and absolute env-steps/s is a single-core number; the SLOs (zero drops,
+fan-out latency, zero crashed agents) are the portable result.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root, for relayrl_tpu
+from common import bench_cwd, free_port, setup_platform  # noqa: E402
+
+setup_platform()
+
+
+def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
+             duration_s: float = 30.0, episode_len: int = 25,
+             obs_dim: int = 8, act_dim: int = 4,
+             traj_per_epoch: int = 64) -> dict:
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
+    addrs = {
+        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+    }
+    server = TrainingServer(
+        "REINFORCE", obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
+        hyperparams={"traj_per_epoch": traj_per_epoch, "hidden_sizes": [32, 32],
+                     "with_vf_baseline": True, "train_vf_iters": 5},
+        **addrs,
+    )
+    publishes: list[tuple[int, float]] = []
+    orig_publish = server.transport.publish_model
+
+    def publish_model(version, bundle_bytes):
+        orig_publish(version, bundle_bytes)
+        publishes.append((int(version), time.time()))
+
+    server.transport.publish_model = publish_model
+
+    n_procs = (n_actors + agents_per_proc - 1) // agents_per_proc
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root  # repo only: see tests/test_multihost.py
+    procs, result_paths = [], []
+    t_spawn = time.time()
+    for w in range(n_procs):
+        n_here = min(agents_per_proc, n_actors - w * agents_per_proc)
+        result_path = os.path.join(scratch, f"worker_{w}.json")
+        result_paths.append(result_path)
+        cfg = {
+            "worker_id": w, "agents_per_proc": n_here,
+            "duration_s": duration_s, "episode_len": episode_len,
+            "obs_dim": obs_dim, "scratch": scratch,
+            "handshake_timeout_s": 180.0,
+            "result_path": result_path, **{
+                k: addrs[k] for k in ("agent_listener_addr", "trajectory_addr")
+            },
+            "model_sub_addr": addrs["model_pub_addr"],
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_soak_worker.py"),
+             json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=duration_s + 600)
+        outs.append(out)
+    wall = time.time() - t_spawn
+    server.drain(timeout=120)
+    stats = dict(server.stats)
+    queue_backlog = server._ingest.qsize()
+
+    agents = []
+    for path, out, p in zip(result_paths, outs, procs):
+        if p.returncode != 0 or not os.path.exists(path):
+            raise RuntimeError(f"soak worker failed (rc={p.returncode}):\n{out}")
+        with open(path) as f:
+            agents.extend(json.load(f)["agents"])
+
+    total_steps = sum(a["steps"] for a in agents)
+    total_episodes = sum(a["episodes"] for a in agents)
+    pub_times = dict(publishes)
+    latencies = [t - pub_times[v]
+                 for a in agents for v, t in a["receipts"] if v in pub_times]
+    result = {
+        "bench": "soak_multi_actor_zmq",
+        "config": {"actors": n_actors, "duration_s": duration_s,
+                   "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
+                   "host_cores": os.cpu_count()},
+        "agents_completed": len(agents),
+        "env_steps_total": total_steps,
+        "env_steps_per_sec": round(total_steps / duration_s, 1),
+        "episodes_total": total_episodes,
+        "server_stats": stats,
+        "ingest_backlog_after_drain": queue_backlog,
+        "publishes": len(publishes),
+        "fanout_receipts": len(latencies),
+        "fanout_latency_ms": {
+            "p50": round(1000 * statistics.median(latencies), 1) if latencies else None,
+            "p95": round(1000 * (statistics.quantiles(latencies, n=20)[18]
+                                 if len(latencies) >= 20 else max(latencies)), 1)
+            if latencies else None,
+            "max": round(1000 * max(latencies), 1) if latencies else None,
+        },
+        "wall_s": round(wall, 1),
+    }
+    server.disable_server()
+    return result
+
+
+def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
+                     obs_dim: int = 8, act_dim: int = 4,
+                     n_pushers: int = 4) -> dict:
+    """Server ingest-plane ceiling: pre-serialized trajectories blasted at
+    the PULL socket as fast as the senders can go (no actor loop, no
+    policy apply). Measures the rate the PULL socket + msgpack decode +
+    learner-thread receive path sustains, and that nothing is dropped —
+    the server-side half of the 64-actor SLO, isolated from the one-core
+    actor fleet."""
+    import numpy as np
+    import zmq
+
+    from relayrl_tpu.runtime.server import TrainingServer
+    from relayrl_tpu.transport.base import pack_trajectory_envelope
+    from relayrl_tpu.types.action import ActionRecord
+    from relayrl_tpu.types.trajectory import serialize_actions
+
+    scratch = tempfile.mkdtemp(prefix="relayrl_blast_")
+    addrs = {
+        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+    }
+    # traj_per_epoch > n_traj: pure ingest+decode+store, no update in the
+    # timed window (the update path is the headline bench's subject).
+    server = TrainingServer(
+        "REINFORCE", obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
+        hyperparams={"traj_per_epoch": n_traj + 1, "hidden_sizes": [32, 32],
+                     "with_vf_baseline": True},
+        **addrs,
+    )
+    rng = np.random.default_rng(0)
+    records = [
+        ActionRecord(obs=rng.standard_normal(obs_dim).astype(np.float32),
+                     act=np.int64(rng.integers(act_dim)), rew=1.0,
+                     data={"logp_a": np.float32(-1.0), "v": np.float32(0.5)},
+                     done=(i == episode_len - 1))
+        for i in range(episode_len)
+    ]
+    payload = serialize_actions(records)
+    ctx = zmq.Context.instance()
+    pushers = []
+    for i in range(n_pushers):
+        s = ctx.socket(zmq.PUSH)
+        s.connect(addrs["trajectory_addr"])
+        pushers.append(s)
+    time.sleep(0.5)  # let connects settle
+
+    t0 = time.time()
+    for i in range(n_traj):
+        env = pack_trajectory_envelope(f"blast-{i % n_pushers}", payload)
+        pushers[i % n_pushers].send(env)
+    send_s = time.time() - t0
+    # drain() only covers trajectories already received; wait for arrival
+    # first (sends return before bytes clear the zmq io threads).
+    deadline = time.time() + 300
+    while (server.stats["trajectories"] + server.stats["dropped"] < n_traj
+           and time.time() < deadline):
+        time.sleep(0.02)
+    drained = server.drain(timeout=60)
+    total_s = time.time() - t0
+    stats = dict(server.stats)
+    for s in pushers:
+        s.close(0)
+    server.disable_server()
+    return {
+        "bench": "ingest_blast_zmq",
+        "config": {"n_traj": n_traj, "episode_len": episode_len,
+                   "payload_bytes": len(payload), "pushers": n_pushers,
+                   "host_cores": os.cpu_count()},
+        "drained": drained,
+        "send_s": round(send_s, 2),
+        "ingest_trajectories_per_sec": round(stats["trajectories"] / total_s, 1),
+        "ingest_env_steps_per_sec": round(
+            stats["trajectories"] * episode_len / total_s, 1),
+        "server_stats": stats,
+    }
+
+
+def main():
+    quick = "--quick" in sys.argv
+    bench_cwd()
+    result = run_soak(n_actors=16 if quick else 64,
+                      duration_s=8.0 if quick else 30.0)
+    blast = run_ingest_blast(n_traj=500 if quick else 2000)
+    for r in (result, blast):
+        print(json.dumps(r))
+    assert result["server_stats"]["dropped"] == 0, "ingest dropped trajectories"
+    assert result["agents_completed"] == result["config"]["actors"]
+    assert blast["server_stats"]["dropped"] == 0 and blast["drained"]
+    if "--write" in sys.argv:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "soak64.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            f.write(json.dumps(result) + "\n")
+            f.write(json.dumps(blast) + "\n")
+
+
+if __name__ == "__main__":
+    main()

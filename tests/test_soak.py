@@ -1,0 +1,37 @@
+"""Scaled-down multi-actor soak (the committed 64-actor numbers live in
+benches/results/soak64.json; this keeps the harness itself green)."""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_BENCHES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benches")
+
+
+@pytest.fixture
+def soak(monkeypatch, tmp_path):
+    monkeypatch.syspath_prepend(_BENCHES)
+    monkeypatch.chdir(tmp_path)
+    import bench_soak
+
+    return bench_soak
+
+
+def test_multi_actor_soak_no_drops(soak):
+    result = soak.run_soak(n_actors=8, agents_per_proc=4, duration_s=5.0,
+                           traj_per_epoch=8)
+    assert result["agents_completed"] == 8
+    assert result["server_stats"]["dropped"] == 0
+    assert result["ingest_backlog_after_drain"] == 0
+    assert result["env_steps_total"] > 0
+
+
+def test_ingest_blast_no_drops(soak):
+    result = soak.run_ingest_blast(n_traj=300)
+    assert result["drained"] is True
+    assert result["server_stats"]["dropped"] == 0
+    assert result["server_stats"]["trajectories"] == 300
