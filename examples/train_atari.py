@@ -1,0 +1,60 @@
+"""Pixel-policy training: the DQN-lineage Atari pipeline + CNN learner.
+
+North-star shapes from BASELINE.md ("PPO Atari Pong (CNN)" /
+"IMPALA-style async A2C Breakout"): 84x84x4 frame-stacked grayscale
+observations into the Nature-DQN trunk. The image bakes no ALE, so the
+default env is the in-repo catch toy (same preprocessing, real reward
+structure); pass ``--env ALE/Pong-v5`` on a machine with
+``gymnasium[atari]`` and the identical pipeline drives the real game.
+
+    python examples/train_atari.py --algo PPO --updates 30
+    python examples/train_atari.py --algo IMPALA --updates 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+if os.environ.get("RELAYRL_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"  # CPU by default; RELAYRL_TPU=1 for the chip
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", default="PPO", choices=["PPO", "IMPALA"])
+    ap.add_argument("--env", default="synthetic",
+                    help='"synthetic" (in-repo catch toy) or an ALE id '
+                         'like "ALE/Pong-v5" (needs gymnasium[atari])')
+    ap.add_argument("--frame-size", type=int, default=84)
+    ap.add_argument("--updates", type=int, default=30)
+    ap.add_argument("--target", type=float, default=None)
+    args = ap.parse_args()
+
+    from relayrl_tpu.envs import make_atari
+    from relayrl_tpu.runtime.local_runner import LocalRunner
+
+    env = make_atari(args.env, frame_size=args.frame_size)
+    h, w, c = env.obs_shape
+    runner = LocalRunner(
+        env, algorithm_name=args.algo,
+        obs_shape=[h, w, c],
+        model_kind="cnn_discrete",
+        traj_per_epoch=8,
+    )
+    done_updates = 0
+    while done_updates < args.updates:
+        result = runner.train(epochs=min(5, args.updates - done_updates),
+                              max_steps=500)
+        done_updates = runner.updates
+        avg = result["avg_return_last_window"]
+        print(f"[atari:{args.algo}] updates={done_updates} "
+              f"avg_return={avg:.2f}", flush=True)
+        if args.target is not None and avg >= args.target:
+            print(f"[atari:{args.algo}] target {args.target} reached",
+                  flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ def main():
                     help="any registered algorithm (REINFORCE/PPO/IMPALA/"
                          "DQN/C51 for cartpole; DDPG/TD3/SAC for pendulum)")
     ap.add_argument("--env", default="cartpole",
-                    choices=["cartpole", "pendulum"])
+                    choices=["cartpole", "pendulum", "lunarlander"])
     ap.add_argument("--baseline", action="store_true",
                     help="REINFORCE: add the value baseline")
     ap.add_argument("--updates", type=int, default=40)
@@ -41,7 +41,8 @@ def main():
         hp.setdefault("discrete", False)
         hp.setdefault("act_limit", 2.0)
 
-    env_ids = {"cartpole": "CartPole-v1", "pendulum": "Pendulum-v1"}
+    env_ids = {"cartpole": "CartPole-v1", "pendulum": "Pendulum-v1",
+               "lunarlander": "LunarLander-v3"}
     runner = LocalRunner(make(env_ids[args.env]), algorithm_name=args.algo,
                          **hp)
     done_updates = 0

@@ -9,6 +9,11 @@ and learning tests run anywhere, and a real Gymnasium env drops in
 unchanged (:func:`make` prefers Gymnasium when it is importable).
 """
 
+from relayrl_tpu.envs.atari import (
+    AtariPreprocessing,
+    SyntheticPixelEnv,
+    make_atari,
+)
 from relayrl_tpu.envs.classic import CartPoleEnv, PendulumEnv
 from relayrl_tpu.envs.spaces import Box, Discrete
 
@@ -37,4 +42,5 @@ def make(env_id: str, **kwargs):
     )
 
 
-__all__ = ["make", "CartPoleEnv", "PendulumEnv", "Box", "Discrete"]
+__all__ = ["make", "make_atari", "AtariPreprocessing", "SyntheticPixelEnv",
+           "CartPoleEnv", "PendulumEnv", "Box", "Discrete"]
