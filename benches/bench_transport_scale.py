@@ -1,0 +1,204 @@
+"""Transport-plane scale shootout: zmq vs grpc vs native C++ at 64 actors.
+
+Isolates the transports (no learner, no policy): for each backend,
+
+* **ingest**: 64 agent transports (threads, own sockets each) blast
+  pre-packed ~3 KB trajectory payloads at one ServerTransport; result is
+  aggregate trajectories/s into the server callback, drops = sends minus
+  receipts.
+* **fan-out**: 64 subscribed agents; the server publishes a ~64 KB model
+  K times; result is publish->last-receipt latency per version across the
+  fleet.
+
+The committed numbers justify (or refute) making the native framed-TCP
+core the default over pyzmq/grpcio — VERDICT r1 item 7. One JSON line per
+backend/shape; ``--write`` commits to results/transport_scale.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))
+from common import free_port, setup_platform  # noqa: E402
+
+setup_platform()
+
+from relayrl_tpu.config import ConfigLoader  # noqa: E402
+from relayrl_tpu.transport import (  # noqa: E402
+    make_agent_transport,
+    make_server_transport,
+)
+
+N_AGENTS = 64
+TRAJ_PER_AGENT = 50
+PAYLOAD = os.urandom(3000)
+MODEL = os.urandom(64 * 1024)
+PUBLISHES = 10
+
+
+def _addrs(backend: str):
+    if backend == "zmq":
+        server = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        agent = {
+            "agent_listener_addr": server["agent_listener_addr"],
+            "trajectory_addr": server["trajectory_addr"],
+            "model_sub_addr": server["model_pub_addr"],
+        }
+    else:
+        port = free_port()
+        server = {"bind_addr": f"127.0.0.1:{port}"}
+        agent = {"server_addr": f"127.0.0.1:{port}"}
+    return server, agent
+
+
+def bench_ingest(backend: str, cfg) -> dict:
+    server_addrs, agent_addrs = _addrs(backend)
+    server = make_server_transport(backend, cfg, **server_addrs)
+    received = []
+    lock = threading.Lock()
+    server.get_model = lambda: (1, b"model")
+    server.on_trajectory = lambda aid, p: (lock.acquire(),
+                                           received.append(len(p)),
+                                           lock.release())
+    server.start()
+    agents = [make_agent_transport(backend, cfg, **agent_addrs)
+              for _ in range(N_AGENTS)]
+    try:
+        for a in agents:
+            a.fetch_model(timeout_s=60)
+        barrier = threading.Barrier(N_AGENTS + 1)
+
+        def blast(a):
+            barrier.wait()
+            for _ in range(TRAJ_PER_AGENT):
+                a.send_trajectory(PAYLOAD)
+
+        threads = [threading.Thread(target=blast, args=(a,), daemon=True)
+                   for a in agents]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.time()
+        for t in threads:
+            t.join(timeout=300)
+        sent_s = time.time() - t0
+        total = N_AGENTS * TRAJ_PER_AGENT
+        deadline = time.time() + 120
+        while len(received) < total and time.time() < deadline:
+            time.sleep(0.02)
+        wall = time.time() - t0
+        return {
+            "bench": "transport_ingest", "backend": backend,
+            "config": {"agents": N_AGENTS, "traj_per_agent": TRAJ_PER_AGENT,
+                       "payload_bytes": len(PAYLOAD),
+                       "host_cores": os.cpu_count()},
+            "received": len(received), "sent": total,
+            "dropped": total - len(received),
+            "send_wall_s": round(sent_s, 3),
+            "trajectories_per_sec": round(len(received) / wall, 1),
+        }
+    finally:
+        for a in agents:
+            a.close()
+        server.stop()
+
+
+def bench_fanout(backend: str, cfg) -> dict:
+    server_addrs, agent_addrs = _addrs(backend)
+    server = make_server_transport(backend, cfg, **server_addrs)
+    # Mutable model source: the gRPC long-poll servicer re-reads
+    # get_model() on wake (publish_model only notifies), so the bench must
+    # advance the source of truth, not just call publish_model.
+    current = {"v": 1, "m": b"model"}
+    server.get_model = lambda: (current["v"], current["m"])
+    server.start()
+    if backend == "grpc":
+        server.idle_timeout_s = 30.0
+    agents = [make_agent_transport(backend, cfg, **agent_addrs)
+              for _ in range(N_AGENTS)]
+    receipts: dict[int, list[float]] = {}
+    lock = threading.Lock()
+
+    def on_model(version, _bundle):
+        now = time.time()
+        with lock:
+            receipts.setdefault(int(version), []).append(now)
+
+    try:
+        for a in agents:
+            a.fetch_model(timeout_s=60)
+            a.on_model = on_model
+            a.start_model_listener()
+        time.sleep(1.0)  # let subscriptions land
+        latencies = []
+        for v in range(2, 2 + PUBLISHES):
+            t_pub = time.time()
+            current["v"], current["m"] = v, MODEL
+            server.publish_model(v, MODEL)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with lock:
+                    if len(receipts.get(v, [])) >= N_AGENTS:
+                        break
+                time.sleep(0.005)
+            with lock:
+                got = receipts.get(v, [])
+                if got:
+                    latencies.append(max(got) - t_pub)
+        complete = sum(1 for v in range(2, 2 + PUBLISHES)
+                       if len(receipts.get(v, [])) >= N_AGENTS)
+        return {
+            "bench": "transport_fanout", "backend": backend,
+            "config": {"agents": N_AGENTS, "model_bytes": len(MODEL),
+                       "publishes": PUBLISHES,
+                       "host_cores": os.cpu_count()},
+            "complete_fanouts": complete,
+            "fanout_last_receipt_ms": {
+                "p50": round(1000 * statistics.median(latencies), 1)
+                if latencies else None,
+                "max": round(1000 * max(latencies), 1) if latencies else None,
+            },
+        }
+    finally:
+        for a in agents:
+            a.close()
+        server.stop()
+
+
+def main():
+    from common import bench_cwd
+
+    bench_cwd()
+    cfg = ConfigLoader(None, None)
+    backends = ["zmq", "native", "grpc"]
+    from relayrl_tpu.transport.native_backend import native_available
+
+    if not native_available():
+        backends.remove("native")
+    lines = []
+    for backend in backends:
+        for fn in (bench_ingest, bench_fanout):
+            r = fn(backend, cfg)
+            lines.append(json.dumps(r))
+            print(lines[-1], flush=True)
+    if "--write" in sys.argv:
+        out = os.path.join(_HERE, "results", "transport_scale.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

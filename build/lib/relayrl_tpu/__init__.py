@@ -1,0 +1,42 @@
+"""relayrl_tpu — a TPU-native distributed actor↔learner RL framework.
+
+A from-scratch re-design of the capabilities of `jrcalgo/RelayRL-prototype`
+(see SURVEY.md): actor processes run environment steps against a locally-held
+policy and stream trajectories over ZMQ/gRPC/native transports to a training
+server whose learner is a pure JAX/XLA program (jit/pjit policy-gradient
+updates over a device mesh), publishing updated parameters back to actors for
+hot-swap.
+
+Public API mirrors the reference's five PyO3 classes
+(reference: relayrl_framework/src/lib.rs:163-186) in TPU-native form:
+
+- :class:`relayrl_tpu.types.ActionRecord`   (ref: RelayRLAction)
+- :class:`relayrl_tpu.types.Trajectory`     (ref: RelayRLTrajectory)
+- :class:`relayrl_tpu.config.ConfigLoader`  (ref: ConfigLoader)
+- :class:`relayrl_tpu.runtime.TrainingServer` (ref: TrainingServer)
+- :class:`relayrl_tpu.runtime.Agent`        (ref: RelayRLAgent)
+"""
+
+__version__ = "0.1.0"
+
+from relayrl_tpu.types import ActionRecord, Trajectory, TensorSpec, DType  # noqa: F401
+from relayrl_tpu.config import ConfigLoader  # noqa: F401
+
+__all__ = [
+    "ActionRecord",
+    "Trajectory",
+    "TensorSpec",
+    "DType",
+    "ConfigLoader",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy imports for heavyweight submodules so `import relayrl_tpu` stays
+    # cheap in actor processes that only need types + config.
+    if name in ("TrainingServer", "Agent", "LocalRunner"):
+        from relayrl_tpu import runtime
+
+        return getattr(runtime, name)
+    raise AttributeError(f"module 'relayrl_tpu' has no attribute {name!r}")

@@ -1,0 +1,47 @@
+"""Learner algorithms (ref layer L7, SURVEY.md §1).
+
+Importing this package registers the built-in algorithms with the registry;
+the training server resolves ``algorithm_name`` through
+:func:`build_algorithm` (the dynamic-import analogue of the reference's
+python_algorithm_reply.py:41-46).
+"""
+
+from relayrl_tpu.algorithms.base import (
+    AlgorithmBase,
+    build_algorithm,
+    register_algorithm,
+    registered_algorithms,
+)
+from relayrl_tpu.algorithms.reinforce import REINFORCE, ReinforceState
+from relayrl_tpu.algorithms.ppo import PPO, PPOState
+from relayrl_tpu.algorithms.offpolicy import OffPolicyAlgorithm
+from relayrl_tpu.algorithms.dqn import DQN, DQNState
+from relayrl_tpu.algorithms.c51 import C51, C51State
+from relayrl_tpu.algorithms.ddpg import DDPG, DDPGState
+from relayrl_tpu.algorithms.td3 import TD3, TD3State
+from relayrl_tpu.algorithms.sac import SAC, SACState
+from relayrl_tpu.algorithms.impala import IMPALA, ImpalaState
+
+__all__ = [
+    "AlgorithmBase",
+    "build_algorithm",
+    "register_algorithm",
+    "registered_algorithms",
+    "REINFORCE",
+    "ReinforceState",
+    "PPO",
+    "PPOState",
+    "OffPolicyAlgorithm",
+    "DQN",
+    "DQNState",
+    "C51",
+    "C51State",
+    "DDPG",
+    "DDPGState",
+    "TD3",
+    "TD3State",
+    "SAC",
+    "SACState",
+    "IMPALA",
+    "ImpalaState",
+]

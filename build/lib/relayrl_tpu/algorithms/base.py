@@ -1,0 +1,90 @@
+"""Algorithm plugin contract.
+
+Capability parity with the reference's learner plugin interface
+(reference: relayrl_framework/src/native/python/_common/_algorithms/
+BaseAlgorithm.py:4-39 — ``save``, ``receive_trajectory -> bool``,
+``train_model``, ``log_epoch``), extended with the TPU-native pieces the
+reference lacks: a pure jitted ``learner_step``, a versioned
+:class:`~relayrl_tpu.types.ModelBundle` surface for transport, and full
+checkpoint/resume (params + optimizer state + RNG + counters; the
+reference checkpoints only the TorchScript policy file — SURVEY.md §5.4).
+
+Algorithms register by name; the training server resolves
+``algorithm_name`` through :func:`build_algorithm` the way the reference's
+learner subprocess dynamically imports ``{ALGO}.{ALGO}``
+(python_algorithm_reply.py:41-46).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Mapping, Sequence
+
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle
+
+_ALGO_REGISTRY: dict[str, Callable[..., "AlgorithmBase"]] = {}
+
+
+def register_algorithm(name: str):
+    def deco(cls):
+        _ALGO_REGISTRY[name.upper()] = cls
+        return cls
+    return deco
+
+
+def build_algorithm(name: str, **kwargs) -> "AlgorithmBase":
+    try:
+        cls = _ALGO_REGISTRY[name.upper()]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r}; registered: {sorted(_ALGO_REGISTRY)}"
+        ) from None
+    return cls(**kwargs)
+
+
+def registered_algorithms() -> list[str]:
+    return sorted(_ALGO_REGISTRY)
+
+
+class AlgorithmBase(abc.ABC):
+    """Host-side orchestration wrapper around a pure jitted learner step."""
+
+    # -- reference contract (BaseAlgorithm.py:4-39) --
+    @abc.abstractmethod
+    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
+        """Ingest one episode; returns True when a train step ran (the
+        training server publishes a new model on True, mirroring
+        training_zmq.rs:1016-1029)."""
+
+    @abc.abstractmethod
+    def train_model(self) -> Mapping[str, Any]:
+        """Run one epoch update; returns metrics."""
+
+    @abc.abstractmethod
+    def save(self, path) -> None:
+        """Write the distributable model artifact (ref: torch.jit.save)."""
+
+    @abc.abstractmethod
+    def log_epoch(self) -> None:
+        """Dump the epoch's tabular diagnostics."""
+
+    # -- TPU-native surface --
+    def _jitted_policy_step(self):
+        """``self.policy.step`` jitted once per instance — rebuilding the
+        wrapper per call would bypass the compile cache and retrace every
+        action."""
+        if getattr(self, "_jit_step_fn", None) is None:
+            import jax
+
+            self._jit_step_fn = jax.jit(self.policy.step)
+        return self._jit_step_fn
+
+    @abc.abstractmethod
+    def bundle(self) -> ModelBundle:
+        """Current policy as a versioned transportable bundle."""
+
+    @property
+    @abc.abstractmethod
+    def version(self) -> int:
+        """Monotonic model version (bumped once per train step)."""

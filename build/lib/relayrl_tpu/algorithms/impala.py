@@ -1,0 +1,134 @@
+"""IMPALA-style async A2C with V-trace, as one jitted XLA program.
+
+Beyond-reference capability (the reference has a single synchronous learner
+fed by one socket — SURVEY.md §3.3): this learner is built for a fleet of
+async actors running stale policies — the BASELINE.md north-star config
+"IMPALA-style async A2C, 256 actors". Each trajectory carries the behavior
+policy's ``logp_a``; the update importance-weights it to the current policy
+with clipped V-trace ratios, then takes one combined A2C step (policy
+gradient on the rho-clipped advantage + value MSE to the vs targets +
+entropy bonus) with a single optimizer.
+
+Staleness tolerance is the whole point: ``receive_trajectory`` trains on
+every ``traj_per_epoch`` batch regardless of which model version produced
+it, and publishes after every update so the actor fleet continuously
+hot-swaps (the version-gated swap path of runtime/policy_actor.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from relayrl_tpu.algorithms.base import register_algorithm
+from relayrl_tpu.algorithms.onpolicy import OnPolicyAlgorithm
+from relayrl_tpu.models import build_policy
+from relayrl_tpu.ops.gae import masked_mean_std
+from relayrl_tpu.ops.vtrace import vtrace
+
+
+class ImpalaState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    rng: jax.Array  # host-side sampling key for act(); unused by the update
+    step: jax.Array
+
+
+def make_impala_update(policy, lr: float, gamma: float, vf_coef: float,
+                       ent_coef: float, rho_bar: float, c_bar: float,
+                       max_grad_norm: float):
+    tx = optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adam(lr),
+    )
+
+    def update(state: ImpalaState, batch: Mapping[str, jax.Array]):
+        obs, act, act_mask = batch["obs"], batch["act"], batch["act_mask"]
+        rew, valid = batch["rew"], batch["valid"]
+        behavior_logp = batch["logp"]
+        last_val = batch["last_val"]
+        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+
+        def loss_fn(params):
+            logp, ent, v = policy.evaluate(params, obs, act, act_mask)
+            vt = vtrace(behavior_logp, jax.lax.stop_gradient(logp), rew,
+                        jax.lax.stop_gradient(v), valid, gamma,
+                        last_val=last_val, rho_bar=rho_bar, c_bar=c_bar)
+            pg_loss = -jnp.sum(logp * vt.pg_adv * valid) / n_valid
+            vf_loss = jnp.sum(jnp.square(v - vt.vs) * valid) / n_valid
+            ent_mean = jnp.sum(ent * valid) / n_valid
+            total = pg_loss + vf_coef * vf_loss - ent_coef * ent_mean
+            return total, (pg_loss, vf_loss, ent_mean, vt.rho, logp)
+
+        (total, (pg_loss, vf_loss, ent_mean, rho, logp_new)), grads = (
+            jax.value_and_grad(loss_fn, has_aux=True)(state.params))
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        rho_mean, _ = masked_mean_std(rho, valid)
+        kl = jnp.sum((behavior_logp - logp_new) * valid) / n_valid
+        metrics = {
+            "LossPi": pg_loss,
+            "LossV": vf_loss,
+            "Entropy": ent_mean,
+            "LossTotal": total,
+            "RhoMean": rho_mean,
+            "KL": kl,
+        }
+        return ImpalaState(params=params, opt_state=opt_state, rng=state.rng,
+                           step=state.step + 1), metrics
+
+    return update
+
+
+@register_algorithm("IMPALA")
+class IMPALA(OnPolicyAlgorithm):
+    """Host orchestration: same epoch-buffer ingest as REINFORCE/PPO, but
+    the update is staleness-corrected so it works with many async actors."""
+
+    ALGO_NAME = "IMPALA"
+
+    def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
+        kind = str(params.get("model_kind",
+                              "mlp_discrete" if self.discrete
+                              else "mlp_continuous"))
+        self.arch = {
+            "kind": kind,
+            "obs_dim": self.obs_dim,
+            "act_dim": self.act_dim,
+            "hidden_sizes": list(params.get("hidden_sizes", [128, 128])),
+            "has_critic": True,
+            "precision": str(learner.get("precision", "float32")),
+        }
+        if kind == "cnn_discrete" and "obs_shape" in params:
+            self.arch["obs_shape"] = list(params["obs_shape"])
+        self.policy = build_policy(self.arch)
+
+        init_rng, state_rng = jax.random.split(rng)
+        net_params = self.policy.init_params(init_rng)
+        lr = float(params.get("lr", 3e-4))
+        tx = optax.chain(
+            optax.clip_by_global_norm(float(params.get("max_grad_norm", 40.0))),
+            optax.adam(lr),
+        )
+        self.state = ImpalaState(
+            params=net_params,
+            opt_state=tx.init(net_params),
+            rng=state_rng,
+            step=jnp.int32(0),
+        )
+        update = make_impala_update(
+            self.policy, lr=lr, gamma=self.gamma,
+            vf_coef=float(params.get("vf_coef", 0.5)),
+            ent_coef=float(params.get("ent_coef", 0.01)),
+            rho_bar=float(params.get("rho_bar", 1.0)),
+            c_bar=float(params.get("c_bar", 1.0)),
+            max_grad_norm=float(params.get("max_grad_norm", 40.0)))
+        self._update = jax.jit(update, donate_argnums=0)
+
+    def _log_keys(self):
+        return ("LossPi", "LossV", "Entropy", "RhoMean", "KL")

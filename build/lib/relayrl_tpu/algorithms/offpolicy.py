@@ -1,0 +1,226 @@
+"""Shared host-side orchestration for the off-policy algorithm family.
+
+The reference registry whitelists C51/DDPG/DQN/SAC/TD3 without implementing
+them (reference: relayrl_framework/src/sys_utils/config_loader.rs:148-159);
+each of those here is a thin subclass of this base: transitions stream into
+a :class:`~relayrl_tpu.data.StepReplayBuffer`, and after a warmup the
+learner runs jitted gradient steps per received trajectory (the
+"update-to-data ratio"), publishing a fresh actor policy each time
+(``receive_trajectory -> True`` drives the server's model push exactly as
+for the on-policy family — training_zmq.rs:1016-1029 behavior).
+
+Subclasses implement ``_setup`` (build policy/arch/state + the pure jitted
+``(state, batch) -> (state, metrics)`` update) and ``_actor_params``
+(the slice of learner state that ships to actors in the ModelBundle).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from relayrl_tpu.algorithms.base import AlgorithmBase
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.data.step_buffer import StepReplayBuffer
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle
+from relayrl_tpu.utils import EpochLogger, setup_logger_kwargs
+
+
+def polyak_update(online_params, target_params, polyak: float):
+    """target <- polyak * target + (1 - polyak) * online (SpinningUp
+    convention: polyak close to 1 means slow targets)."""
+    return optax.incremental_update(online_params, target_params,
+                                    step_size=1.0 - polyak)
+
+
+class OffPolicyAlgorithm(AlgorithmBase):
+    """Transition-replay learner loop shared by DQN/C51/DDPG/TD3/SAC."""
+
+    ALGO_NAME = "OFFPOLICY"  # subclasses override
+    DEFAULT_DISCRETE = True
+
+    def __init__(
+        self,
+        env_dir: str | None = None,
+        config_path: str | None = None,
+        obs_dim: int = 4,
+        act_dim: int = 2,
+        buf_size: int | None = None,
+        logger_kwargs: Mapping[str, Any] | None = None,
+        **overrides,
+    ):
+        loader = ConfigLoader(self.ALGO_NAME, config_path,
+                              create_if_missing=False)
+        params = loader.get_algorithm_params()
+        params.update(overrides)
+        learner = loader.get_learner_params()
+
+        self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
+        self.gamma = float(params.get("gamma", 0.99))
+        self.polyak = float(params.get("polyak", 0.995))
+        self.batch_size = int(params.get("batch_size", 256))
+        self.update_after = int(params.get("update_after", 1000))
+        self.updates_per_step = float(params.get("updates_per_step", 1.0))
+        # Bound on jitted updates per receive_trajectory call: a long
+        # episode past warmup owes stored*updates_per_step updates, but
+        # running them all inside one ingest call starves the ingest queue
+        # and delays the model publish for the whole burst. The backlog is
+        # carried in ``_update_debt`` and amortized across future calls.
+        self.max_updates_per_ingest = int(
+            params.get("max_updates_per_ingest", 64))
+        if self.max_updates_per_ingest < 1:
+            raise ValueError(
+                "max_updates_per_ingest must be >= 1 (it bounds the jitted "
+                "updates run per ingest call; use updates_per_step=0 to "
+                "disable training on ingest)")
+        self._update_debt = 0.0
+        self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
+        seed = int(params.get("seed", 1))
+        # Param init is deterministic given the seed (reproducible learners);
+        # only the action-sampling stream folds in the pid so concurrent
+        # actor processes explore differently.
+        self._rng_init = jax.random.PRNGKey(seed)
+        self._rng_state = jax.random.fold_in(
+            jax.random.PRNGKey(seed ^ 0x5EED), os.getpid())
+
+        self.buffer = StepReplayBuffer(
+            obs_dim=self.obs_dim,
+            act_dim=self.act_dim,
+            capacity=int(buf_size or params.get("buffer_size", 100_000)),
+            discrete=bool(params.get("discrete", self.DEFAULT_DISCRETE)),
+            seed=seed,
+        )
+
+        # Subclass: sets self.policy, self.arch, self.state, self._update.
+        self._setup(params, learner)
+
+        lk = dict(logger_kwargs) if logger_kwargs else setup_logger_kwargs(
+            f"relayrl-{self.ALGO_NAME.lower()}", seed,
+            data_dir=os.path.join(env_dir or ".", "logs"))
+        self.logger = EpochLogger(**lk)
+        self.logger.save_config({"algorithm": self.ALGO_NAME, **params,
+                                 "obs_dim": obs_dim, "act_dim": act_dim})
+        self.epoch = 0
+        self._traj_since_log = 0
+        self._ep_returns: list[float] = []
+        self._ep_lengths: list[int] = []
+        self._last_metrics: dict[str, float] = {}
+        self.server_model_path = loader.get_server_model_path()
+
+    # -- subclass contract --
+    def _setup(self, params: dict, learner: dict) -> None:
+        raise NotImplementedError
+
+    def _actor_params(self):
+        """Slice of self.state that the registered policy kind applies."""
+        raise NotImplementedError
+
+    def _publish_arch(self) -> dict:
+        """Arch shipped with the bundle (hook for annealing exploration)."""
+        return self.arch
+
+    def _metric_keys(self) -> Sequence[str]:
+        return ("LossQ",)
+
+    # -- reference contract --
+    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
+        if not actions or all(a.act is None for a in actions):
+            # Empty or marker-only (a capacity flush can strand the
+            # terminal marker in its own send) — no steps to store, and
+            # logging it would record a phantom zero-length episode.
+            return False
+        rew_total = float(sum(a.rew for a in actions))
+        stored = self.buffer.add_episode(actions)
+        self._ep_returns.append(rew_total)
+        self._ep_lengths.append(stored)
+        self._traj_since_log += 1
+        trained = False
+        if (self.updates_per_step > 0
+                and self.buffer.total_steps >= self.update_after
+                and stored > 0):
+            self._update_debt += stored * self.updates_per_step
+            n = min(self.max_updates_per_ingest,
+                    max(1, int(self._update_debt)))
+            self._train_batches(n)
+            self._update_debt = max(0.0, self._update_debt - n)
+            trained = True
+        if self._traj_since_log >= self.traj_per_epoch:
+            self.log_epoch()
+        return trained
+
+    def train_model(self) -> Mapping[str, float]:
+        self._train_batches(1)
+        return self._last_metrics
+
+    def _train_batches(self, n: int) -> None:
+        for _ in range(int(n)):
+            batch = self.buffer.sample(self.batch_size)
+            device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.state, metrics = self._update(self.state, device_batch)
+        self._last_metrics = {k: float(v) for k, v in metrics.items()}
+        self.logger.store(**self._last_metrics)
+
+    def log_epoch(self) -> None:
+        self.epoch += 1
+        self._traj_since_log = 0
+        self.logger.store(EpRet=self._ep_returns or [0.0],
+                          EpLen=self._ep_lengths or [0])
+        self._ep_returns, self._ep_lengths = [], []
+        self.logger.log_tabular("Epoch", self.epoch)
+        self.logger.log_tabular("EpRet", with_min_and_max=True)
+        self.logger.log_tabular("EpLen", average_only=True)
+        self.logger.log_tabular("TotalEnvInteracts", self.buffer.total_steps)
+        for key in self._metric_keys():
+            self.logger.log_tabular(key, self._last_metrics.get(key, 0.0))
+        self.logger.dump_tabular()
+
+    def save(self, path=None) -> None:
+        self.bundle().save(path or self.server_model_path)
+
+    def bundle(self) -> ModelBundle:
+        host_params = jax.device_get(self._actor_params())
+        return ModelBundle(version=self.version, arch=self._publish_arch(),
+                           params=host_params)
+
+    @property
+    def version(self) -> int:
+        return int(self.state.step)
+
+    # convenience for in-process actors/tests
+    def act(self, obs, mask=None):
+        from relayrl_tpu.types.model_bundle import exploration_kwargs
+
+        self._rng_state, sub = jax.random.split(self._rng_state)
+        # Current (possibly annealed) exploration knobs ride as traced args.
+        explore = exploration_kwargs(self._publish_arch())
+        act, aux = self._jitted_policy_step()(
+            self._actor_params(), sub, jnp.asarray(obs), mask, **explore)
+        return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}
+
+
+class EpsilonGreedyMixin:
+    """Linear epsilon annealing shared by the epsilon-greedy family
+    (DQN/C51): parse the schedule in ``_setup`` via ``_setup_epsilon``,
+    publish the current value in the bundle arch."""
+
+    def _setup_epsilon(self, params: dict) -> float:
+        self.eps_start = float(params.get("epsilon_start", 1.0))
+        self.eps_end = float(params.get("epsilon_end", 0.05))
+        self.eps_decay_steps = int(params.get("epsilon_decay_steps", 10_000))
+        return self.eps_start
+
+    def current_epsilon(self) -> float:
+        frac = min(1.0, self.buffer.total_steps / max(1, self.eps_decay_steps))
+        return self.eps_start + frac * (self.eps_end - self.eps_start)
+
+    def _publish_arch(self) -> dict:
+        return {**self.arch, "epsilon": self.current_epsilon()}
+
+    def _metric_keys(self):
+        return ("LossQ", "QVals")

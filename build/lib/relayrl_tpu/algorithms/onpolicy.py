@@ -1,0 +1,137 @@
+"""Shared host-side orchestration for the on-policy algorithm family.
+
+REINFORCE, PPO, and IMPALA share one loop (the reference runs it inside its
+learner subprocess — relayrl_framework/src/native/python/algorithms/
+REINFORCE/REINFORCE.py:70-95: buffer episodes, train every
+``traj_per_epoch``, log, save): episodes stream into an
+:class:`~relayrl_tpu.data.EpochBuffer`, full epochs drain into one jitted
+update, and ``receive_trajectory -> True`` drives the server's model
+publish. Subclasses implement ``_setup`` (arch/policy/state + the pure
+jitted ``(state, batch) -> (state, metrics)`` update) and ``_log_keys``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relayrl_tpu.algorithms.base import AlgorithmBase
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.data import EpochBuffer
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle
+from relayrl_tpu.utils import EpochLogger, setup_logger_kwargs
+
+
+class OnPolicyAlgorithm(AlgorithmBase):
+    """Epoch-buffer learner loop shared by REINFORCE/PPO/IMPALA."""
+
+    ALGO_NAME = "ONPOLICY"  # subclasses override
+
+    def __init__(
+        self,
+        env_dir: str | None = None,
+        config_path: str | None = None,
+        obs_dim: int = 4,
+        act_dim: int = 2,
+        buf_size: int | None = None,
+        logger_kwargs: Mapping[str, Any] | None = None,
+        **overrides,
+    ):
+        loader = ConfigLoader(self.ALGO_NAME, config_path,
+                              create_if_missing=False)
+        params = loader.get_algorithm_params()
+        params.update(overrides)
+        learner = loader.get_learner_params()
+
+        self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
+        self.discrete = bool(params.get("discrete", True))
+        self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
+        self.gamma = float(params.get("gamma", 0.99))
+        seed = int(params.get("seed", 1))
+        # Ref seeds `seed + 10000 * proc_id` (REINFORCE.py:40-42); fold_in is
+        # the JAX-native equivalent with better key hygiene.
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), os.getpid())
+
+        # Subclass: sets self.arch, self.policy, self.state, self._update.
+        self._setup(params, learner, rng)
+
+        self.buffer = EpochBuffer(
+            obs_dim=self.obs_dim,
+            act_dim=self.act_dim,
+            traj_per_epoch=self.traj_per_epoch,
+            discrete=self.discrete,
+            buckets=learner.get("bucket_lengths", (64, 256, 1000)),
+            max_traj_length=loader.get_max_traj_length(),
+        )
+
+        lk = dict(logger_kwargs) if logger_kwargs else setup_logger_kwargs(
+            f"relayrl-{self.ALGO_NAME.lower()}", seed,
+            data_dir=os.path.join(env_dir or ".", "logs"))
+        self.logger = EpochLogger(**lk)
+        self.logger.save_config({"algorithm": self.ALGO_NAME, **params,
+                                 "obs_dim": obs_dim, "act_dim": act_dim})
+        self.epoch = 0
+        self._last_metrics: dict[str, float] = {}
+        self.server_model_path = loader.get_server_model_path()
+
+    # -- subclass contract --
+    def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
+        raise NotImplementedError
+
+    def _log_keys(self) -> Sequence[str]:
+        return ("LossPi",)
+
+    # -- reference contract --
+    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
+        if not actions or all(a.act is None for a in actions):
+            # Marker-only trajectories (stranded by a capacity flush)
+            # carry no steps; padding would raise on the empty fold.
+            return False
+        if self.buffer.add_episode(actions):
+            self.train_model()
+            self.log_epoch()
+            return True
+        return False
+
+    def train_model(self) -> Mapping[str, float]:
+        batch = self.buffer.drain()
+        device_batch = {k: jnp.asarray(v) for k, v in batch.as_dict().items()}
+        self.state, metrics = self._update(self.state, device_batch)
+        self._last_metrics = {k: float(v) for k, v in metrics.items()}
+        return self._last_metrics
+
+    def log_epoch(self) -> None:
+        rets, lens = self.buffer.pop_episode_stats()
+        self.epoch += 1
+        self.logger.store(EpRet=rets or [0.0], EpLen=lens or [0])
+        self.logger.log_tabular("Epoch", self.epoch)
+        self.logger.log_tabular("EpRet", with_min_and_max=True)
+        self.logger.log_tabular("EpLen", average_only=True)
+        for key in self._log_keys():
+            self.logger.log_tabular(key, self._last_metrics.get(key, 0.0))
+        self.logger.dump_tabular()
+
+    def save(self, path=None) -> None:
+        self.bundle().save(path or self.server_model_path)
+
+    def bundle(self) -> ModelBundle:
+        host_params = jax.device_get(self.state.params)
+        return ModelBundle(version=self.version, arch=self.arch,
+                           params=host_params)
+
+    @property
+    def version(self) -> int:
+        return int(self.state.step)
+
+    # convenience for in-process actors/tests
+    def act(self, obs, mask=None):
+        rng, sub = jax.random.split(self.state.rng)
+        self.state = self.state.replace(rng=rng)
+        act, aux = self._jitted_policy_step()(self.state.params, sub,
+                                              jnp.asarray(obs), mask)
+        return np.asarray(act), {k: np.asarray(v) for k, v in aux.items()}

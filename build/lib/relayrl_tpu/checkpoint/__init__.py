@@ -1,0 +1,9 @@
+"""Checkpoint/resume (full train state, async orbax saves)."""
+
+from relayrl_tpu.checkpoint.manager import (
+    CheckpointManager,
+    checkpoint_algorithm,
+    restore_algorithm,
+)
+
+__all__ = ["CheckpointManager", "checkpoint_algorithm", "restore_algorithm"]

@@ -1,0 +1,106 @@
+"""Checkpoint/resume of the full learner state.
+
+The reference's only checkpoint is the TorchScript policy file — restarting
+the server loses optimizer/buffer/epoch state (SURVEY.md §5.4; its
+Logger.save_state is dead code referencing an unimported joblib,
+utils/logger.py:200-229). Here a checkpoint is the complete train state:
+params, both optimizer states, RNG key, step counter, plus host-side
+counters (epoch, model version), via orbax with async save.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+from typing import Any
+
+import jax
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: numbered step directories + latest-step resume."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = osp.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, extra: dict | None = None,
+             wait: bool = False) -> None:
+        """Async save of the state pytree (+ JSON-able extras)."""
+        import orbax.checkpoint as ocp
+
+        args = {
+            "state": ocp.args.StandardSave(state),
+            # always present so restore() can ask for it unconditionally
+            "extra": ocp.args.JsonSave(extra if extra is not None else {}),
+        }
+        self._mgr.save(step, args=ocp.args.Composite(**args))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: int | None = None
+                ) -> tuple[Any, dict]:
+        """Restore (state, extra) at ``step`` (default latest)."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(state_template),
+                extra=ocp.args.JsonRestore(),
+            ),
+        )
+        extra = dict(restored.get("extra") or {})
+        return restored["state"], extra
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def checkpoint_algorithm(algo, directory: str | None = None,
+                         wait: bool = False) -> CheckpointManager:
+    """Save an algorithm's full state (convenience used by the server)."""
+    directory = directory or osp.join(".", "checkpoints")
+    mgr = getattr(algo, "_ckpt_mgr", None)
+    if mgr is None or mgr.directory != osp.abspath(directory):
+        mgr = CheckpointManager(directory)
+        algo._ckpt_mgr = mgr
+    extra = {
+        "epoch": int(getattr(algo, "epoch", 0)),
+        "version": int(algo.version),
+        "arch": algo.arch,
+    }
+    mgr.save(int(algo.version), jax.device_get(algo.state), extra, wait=wait)
+    return mgr
+
+
+def restore_algorithm(algo, directory: str | None = None,
+                      step: int | None = None) -> None:
+    """Restore a previously checkpointed algorithm in place."""
+    directory = directory or osp.join(".", "checkpoints")
+    mgr = CheckpointManager(directory)
+    state, extra = mgr.restore(jax.device_get(algo.state), step)
+    if extra.get("arch") and json.dumps(extra["arch"], sort_keys=True) != \
+            json.dumps(algo.arch, sort_keys=True):
+        raise ValueError(
+            f"checkpoint arch {extra.get('arch')} != algorithm arch {algo.arch}")
+    algo.state = jax.device_put(state)
+    algo.epoch = int(extra.get("epoch", 0))
+    mgr.close()

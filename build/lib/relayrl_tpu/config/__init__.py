@@ -1,0 +1,23 @@
+"""Config system (ref layer L2, SURVEY.md §1)."""
+
+from relayrl_tpu.config.default_config import (
+    DEFAULT_CONFIG,
+    SUPPORTED_ALGORITHMS,
+    default_config,
+)
+from relayrl_tpu.config.loader import (
+    DEFAULT_CONFIG_FILENAME,
+    ConfigLoader,
+    Endpoint,
+    resolve_config_path,
+)
+
+__all__ = [
+    "DEFAULT_CONFIG",
+    "SUPPORTED_ALGORITHMS",
+    "default_config",
+    "ConfigLoader",
+    "Endpoint",
+    "resolve_config_path",
+    "DEFAULT_CONFIG_FILENAME",
+]

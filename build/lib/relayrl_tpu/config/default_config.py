@@ -1,0 +1,196 @@
+"""Embedded default configuration.
+
+Schema parity with the reference's embedded default
+(reference: relayrl_framework/src/default_config.json and the
+DEFAULT_CONFIG_CONTENT string in src/sys_utils/config_loader.rs:66-113):
+per-algorithm hyperparams, three endpoint addresses, model paths, tensorboard
+settings, max trajectory length. TPU-native additions live under "learner"
+(mesh/batching knobs absent from the reference, which has no device story).
+
+Model artifacts are `.rlx` ModelBundles (params + arch + version), not
+TorchScript `.pt`.
+"""
+
+from __future__ import annotations
+
+import copy
+
+DEFAULT_CONFIG: dict = {
+    "algorithms": {
+        "REINFORCE": {
+            "discrete": True,
+            "with_vf_baseline": False,
+            "seed": 1,
+            "traj_per_epoch": 8,
+            "gamma": 0.98,
+            "lam": 0.97,
+            "pi_lr": 3e-4,
+            "vf_lr": 1e-3,
+            "train_vf_iters": 80,
+            "hidden_sizes": [128, 128],
+        },
+        "PPO": {
+            "discrete": True,
+            "seed": 1,
+            "traj_per_epoch": 8,
+            "gamma": 0.99,
+            "lam": 0.95,
+            "clip_ratio": 0.2,
+            "pi_lr": 3e-4,
+            "vf_lr": 1e-3,
+            "train_iters": 4,
+            "minibatch_count": 4,
+            "ent_coef": 0.0,
+            "vf_coef": 0.5,
+            "target_kl": 0.015,
+            "hidden_sizes": [128, 128],
+        },
+        "DQN": {
+            "discrete": True,
+            "seed": 1,
+            "gamma": 0.99,
+            "lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "double_q": True,
+            "epsilon_start": 1.0,
+            "epsilon_end": 0.05,
+            "epsilon_decay_steps": 10_000,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "C51": {
+            "discrete": True,
+            "seed": 1,
+            "gamma": 0.99,
+            "lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "n_atoms": 51,
+            "v_min": -10.0,
+            "v_max": 10.0,
+            "epsilon_start": 1.0,
+            "epsilon_end": 0.05,
+            "epsilon_decay_steps": 10_000,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "DDPG": {
+            "discrete": False,
+            "seed": 1,
+            "gamma": 0.99,
+            "pi_lr": 1e-3,
+            "q_lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "act_limit": 1.0,
+            "act_noise": 0.1,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "TD3": {
+            "discrete": False,
+            "seed": 1,
+            "gamma": 0.99,
+            "pi_lr": 1e-3,
+            "q_lr": 1e-3,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "act_limit": 1.0,
+            "act_noise": 0.1,
+            "target_noise": 0.2,
+            "noise_clip": 0.5,
+            "policy_delay": 2,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+        "IMPALA": {
+            "discrete": True,
+            "seed": 1,
+            "traj_per_epoch": 16,
+            "gamma": 0.99,
+            "lr": 3e-4,
+            "vf_coef": 0.5,
+            "ent_coef": 0.01,
+            "rho_bar": 1.0,
+            "c_bar": 1.0,
+            "max_grad_norm": 40.0,
+            "hidden_sizes": [128, 128],
+        },
+        "SAC": {
+            "discrete": False,
+            "seed": 1,
+            "gamma": 0.99,
+            "pi_lr": 3e-4,
+            "q_lr": 3e-4,
+            "alpha_lr": 3e-4,
+            "alpha": 0.2,
+            "batch_size": 256,
+            "buffer_size": 100_000,
+            "update_after": 1000,
+            "updates_per_step": 1.0,
+            "polyak": 0.995,
+            "act_limit": 1.0,
+            "traj_per_epoch": 8,
+            "hidden_sizes": [128, 128],
+        },
+    },
+    "grpc_idle_timeout_s": 30.0,
+    "max_traj_length": 1000,
+    "model_paths": {
+        "client_model": "client_model.rlx",
+        "server_model": "server_model.rlx",
+    },
+    "server": {
+        "training_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "50051"},
+        "trajectory_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7776"},
+        "agent_listener": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7777"},
+    },
+    "training_tensorboard": {
+        "launch_tb_on_startup": False,
+        "scalar_tags": "AverageEpRet;LossPi",
+        "global_step_tag": "Epoch",
+    },
+    "learner": {
+        "batch_trajectories": 8,
+        "bucket_lengths": [64, 256, 1000],
+        "mesh": {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1},
+        # compute dtype for policy trunks: float32 on CPU actors/tests;
+        # set "bfloat16" on TPU learners to feed the MXU (bench configs do).
+        "precision": "float32",
+        "checkpoint_dir": "checkpoints",
+        "checkpoint_every_epochs": 10,
+        # multi-host learner bring-up (jax.distributed); single-process when
+        # coordinator is null. Env overrides: RELAYRL_COORDINATOR,
+        # RELAYRL_NUM_PROCESSES. The per-host rank is deliberately NOT a
+        # config key (configs are shared between hosts): set
+        # RELAYRL_PROCESS_ID per host or pass process_id= explicitly.
+        "distributed": {
+            "coordinator": None,
+            "num_processes": 1,
+        },
+    },
+}
+
+# Algorithm whitelist, matching the reference's registry
+# (config_loader.rs:397-433 lists C51/DDPG/DQN/PPO/REINFORCE/SAC/TD3 even
+# though only REINFORCE is implemented there).
+SUPPORTED_ALGORITHMS = (
+    "C51", "DDPG", "DQN", "IMPALA", "PPO", "REINFORCE", "SAC", "TD3",
+)
+
+
+def default_config() -> dict:
+    return copy.deepcopy(DEFAULT_CONFIG)

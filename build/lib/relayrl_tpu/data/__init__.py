@@ -1,0 +1,24 @@
+"""Host-side data staging: padding, bucketing, epoch buffers."""
+
+from relayrl_tpu.data.batching import (
+    PaddedTrajectory,
+    TrajectoryBatch,
+    pad_trajectory,
+    pick_bucket,
+    repad_trajectory,
+    stack_trajectories,
+)
+from relayrl_tpu.data.replay_buffer import DEFAULT_BUCKETS, EpochBuffer
+from relayrl_tpu.data.step_buffer import StepReplayBuffer
+
+__all__ = [
+    "StepReplayBuffer",
+    "PaddedTrajectory",
+    "TrajectoryBatch",
+    "pad_trajectory",
+    "pick_bucket",
+    "repad_trajectory",
+    "stack_trajectories",
+    "EpochBuffer",
+    "DEFAULT_BUCKETS",
+]

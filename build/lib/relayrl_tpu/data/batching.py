@@ -1,0 +1,214 @@
+"""Variable-length trajectories → fixed-shape padded/masked batches.
+
+The reference pickles arbitrary-length ``Vec<RelayRLAction>`` and loops over
+actions in Python (reference: relayrl_framework/src/native/python/algorithms/
+REINFORCE/REINFORCE.py:70-95 unpacks one action at a time into the buffer).
+Under XLA every distinct shape is a recompilation, so here trajectories are
+padded to **bucketed** lengths with a validity mask and stacked into
+``[B, T, ...]`` batches — the learner compiles once per bucket, not once per
+episode length (SURVEY.md §7.4 item 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from relayrl_tpu.types.action import ActionRecord
+
+
+@dataclasses.dataclass
+class PaddedTrajectory:
+    """One episode padded to ``T`` with host (numpy) arrays."""
+
+    obs: np.ndarray        # [T, obs_dim] f32
+    act: np.ndarray        # [T] i32 (discrete) or [T, act_dim] f32
+    act_mask: np.ndarray   # [T, act_dim] f32
+    rew: np.ndarray        # [T] f32
+    val: np.ndarray        # [T] f32 — critic value stored at sample time
+    logp: np.ndarray       # [T] f32 — behavior log-prob stored at sample time
+    valid: np.ndarray      # [T] f32
+    length: int
+    terminated: bool       # final action had done=True
+    last_val: float        # bootstrap value for truncated episodes
+
+
+@dataclasses.dataclass
+class TrajectoryBatch:
+    """Stacked episodes ``[B, T, ...]`` — the learner-step input."""
+
+    obs: np.ndarray        # [B, T, obs_dim]
+    act: np.ndarray        # [B, T] or [B, T, act_dim]
+    act_mask: np.ndarray   # [B, T, act_dim]
+    rew: np.ndarray        # [B, T]
+    val: np.ndarray        # [B, T]
+    logp: np.ndarray       # [B, T]
+    valid: np.ndarray      # [B, T]
+    last_val: np.ndarray   # [B]
+
+    @property
+    def batch_size(self) -> int:
+        return self.obs.shape[0]
+
+    @property
+    def horizon(self) -> int:
+        return self.obs.shape[1]
+
+    def as_dict(self) -> dict[str, np.ndarray]:
+        return dataclasses.asdict(self)
+
+
+def fold_trailing_markers(
+    actions: Sequence[ActionRecord],
+) -> tuple[list[ActionRecord], np.ndarray | None, bool, np.ndarray | None]:
+    """Fold ``flag_last_action`` markers (act-less records) into the last
+    real step.
+
+    The marker's reward is added to the preceding step and its done /
+    truncated flags OR-merged in. Returns ``(steps, final_obs, truncated,
+    final_mask)`` where ``final_obs`` is the post-step observation a
+    truncation marker may carry (the off-policy bootstrap successor),
+    ``truncated`` is True if any marker flagged a time-limit ending, and
+    ``final_mask`` is the marker's action mask for that successor state
+    (action-masked envs). Shared by the epoch and step replay buffers so
+    marker semantics cannot diverge between them.
+    """
+    steps = list(actions)
+    final_obs: np.ndarray | None = None
+    final_mask: np.ndarray | None = None
+    truncated = False
+    while steps and steps[-1].act is None:
+        marker = steps.pop()
+        truncated = truncated or marker.truncated
+        if marker.obs is not None:
+            final_obs = np.asarray(marker.obs, np.float32)
+        if marker.mask is not None:
+            final_mask = np.asarray(marker.mask, np.float32)
+        if steps:
+            last = steps[-1]
+            steps[-1] = ActionRecord(
+                obs=last.obs, act=last.act, mask=last.mask,
+                rew=last.rew + marker.rew, data=last.data,
+                done=last.done or marker.done,
+                truncated=last.truncated or marker.truncated,
+            )
+    return steps, final_obs, truncated, final_mask
+
+
+def pick_bucket(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket ≥ length (lengths above the last bucket clamp to it)."""
+    for b in sorted(buckets):
+        if length <= b:
+            return int(b)
+    return int(max(buckets))
+
+
+def pad_trajectory(
+    actions: Sequence[ActionRecord],
+    horizon: int,
+    obs_dim: int,
+    act_dim: int,
+    discrete: bool = True,
+) -> PaddedTrajectory:
+    """ActionRecords → fixed-shape padded arrays.
+
+    Aux ``logp_a``/``v`` come from the action's data dict (the reference's
+    REINFORCE reads ``data['v']``/``data['logp_a']`` the same way). Episodes
+    longer than ``horizon`` are truncated (bootstrapped from the stored value
+    of the last kept step).
+    """
+    if not actions:
+        raise ValueError("empty trajectory")
+    # ``flag_last_action`` terminates an episode with a marker record that
+    # carries only the final reward + done flag (no obs/act — ref:
+    # agent_zmq.rs:605-610). Markers are not steps: fold their reward into
+    # the preceding real step so the policy-gradient loss never sees a
+    # fictitious action at a zero observation.
+    actions, _, _, _ = fold_trailing_markers(actions)
+    if not actions:
+        raise ValueError("trajectory contained only terminal markers")
+    n = min(len(actions), horizon)
+
+    obs = np.zeros((horizon, obs_dim), dtype=np.float32)
+    act = np.zeros((horizon,), dtype=np.int32) if discrete else np.zeros(
+        (horizon, act_dim), dtype=np.float32)
+    act_mask = np.zeros((horizon, act_dim), dtype=np.float32)
+    act_mask[:n] = 1.0
+    rew = np.zeros((horizon,), dtype=np.float32)
+    val = np.zeros((horizon,), dtype=np.float32)
+    logp = np.zeros((horizon,), dtype=np.float32)
+    valid = np.zeros((horizon,), dtype=np.float32)
+
+    for t in range(n):
+        a = actions[t]
+        if a.obs is not None:
+            obs[t] = np.asarray(a.obs, dtype=np.float32).reshape(-1)[:obs_dim]
+        if a.act is not None:
+            if discrete:
+                act[t] = int(np.asarray(a.act).reshape(-1)[0])
+            else:
+                act[t] = np.asarray(a.act, dtype=np.float32).reshape(-1)[:act_dim]
+        if a.mask is not None:
+            act_mask[t] = np.asarray(a.mask, dtype=np.float32).reshape(-1)[:act_dim]
+        rew[t] = float(a.rew)
+        data = a.data or {}
+        val[t] = float(np.asarray(data.get("v", 0.0)).reshape(-1)[0]) if "v" in data else 0.0
+        logp[t] = (
+            float(np.asarray(data.get("logp_a", 0.0)).reshape(-1)[0])
+            if "logp_a" in data else 0.0
+        )
+        valid[t] = 1.0
+
+    # ``terminated`` means a true terminal state: the value target stops
+    # there. A time-limit truncation (Gymnasium ``truncated``) must still
+    # bootstrap — v(s_{T+1}) is unavailable on the wire, so the stored
+    # v(s_T) is the standard stand-in (the reference never bootstraps:
+    # finish_path(last_val=0)).
+    terminated = (bool(actions[n - 1].done)
+                  and not bool(actions[n - 1].truncated)
+                  and n == len(actions))
+    last_val = 0.0 if terminated else float(val[n - 1])
+    return PaddedTrajectory(
+        obs=obs, act=act, act_mask=act_mask, rew=rew, val=val, logp=logp,
+        valid=valid, length=n, terminated=terminated, last_val=last_val,
+    )
+
+
+def stack_trajectories(trajs: Sequence[PaddedTrajectory]) -> TrajectoryBatch:
+    """Same-horizon padded episodes → one ``[B, T, ...]`` batch."""
+    horizons = {t.obs.shape[0] for t in trajs}
+    if len(horizons) != 1:
+        raise ValueError(f"mixed horizons in batch: {sorted(horizons)}")
+    return TrajectoryBatch(
+        obs=np.stack([t.obs for t in trajs]),
+        act=np.stack([t.act for t in trajs]),
+        act_mask=np.stack([t.act_mask for t in trajs]),
+        rew=np.stack([t.rew for t in trajs]),
+        val=np.stack([t.val for t in trajs]),
+        logp=np.stack([t.logp for t in trajs]),
+        valid=np.stack([t.valid for t in trajs]),
+        last_val=np.asarray([t.last_val for t in trajs], dtype=np.float32),
+    )
+
+
+def repad_trajectory(traj: PaddedTrajectory, horizon: int) -> PaddedTrajectory:
+    """Grow (or validate) a padded episode to a new horizon."""
+    cur = traj.obs.shape[0]
+    if cur == horizon:
+        return traj
+    if cur > horizon:
+        raise ValueError(f"cannot shrink padded trajectory {cur} -> {horizon}")
+    pad = horizon - cur
+
+    def _grow(arr):
+        width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, width)
+
+    return PaddedTrajectory(
+        obs=_grow(traj.obs), act=_grow(traj.act), act_mask=_grow(traj.act_mask),
+        rew=_grow(traj.rew), val=_grow(traj.val), logp=_grow(traj.logp),
+        valid=_grow(traj.valid), length=traj.length, terminated=traj.terminated,
+        last_val=traj.last_val,
+    )

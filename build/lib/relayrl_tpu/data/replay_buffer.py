@@ -1,0 +1,91 @@
+"""Epoch buffer: accumulate episodes host-side, emit device-ready batches.
+
+Capability parity with the reference's REINFORCE buffer
+(reference: relayrl_framework/src/native/python/algorithms/REINFORCE/
+replay_buffer.py — per-step store, GAE on finish_path at :48-79, normalized
+get() at :81-111), restructured for TPU: the host buffer only pads and
+stacks; **all math (GAE, normalization) happens inside the jitted learner
+step on device** so ingest overlaps compute and nothing round-trips
+(SURVEY.md §7.4 item 1).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from relayrl_tpu.data.batching import (
+    PaddedTrajectory,
+    TrajectoryBatch,
+    pad_trajectory,
+    pick_bucket,
+    repad_trajectory,
+    stack_trajectories,
+)
+from relayrl_tpu.types.action import ActionRecord
+
+DEFAULT_BUCKETS = (64, 256, 1000)
+
+
+class EpochBuffer:
+    """Collects ``traj_per_epoch`` episodes, then drains one batch.
+
+    Bucketing: each episode pads to the smallest configured bucket that fits;
+    the drained batch uses the largest bucket present, so the learner step
+    compiles once per (batch_size, bucket) pair.
+    """
+
+    def __init__(
+        self,
+        obs_dim: int,
+        act_dim: int,
+        traj_per_epoch: int,
+        discrete: bool = True,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_traj_length: int | None = None,
+    ):
+        self.obs_dim = int(obs_dim)
+        self.act_dim = int(act_dim)
+        self.traj_per_epoch = int(traj_per_epoch)
+        self.discrete = bool(discrete)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if max_traj_length is not None:
+            self.buckets = tuple(b for b in self.buckets if b <= max_traj_length) or (
+                int(max_traj_length),
+            )
+        self._pending: list[PaddedTrajectory] = []
+        self.episode_returns: list[float] = []
+        self.episode_lengths: list[int] = []
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    @property
+    def ready(self) -> bool:
+        return len(self._pending) >= self.traj_per_epoch
+
+    def add_episode(self, actions: Sequence[ActionRecord]) -> bool:
+        """Pad + buffer one episode; True when a batch is ready to drain."""
+        bucket = pick_bucket(len(actions), self.buckets)
+        padded = pad_trajectory(
+            actions, bucket, self.obs_dim, self.act_dim, self.discrete
+        )
+        self._pending.append(padded)
+        self.episode_returns.append(float(padded.rew.sum()))
+        self.episode_lengths.append(padded.length)
+        return self.ready
+
+    def drain(self) -> TrajectoryBatch:
+        """Emit the epoch batch (and clear). All episodes re-pad to the
+        largest bucket present so the stack is rectangular."""
+        if not self._pending:
+            raise ValueError("drain() on empty buffer")
+        take = self._pending[: self.traj_per_epoch]
+        self._pending = self._pending[self.traj_per_epoch:]
+        horizon = max(t.obs.shape[0] for t in take)
+        batch = stack_trajectories([repad_trajectory(t, horizon) for t in take])
+        return batch
+
+    def pop_episode_stats(self) -> tuple[list[float], list[int]]:
+        rets, lens = self.episode_returns, self.episode_lengths
+        self.episode_returns, self.episode_lengths = [], []
+        return rets, lens

@@ -1,0 +1,127 @@
+"""Uniform step replay buffer (the off-policy ingest path).
+
+The reference's only buffer is the on-policy epoch buffer of its REINFORCE
+learner (reference: relayrl_framework/src/native/python/_common/_algorithms/
+BaseReplayBuffer.py contract + algorithms/REINFORCE/replay_buffer.py); its
+registry nonetheless whitelists DQN/C51/DDPG/SAC/TD3
+(config_loader.rs:148-159), which need transition replay. This is that
+buffer, TPU-shaped: a fixed-capacity ring of transitions in pinned host
+numpy arrays, sampling fixed-size batches (one jit signature) ready for
+``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from relayrl_tpu.types.action import ActionRecord
+
+
+class StepReplayBuffer:
+    """Ring buffer of ``(obs, act, rew, obs2, done)`` transitions.
+
+    ``add_episode`` unrolls an ActionRecord trajectory: record ``t`` holds
+    ``(obs_t, act_t, rew_t)`` (terminal markers already folded by the caller
+    or carrying their reward here), ``obs2`` comes from record ``t+1``. A
+    time-limit truncation whose marker carries the post-step observation is
+    stored with ``done=0`` and that observation as the bootstrap successor;
+    a truncated final step without one is dropped — its bootstrap target is
+    unknowable without ``obs_{T+1}``.
+    """
+
+    def __init__(self, obs_dim: int, act_dim: int, capacity: int,
+                 discrete: bool = True, seed: int = 0):
+        self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
+        self.capacity = int(capacity)
+        self.discrete = bool(discrete)
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.obs2 = np.zeros((capacity, obs_dim), np.float32)
+        if discrete:
+            self.act = np.zeros((capacity,), np.int32)
+        else:
+            self.act = np.zeros((capacity, act_dim), np.float32)
+        self.mask2 = np.ones((capacity, act_dim), np.float32)
+        self.rew = np.zeros((capacity,), np.float32)
+        self.done = np.zeros((capacity,), np.float32)
+        self.ptr = 0
+        self.size = 0
+        self.total_steps = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def _put(self, obs, act, rew, obs2, done, mask2):
+        i = self.ptr
+        self.obs[i] = obs
+        self.obs2[i] = obs2
+        if self.discrete:
+            self.act[i] = int(np.asarray(act).reshape(-1)[0])
+        else:
+            self.act[i] = np.asarray(act, np.float32).reshape(-1)[: self.act_dim]
+        self.mask2[i] = mask2
+        self.rew[i] = float(rew)
+        self.done[i] = float(done)
+        self.ptr = (i + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+        self.total_steps += 1
+
+    def add_episode(self, actions: Sequence[ActionRecord]) -> int:
+        """Unroll one trajectory into transitions; returns how many stored."""
+        from relayrl_tpu.data.batching import fold_trailing_markers
+
+        # A truncation marker may carry the post-step observation — the
+        # bootstrap successor for the final transition — and its action
+        # mask, so masked bootstrap targets stay legal.
+        steps, final_obs, truncated, final_mask = fold_trailing_markers(actions)
+        stored = 0
+        ones = np.ones((self.act_dim,), np.float32)
+        for t, rec in enumerate(steps):
+            if rec.obs is None or rec.act is None:
+                continue
+            is_last = t == len(steps) - 1
+            if is_last:
+                if truncated or rec.truncated or not rec.done:
+                    # Time-limit ending: the value target must bootstrap
+                    # through the boundary (done=0). That needs a real
+                    # successor obs — without one the transition is
+                    # unknowable and dropped.
+                    if final_obs is None:
+                        break
+                    obs2 = final_obs.reshape(-1)[: self.obs_dim]
+                    mask2 = (ones if final_mask is None
+                             else np.asarray(final_mask, np.float32)
+                             .reshape(-1)[: self.act_dim])
+                    done = 0.0
+                else:
+                    obs2 = np.zeros((self.obs_dim,), np.float32)
+                    mask2 = ones
+                    done = 1.0
+            else:
+                nxt = steps[t + 1]
+                if nxt.obs is None:
+                    continue
+                obs2 = np.asarray(nxt.obs, np.float32).reshape(-1)[: self.obs_dim]
+                mask2 = (np.asarray(nxt.mask, np.float32).reshape(-1)[: self.act_dim]
+                         if nxt.mask is not None else ones)
+                done = 0.0
+            obs = np.asarray(rec.obs, np.float32).reshape(-1)[: self.obs_dim]
+            self._put(obs, rec.act, rec.rew, obs2, done, mask2)
+            stored += 1
+        return stored
+
+    def sample(self, batch_size: int) -> dict[str, np.ndarray]:
+        """Uniform sample of a fixed-size batch (with replacement)."""
+        if self.size == 0:
+            raise ValueError("sample() on empty buffer")
+        idx = self._rng.integers(0, self.size, size=int(batch_size))
+        return {
+            "obs": self.obs[idx],
+            "act": self.act[idx],
+            "rew": self.rew[idx],
+            "obs2": self.obs2[idx],
+            "mask2": self.mask2[idx],
+            "done": self.done[idx],
+        }

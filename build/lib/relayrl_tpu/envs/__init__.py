@@ -1,0 +1,46 @@
+"""Built-in environments.
+
+The reference validates end-to-end on Gymnasium classic-control tasks
+(reference: examples/README.md:125-152 and the 12 example notebooks —
+CartPole, LunarLander). Gymnasium is not a dependency of this image, so the
+framework ships self-contained numpy implementations of the standard
+classic-control dynamics behind the same ``reset``/``step`` API; examples
+and learning tests run anywhere, and a real Gymnasium env drops in
+unchanged (:func:`make` prefers Gymnasium when it is importable).
+"""
+
+from relayrl_tpu.envs.atari import (
+    AtariPreprocessing,
+    SyntheticPixelEnv,
+    make_atari,
+)
+from relayrl_tpu.envs.classic import CartPoleEnv, PendulumEnv
+from relayrl_tpu.envs.spaces import Box, Discrete
+
+_BUILTIN = {
+    "CartPole-v1": CartPoleEnv,
+    "Pendulum-v1": PendulumEnv,
+}
+
+
+def make(env_id: str, **kwargs):
+    """Create an env by id — Gymnasium if installed, else the built-in."""
+    try:
+        import gymnasium
+    except ImportError:
+        gymnasium = None
+    # Dispatch on registry membership, don't catch gymnasium.make errors —
+    # a missing extra (box2d) or bad kwarg must surface, not silently swap
+    # in different dynamics.
+    if gymnasium is not None and env_id in gymnasium.registry:
+        return gymnasium.make(env_id, **kwargs)
+    if env_id in _BUILTIN:
+        return _BUILTIN[env_id](**kwargs)
+    raise ValueError(
+        f"unknown env {env_id!r} (not in gymnasium{'' if gymnasium else ' [not installed]'}); "
+        f"built-ins: {sorted(_BUILTIN)}"
+    )
+
+
+__all__ = ["make", "make_atari", "AtariPreprocessing", "SyntheticPixelEnv",
+           "CartPoleEnv", "PendulumEnv", "Box", "Discrete"]

@@ -1,0 +1,18 @@
+"""Policy model registry (the framework's model-ABI layer).
+
+Importing this package registers the built-in model families; user plugins
+call :func:`register_model` themselves.
+"""
+
+from relayrl_tpu.models.base import (
+    Policy,
+    build_policy,
+    register_model,
+    validate_policy,
+)
+import relayrl_tpu.models.mlp  # noqa: F401  (registers mlp_discrete/continuous)
+import relayrl_tpu.models.cnn  # noqa: F401  (registers cnn_discrete)
+import relayrl_tpu.models.transformer  # noqa: F401  (registers transformer_discrete)
+import relayrl_tpu.models.q_networks  # noqa: F401  (registers qnet/c51/ddpg/sac kinds)
+
+__all__ = ["Policy", "build_policy", "register_model", "validate_policy"]

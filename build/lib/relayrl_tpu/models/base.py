@@ -1,0 +1,95 @@
+"""Model registry + the policy ABI.
+
+The reference's model ABI is a TorchScript module exporting ``step(obs, mask)
+-> (act, {logp_a, v})`` plus ``get_input_dim``/``get_output_dim``, validated
+by a dummy forward on every load (reference: relayrl_framework/src/native/
+python/algorithms/REINFORCE/kernel.py:99-143 and src/network/client/
+agent_wrapper.rs:88-168). TorchScript ships code; JAX params are data-only,
+so here the ABI is an **architecture config** (a JSON-able dict) resolved
+through this registry into a :class:`Policy` — a bundle of pure functions
+that run identically on the TPU learner and on CPU actor hosts (SURVEY.md
+§7.4 item 2).
+
+Arch config schema::
+
+    {"kind": "<registry key>", "obs_dim": int, "act_dim": int, ...}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_REGISTRY: dict[str, Callable[[Mapping[str, Any]], "Policy"]] = {}
+
+
+def register_model(kind: str):
+    def deco(builder):
+        _REGISTRY[kind] = builder
+        return builder
+    return deco
+
+
+def build_policy(arch: Mapping[str, Any]) -> "Policy":
+    kind = arch.get("kind")
+    if kind not in _REGISTRY:
+        raise ValueError(f"unknown model kind {kind!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[kind](arch)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Pure-function policy bundle.
+
+    * ``init_params(rng) -> params``
+    * ``step(params, rng, obs, mask) -> (act, aux)`` — sampling forward;
+      ``aux`` always contains ``logp_a`` and ``v`` (v=0 without a critic),
+      mirroring the reference's step ABI. Works on single obs ``[obs_dim]``
+      or batches ``[..., obs_dim]``.
+    * ``evaluate(params, obs, act, mask) -> (logp, entropy, v)`` — the
+      learner-side forward for loss computation on ``[..., obs_dim]``.
+    * ``mode(params, obs, mask) -> act`` — deterministic action (greedy).
+    """
+
+    arch: dict[str, Any]
+    init_params: Callable
+    step: Callable
+    evaluate: Callable
+    mode: Callable
+
+    @property
+    def input_dim(self) -> int:
+        return int(self.arch["obs_dim"])
+
+    @property
+    def output_dim(self) -> int:
+        return int(self.arch["act_dim"])
+
+    # -- reference getter parity --
+    def get_input_dim(self) -> int:
+        return self.input_dim
+
+    def get_output_dim(self) -> int:
+        return self.output_dim
+
+
+def validate_policy(policy: Policy, params) -> None:
+    """Dummy-forward validation on load (ref: agent_wrapper.rs:88-168 runs a
+    zero-obs ``step`` and asserts the output shape/aux dict)."""
+    obs_shape = policy.arch.get("obs_shape") or (policy.input_dim,)
+    obs = jnp.zeros(tuple(obs_shape), dtype=jnp.float32)
+    mask = jnp.ones((policy.output_dim,), dtype=jnp.float32)
+    act, aux = policy.step(params, jax.random.PRNGKey(0), obs, mask)
+    if not isinstance(aux, dict) or "logp_a" not in aux:
+        raise ValueError("policy step ABI violation: aux dict missing 'logp_a'")
+    act_arr = np.asarray(act)
+    if act_arr.ndim > 1:
+        raise ValueError(f"policy step returned act of rank {act_arr.ndim} for single obs")
+
+
+def mlp_sizes(arch: Mapping[str, Any]) -> tuple[int, ...]:
+    return tuple(int(h) for h in arch.get("hidden_sizes", (128, 128)))

@@ -1,0 +1,328 @@
+"""Value-based and continuous-control model families (off-policy stack).
+
+The reference whitelists C51/DDPG/DQN/SAC/TD3 in its algorithm registry but
+implements none of them (reference: relayrl_framework/src/sys_utils/
+config_loader.rs:148-159 — only REINFORCE parses to params); this module
+supplies the model halves for the full registry, TPU-native.
+
+Two kinds of artifacts:
+
+* **Registered policy kinds** — what ships to actors through
+  :class:`~relayrl_tpu.types.ModelBundle` with the uniform ``step`` ABI:
+  ``qnet_discrete`` (epsilon-greedy over Q), ``c51_discrete``
+  (epsilon-greedy over expected atom values), ``ddpg_continuous``
+  (deterministic tanh actor + Gaussian exploration noise), and
+  ``sac_continuous`` (squashed-Gaussian sampler). Exploration knobs
+  (``epsilon``, ``act_noise``) ride in the arch config so the learner can
+  anneal them per publish without a new code path on the actor.
+* **Learner-only critic modules** — Q(s) / Q(s,a) / twin / distributional
+  heads used inside jitted updates; never serialized to actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from relayrl_tpu.models.base import Policy, mlp_sizes, register_model
+from relayrl_tpu.models.mlp import _MASK_FILL, MLPTrunk, _compute_dtype
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class DiscreteQNet(nn.Module):
+    """obs -> Q[A] (DQN head)."""
+
+    act_dim: int
+    hidden_sizes: Sequence[int]
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
+                     name="q_trunk")(obs)
+        q = nn.Dense(self.act_dim, dtype=self.compute_dtype, name="q_head")(h)
+        return q.astype(jnp.float32)
+
+
+class DistributionalQNet(nn.Module):
+    """obs -> logits[A, n_atoms] (C51 head)."""
+
+    act_dim: int
+    n_atoms: int
+    hidden_sizes: Sequence[int]
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
+                     name="q_trunk")(obs)
+        logits = nn.Dense(self.act_dim * self.n_atoms,
+                          dtype=self.compute_dtype, name="q_head")(h)
+        return logits.astype(jnp.float32).reshape(
+            *logits.shape[:-1], self.act_dim, self.n_atoms)
+
+
+class QValueNet(nn.Module):
+    """(obs, act) -> scalar Q (DDPG/TD3/SAC critic)."""
+
+    hidden_sizes: Sequence[int]
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
+                     name="q_trunk")(x)
+        q = nn.Dense(1, dtype=self.compute_dtype, name="q_head")(h)
+        return jnp.squeeze(q.astype(jnp.float32), axis=-1)
+
+
+class TwinQNet(nn.Module):
+    """Two independent Q(s,a) heads (TD3/SAC clipped double-Q)."""
+
+    hidden_sizes: Sequence[int]
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, act):
+        q1 = QValueNet(self.hidden_sizes, self.compute_dtype, name="q1")(obs, act)
+        q2 = QValueNet(self.hidden_sizes, self.compute_dtype, name="q2")(obs, act)
+        return q1, q2
+
+
+class DeterministicActor(nn.Module):
+    """obs -> tanh-squashed action scaled to act_limit (DDPG/TD3 actor)."""
+
+    act_dim: int
+    act_limit: float
+    hidden_sizes: Sequence[int]
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
+                     name="pi_trunk")(obs)
+        a = nn.Dense(self.act_dim, dtype=self.compute_dtype, name="pi_head")(h)
+        return self.act_limit * jnp.tanh(a.astype(jnp.float32))
+
+
+class SquashedGaussianActor(nn.Module):
+    """obs -> (mu, log_std) of a pre-squash Gaussian (SAC actor)."""
+
+    act_dim: int
+    hidden_sizes: Sequence[int]
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
+                     name="pi_trunk")(obs)
+        mu = nn.Dense(self.act_dim, dtype=self.compute_dtype, name="pi_mu")(h)
+        log_std = nn.Dense(self.act_dim, dtype=self.compute_dtype,
+                           name="pi_log_std")(h)
+        log_std = jnp.clip(log_std.astype(jnp.float32), LOG_STD_MIN, LOG_STD_MAX)
+        return mu.astype(jnp.float32), log_std
+
+
+def squashed_gaussian_sample(rng, mu, log_std, act_limit: float):
+    """Sample a tanh-squashed Gaussian action + its log-prob (with the
+    tanh change-of-variables correction, computed in the numerically stable
+    softplus form)."""
+    std = jnp.exp(log_std)
+    pre = mu + std * jax.random.normal(rng, mu.shape, mu.dtype)
+    logp = jnp.sum(
+        -0.5 * (jnp.square((pre - mu) / std) + 2 * log_std
+                + jnp.log(2 * jnp.pi)), axis=-1)
+    # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+    logp -= jnp.sum(2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)),
+                    axis=-1)
+    return act_limit * jnp.tanh(pre), logp
+
+
+def _masked_argmax(values, mask):
+    if mask is not None:
+        values = jnp.where(mask > 0, values, _MASK_FILL)
+    return jnp.argmax(values, axis=-1), values
+
+
+def _eps_greedy(rng, greedy, values, mask, epsilon):
+    """Epsilon-greedy over the valid-action set."""
+    explore_rng, pick_rng = jax.random.split(rng)
+    if mask is None:
+        mask = jnp.ones_like(values)
+    random_act = jax.random.categorical(
+        pick_rng, jnp.where(mask > 0, 0.0, _MASK_FILL), axis=-1)
+    explore = jax.random.bernoulli(
+        explore_rng, epsilon, greedy.shape)
+    return jnp.where(explore, random_act, greedy)
+
+
+@register_model("qnet_discrete")
+def build_qnet_discrete(arch: Mapping[str, Any]) -> Policy:
+    """Epsilon-greedy policy over a Q-network (the DQN actor artifact).
+    ``arch["epsilon"]`` is the exploration rate actors apply; the learner
+    anneals it per model publish."""
+    module = DiscreteQNet(
+        act_dim=int(arch["act_dim"]),
+        hidden_sizes=mlp_sizes(arch),
+        compute_dtype=_compute_dtype(arch),
+    )
+    obs_dim = int(arch["obs_dim"])
+    epsilon_default = float(arch.get("epsilon", 0.05))
+
+    def init_params(rng):
+        return module.init(rng, jnp.zeros((1, obs_dim), jnp.float32))
+
+    def step(params, rng, obs, mask=None, epsilon=None):
+        # ``epsilon`` may arrive as a traced scalar (PolicyActor passes the
+        # annealed value per call) so a new publish never retraces.
+        eps = epsilon if epsilon is not None else epsilon_default
+        q = module.apply(params, obs)
+        greedy, q_masked = _masked_argmax(q, mask)
+        act = _eps_greedy(rng, greedy, q, mask, eps)
+        v = jnp.max(q_masked, axis=-1)
+        return act, {"logp_a": jnp.zeros_like(v), "v": v}
+
+    def evaluate(params, obs, act, mask=None):
+        q = module.apply(params, obs)
+        _, q_masked = _masked_argmax(q, mask)
+        q_a = jnp.take_along_axis(
+            q, jnp.asarray(act)[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+        return jnp.zeros_like(q_a), jnp.zeros_like(q_a), q_a
+
+    def mode(params, obs, mask=None):
+        q = module.apply(params, obs)
+        return _masked_argmax(q, mask)[0]
+
+    return Policy(arch=dict(arch), init_params=init_params, step=step,
+                  evaluate=evaluate, mode=mode)
+
+
+def c51_support(arch: Mapping[str, Any]) -> jax.Array:
+    return jnp.linspace(float(arch.get("v_min", -10.0)),
+                        float(arch.get("v_max", 10.0)),
+                        int(arch.get("n_atoms", 51)))
+
+
+@register_model("c51_discrete")
+def build_c51_discrete(arch: Mapping[str, Any]) -> Policy:
+    """Epsilon-greedy policy over C51 expected values."""
+    module = DistributionalQNet(
+        act_dim=int(arch["act_dim"]),
+        n_atoms=int(arch.get("n_atoms", 51)),
+        hidden_sizes=mlp_sizes(arch),
+        compute_dtype=_compute_dtype(arch),
+    )
+    obs_dim = int(arch["obs_dim"])
+    epsilon_default = float(arch.get("epsilon", 0.05))
+    support = c51_support(arch)
+
+    def expected_q(params, obs):
+        logits = module.apply(params, obs)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.sum(probs * support, axis=-1)
+
+    def init_params(rng):
+        return module.init(rng, jnp.zeros((1, obs_dim), jnp.float32))
+
+    def step(params, rng, obs, mask=None, epsilon=None):
+        eps = epsilon if epsilon is not None else epsilon_default
+        q = expected_q(params, obs)
+        greedy, q_masked = _masked_argmax(q, mask)
+        act = _eps_greedy(rng, greedy, q, mask, eps)
+        v = jnp.max(q_masked, axis=-1)
+        return act, {"logp_a": jnp.zeros_like(v), "v": v}
+
+    def evaluate(params, obs, act, mask=None):
+        q = expected_q(params, obs)
+        q_a = jnp.take_along_axis(
+            q, jnp.asarray(act)[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+        return jnp.zeros_like(q_a), jnp.zeros_like(q_a), q_a
+
+    def mode(params, obs, mask=None):
+        return _masked_argmax(expected_q(params, obs), mask)[0]
+
+    return Policy(arch=dict(arch), init_params=init_params, step=step,
+                  evaluate=evaluate, mode=mode)
+
+
+@register_model("ddpg_continuous")
+def build_ddpg_continuous(arch: Mapping[str, Any]) -> Policy:
+    """Deterministic tanh actor with Gaussian exploration noise
+    (``arch["act_noise"]``; set 0 for evaluation actors)."""
+    act_limit = float(arch.get("act_limit", 1.0))
+    module = DeterministicActor(
+        act_dim=int(arch["act_dim"]),
+        act_limit=act_limit,
+        hidden_sizes=mlp_sizes(arch),
+        compute_dtype=_compute_dtype(arch),
+    )
+    obs_dim = int(arch["obs_dim"])
+    act_noise_default = float(arch.get("act_noise", 0.1))
+
+    def init_params(rng):
+        return module.init(rng, jnp.zeros((1, obs_dim), jnp.float32))
+
+    def step(params, rng, obs, mask=None, act_noise=None):
+        del mask
+        noise = act_noise if act_noise is not None else act_noise_default
+        a = module.apply(params, obs)
+        a = a + noise * jax.random.normal(rng, a.shape, a.dtype)
+        a = jnp.clip(a, -act_limit, act_limit)
+        zero = jnp.zeros(a.shape[:-1], jnp.float32)
+        return a, {"logp_a": zero, "v": zero}
+
+    def evaluate(params, obs, act, mask=None):
+        del act, mask
+        a = module.apply(params, obs)
+        zero = jnp.zeros(a.shape[:-1], jnp.float32)
+        return zero, zero, zero
+
+    def mode(params, obs, mask=None):
+        del mask
+        return module.apply(params, obs)
+
+    return Policy(arch=dict(arch), init_params=init_params, step=step,
+                  evaluate=evaluate, mode=mode)
+
+
+@register_model("sac_continuous")
+def build_sac_continuous(arch: Mapping[str, Any]) -> Policy:
+    """Squashed-Gaussian stochastic actor (SAC)."""
+    act_limit = float(arch.get("act_limit", 1.0))
+    module = SquashedGaussianActor(
+        act_dim=int(arch["act_dim"]),
+        hidden_sizes=mlp_sizes(arch),
+        compute_dtype=_compute_dtype(arch),
+    )
+    obs_dim = int(arch["obs_dim"])
+
+    def init_params(rng):
+        return module.init(rng, jnp.zeros((1, obs_dim), jnp.float32))
+
+    def step(params, rng, obs, mask=None):
+        del mask
+        mu, log_std = module.apply(params, obs)
+        a, logp = squashed_gaussian_sample(rng, mu, log_std, act_limit)
+        return a, {"logp_a": logp, "v": jnp.zeros_like(logp)}
+
+    def evaluate(params, obs, act, mask=None):
+        del act, mask
+        mu, log_std = module.apply(params, obs)
+        ent = jnp.sum(log_std, axis=-1)  # up-to-constant Gaussian entropy
+        zero = jnp.zeros(ent.shape, jnp.float32)
+        return zero, ent, zero
+
+    def mode(params, obs, mask=None):
+        del mask
+        mu, _ = module.apply(params, obs)
+        return act_limit * jnp.tanh(mu)
+
+    return Policy(arch=dict(arch), init_params=init_params, step=step,
+                  evaluate=evaluate, mode=mode)

@@ -1,0 +1,211 @@
+"""Decoder-only transformer sequence policy (the long-context model family).
+
+No counterpart exists in the reference — its only models are 2x128 MLPs
+(relayrl_framework/src/native/python/algorithms/REINFORCE/kernel.py:14-21)
+and SURVEY.md §5.7 records long-context support as absent. This family is
+the TPU-first addition: a causal transformer over the trajectory time axis,
+so the policy conditions on history instead of a single observation, with
+three attention backends selected by arch config:
+
+* ``"dense"``     — plain softmax attention (small T, correctness anchor)
+* ``"blockwise"`` — online-softmax scan over KV blocks (long T, one device)
+* ``"ring"``      — ring attention over the mesh ``sp`` axis
+                    (:mod:`relayrl_tpu.parallel.ring`); requires an ambient
+                    mesh (``parallel.context.use_mesh``) at trace time and
+                    falls back to blockwise without one, so the SAME arch
+                    config applies on CPU actor hosts and the TPU learner
+                    (the heterogeneous-placement requirement of SURVEY.md
+                    §7.4 item 2).
+
+Sequence ABI: ``evaluate(params, obs[B,T,D], act[B,T], mask[B,T,A]) ->
+(logp[B,T], ent[B,T], v[B,T])`` — same shapes the per-step MLP family
+broadcasts to, so REINFORCE/PPO updates take this policy unchanged.
+``step`` treats the second-to-last axis as time (``[T,D]`` or ``[B,T,D]``)
+and returns the action at the last position; a bare ``[D]`` obs is a
+context of one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from relayrl_tpu.models.base import Policy, register_model
+from relayrl_tpu.models.mlp import (
+    _MASK_FILL,
+    _categorical_entropy,
+    _categorical_logp,
+    _compute_dtype,
+)
+from relayrl_tpu.ops.attention import blockwise_attention, dense_attention
+
+
+def _resolve_attention(arch: Mapping[str, Any]) -> Callable:
+    """Arch config -> [B,T,H,D]x3 -> [B,T,H,D] attention callable."""
+    kind = arch.get("attention", "dense")
+    block = int(arch.get("attention_block", 128))
+    if kind == "dense":
+        return lambda q, k, v: dense_attention(q, k, v, causal=True)
+    if kind == "blockwise":
+        return lambda q, k, v: blockwise_attention(q, k, v, block, causal=True)
+    if kind == "ring":
+        def ring_or_local(q, k, v):
+            from relayrl_tpu.parallel.context import current_mesh
+            from relayrl_tpu.parallel.ring import make_ring_attention
+
+            mesh = current_mesh()
+            if mesh is None or mesh.shape.get("sp", 1) <= 1:
+                if q.shape[1] % block == 0:
+                    return blockwise_attention(q, k, v, block, causal=True)
+                return dense_attention(q, k, v, causal=True)
+            return make_ring_attention(mesh)(q, k, v)
+        return ring_or_local
+    raise ValueError(f"unknown attention kind {kind!r}")
+
+
+class TransformerBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    mlp_ratio: int
+    attn_fn: Callable
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, _ = x.shape
+        head_dim = self.d_model // self.n_heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        h = h.astype(self.compute_dtype)
+        qkv = nn.Dense(3 * self.d_model, dtype=self.compute_dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.n_heads, head_dim)
+        attn = self.attn_fn(q.reshape(shape), k.reshape(shape),
+                            v.reshape(shape))
+        attn = attn.reshape(B, T, self.d_model)
+        x = x + nn.Dense(self.d_model, dtype=self.compute_dtype,
+                         name="attn_out")(attn).astype(x.dtype)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = h.astype(self.compute_dtype)
+        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.compute_dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.compute_dtype, name="mlp_down")(h)
+        return x + h.astype(x.dtype)
+
+
+class TransformerCore(nn.Module):
+    """Obs sequence -> per-step (logits, v). Residual stream stays f32."""
+
+    act_dim: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    mlp_ratio: int
+    max_seq_len: int
+    has_critic: bool
+    attn_fn: Callable
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, obs, mask=None):
+        B, T, _ = obs.shape
+        x = nn.Dense(self.d_model, dtype=jnp.float32, name="obs_embed")(obs)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02), (self.max_seq_len, self.d_model),
+            jnp.float32)
+        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, T, axis=0)[None]
+        for i in range(self.n_layers):
+            x = TransformerBlock(
+                self.d_model, self.n_heads, self.mlp_ratio, self.attn_fn,
+                self.compute_dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        logits = nn.Dense(self.act_dim, dtype=jnp.float32,
+                          name="pi_head")(x)
+        if mask is not None:
+            logits = jnp.where(mask > 0, logits, _MASK_FILL)
+        if self.has_critic:
+            # Shared-trunk actor-critic: unlike the MLP family's separate
+            # vf_trunk, the critic reads the policy-shaped features, so the
+            # vf optimizer partition (labels by `vf*` prefix) trains only
+            # this head — a 2-layer MLP rather than a single linear probe to
+            # give the vf steps real capacity.
+            h = nn.Dense(self.d_model, dtype=jnp.float32, name="vf_head_up")(x)
+            v = nn.Dense(1, dtype=jnp.float32, name="vf_head")(nn.tanh(h))
+            v = jnp.squeeze(v, axis=-1)
+        else:
+            v = jnp.zeros(logits.shape[:-1], jnp.float32)
+        return logits, v
+
+
+def _as_btd(obs, mask):
+    """Normalize step/evaluate inputs to [B, T, D] (+ mask [B, T, A])."""
+    obs = jnp.asarray(obs)
+    if obs.ndim == 1:          # [D] -> context of one
+        obs, lead = obs[None, None], "scalar"
+    elif obs.ndim == 2:        # [T, D]
+        obs, lead = obs[None], "seq"
+    else:                      # [B, T, D]
+        lead = "batch"
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        while mask.ndim < 3:
+            mask = mask[None]
+    return obs, mask, lead
+
+
+@register_model("transformer_discrete")
+def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
+    obs_dim = int(arch["obs_dim"])
+    max_seq_len = int(arch.get("max_seq_len", 1024))
+    core = TransformerCore(
+        act_dim=int(arch["act_dim"]),
+        d_model=int(arch.get("d_model", 128)),
+        n_layers=int(arch.get("n_layers", 2)),
+        n_heads=int(arch.get("n_heads", 4)),
+        mlp_ratio=int(arch.get("mlp_ratio", 4)),
+        max_seq_len=max_seq_len,
+        has_critic=bool(arch.get("has_critic", True)),
+        attn_fn=_resolve_attention(arch),
+        compute_dtype=_compute_dtype(arch),
+    )
+
+    def init_params(rng):
+        return core.init(rng, jnp.zeros((1, 1, obs_dim), jnp.float32))
+
+    def step(params, rng, obs, mask=None):
+        obs, mask, lead = _as_btd(obs, mask)
+        logits, v = core.apply(params, obs, mask)
+        logits_last, v_last = logits[:, -1], v[:, -1]
+        act = jax.random.categorical(rng, logits_last, axis=-1)
+        logp = _categorical_logp(logits_last, act)
+        if lead != "batch":
+            act, logp, v_last = act[0], logp[0], v_last[0]
+        return act, {"logp_a": logp, "v": v_last}
+
+    def evaluate(params, obs, act, mask=None):
+        obs, mask, lead = _as_btd(obs, mask)
+        act_b = jnp.asarray(act)
+        while act_b.ndim < 2:  # scalar -> [1,1], [T] -> [1,T]
+            act_b = act_b[None]
+        logits, v = core.apply(params, obs, mask)
+        logp = _categorical_logp(logits, act_b)
+        ent = _categorical_entropy(logits)
+        if lead != "batch":
+            logp, ent, v = logp[0], ent[0], v[0]
+        if lead == "scalar":
+            logp, ent, v = logp[0], ent[0], v[0]
+        return logp, ent, v
+
+    def mode(params, obs, mask=None):
+        obs, mask, lead = _as_btd(obs, mask)
+        logits, _ = core.apply(params, obs, mask)
+        act = jnp.argmax(logits[:, -1], axis=-1)
+        return act if lead == "batch" else act[0]
+
+    return Policy(arch=dict(arch), init_params=init_params, step=step,
+                  evaluate=evaluate, mode=mode)

@@ -1,0 +1,23 @@
+"""Numerical ops for RL on fixed-shape padded batches (TPU-first)."""
+
+from relayrl_tpu.ops.gae import (
+    discount_cumsum,
+    gae_advantages,
+    masked_mean_std,
+    normalize_advantages,
+    rewards_to_go,
+)
+from relayrl_tpu.ops.attention import blockwise_attention, dense_attention
+from relayrl_tpu.ops.vtrace import VTraceReturns, vtrace
+
+__all__ = [
+    "discount_cumsum",
+    "gae_advantages",
+    "masked_mean_std",
+    "normalize_advantages",
+    "rewards_to_go",
+    "blockwise_attention",
+    "dense_attention",
+    "VTraceReturns",
+    "vtrace",
+]

@@ -1,0 +1,117 @@
+"""Multi-head attention ops: dense, blockwise (memory-efficient) variants.
+
+The reference has no attention / sequence models at all (SURVEY.md §5.7:
+"long-context / sequence parallelism: absent"; its largest model is a 2x128
+MLP — relayrl_framework/src/native/python/algorithms/REINFORCE/
+kernel.py:14-21). These ops are the TPU-first long-context building blocks
+the new framework adds as first-class components: a dense softmax attention
+(the correctness reference), and a blockwise online-softmax attention
+(flash-attention recurrence over KV blocks via ``lax.scan``) whose
+per-block combine step is shared with the ring-attention sequence-parallel
+path in :mod:`relayrl_tpu.parallel.ring`.
+
+Layout convention: ``[batch, time, heads, head_dim]`` (BTHD) everywhere.
+Scores are computed in float32 regardless of input dtype (bf16 trunks feed
+the MXU; softmax stays f32 for stability).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Finite large-negative fill: keeps exp()/grad NaN-free where a row is fully
+# masked (same rationale as the policy-logit mask fill in models/mlp.py).
+_NEG_INF = -1e30
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    q_offset: int | jax.Array = 0,
+                    kv_offset: int | jax.Array = 0) -> jax.Array:
+    """Plain softmax attention on ``[B, Tq, H, D] x [B, Tk, H, D]``.
+
+    ``q_offset``/``kv_offset`` are the global time positions of the first
+    query/key — used by the blockwise and ring variants to apply a causal
+    mask across blocks that live on different devices.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def attention_block_combine(carry, q, k_blk, v_blk, mask):
+    """One online-softmax accumulation step (the flash-attention recurrence).
+
+    ``carry = (o, m, l)`` with ``o [B,H,Tq,D]`` un-normalized output,
+    ``m [B,H,Tq]`` running max, ``l [B,H,Tq]`` running denominator — all
+    float32, ``m`` finite (init ``_NEG_INF``, never ``-inf``, so fully-masked
+    blocks contribute exact zeros instead of NaNs). ``mask [Tq, Tk]`` is the
+    validity of each (query, key) pair for this block.
+    """
+    o, m, l = carry
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # Rows with no valid key yet keep m == _NEG_INF; exp(s - m) would be
+    # exp(0) = 1 there, so zero those entries via the mask.
+    p = jnp.where(mask[None, None], jnp.exp(s - m_new[..., None]), 0.0)
+    correction = jnp.exp(m - m_new)
+    l = l * correction + jnp.sum(p, axis=-1)
+    o = o * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+    return o, m_new, l
+
+
+def finalize_attention(o: jax.Array, l: jax.Array, out_dtype) -> jax.Array:
+    """Normalize the online-softmax accumulator and restore BTHD layout."""
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(out_dtype)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_size: int = 128,
+                        causal: bool = True) -> jax.Array:
+    """Memory-efficient attention: ``lax.scan`` over KV blocks.
+
+    Peak memory is O(Tq * block_size) instead of O(Tq * Tk); numerics match
+    :func:`dense_attention` (same online-softmax math flash attention uses).
+    Requires ``T % block_size == 0`` (pad to fixed shapes upstream — variable
+    shapes would recompile, SURVEY.md §7.4 item 3).
+    """
+    B, T, H, D = q.shape
+    if T % block_size != 0:
+        raise ValueError(f"seq len {T} not divisible by block {block_size}")
+    n_blocks = T // block_size
+    k_blocks = k.reshape(B, n_blocks, block_size, H, D)
+    v_blocks = v.reshape(B, n_blocks, block_size, H, D)
+    q_pos = jnp.arange(T)
+
+    o = jnp.zeros((B, H, T, D), jnp.float32)
+    m = jnp.full((B, H, T), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, T), jnp.float32)
+
+    def scan_step(carry, blk):
+        k_blk, v_blk, blk_idx = blk
+        kv_pos = blk_idx * block_size + jnp.arange(block_size)
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        else:
+            mask = jnp.ones((T, block_size), bool)
+        return attention_block_combine(carry, q, k_blk, v_blk, mask), None
+
+    (o, m, l), _ = jax.lax.scan(
+        scan_step, (o, m, l),
+        (jnp.moveaxis(k_blocks, 1, 0), jnp.moveaxis(v_blocks, 1, 0),
+         jnp.arange(n_blocks)),
+    )
+    return finalize_attention(o, l, q.dtype)

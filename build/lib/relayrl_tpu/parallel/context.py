@@ -1,0 +1,36 @@
+"""Ambient mesh context.
+
+Model arch configs are JSON-able data (the transportable model ABI —
+models/base.py), so they cannot carry a live ``Mesh``. Components that need
+one at trace time (ring attention in the transformer policy) read it from
+this context, which the learner/driver sets around compilation::
+
+    with use_mesh(mesh):
+        update = make_sharded_update(...)
+
+Single-device paths (actors on CPU hosts) simply never set a mesh and the
+sequence models fall back to their local attention implementation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from jax.sharding import Mesh
+
+_state = threading.local()
+
+
+def current_mesh() -> Mesh | None:
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    prev = current_mesh()
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev

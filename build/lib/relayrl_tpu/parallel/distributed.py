@@ -1,0 +1,131 @@
+"""Multi-host bring-up: ``jax.distributed`` initialization.
+
+The reference's only "distribution" is socket-level actor/learner process
+separation (SURVEY.md §0 — no NCCL/MPI, no multi-device anything); the
+TPU-native learner scales across hosts with ``jax.distributed`` + the same
+mesh/sharding rules (meshes built over ``jax.devices()`` span all hosts
+automatically once initialized; XLA routes collectives over ICI/DCN).
+
+Resolution order for each knob: explicit argument > environment variable
+(``RELAYRL_COORDINATOR`` / ``RELAYRL_NUM_PROCESSES`` / ``RELAYRL_PROCESS_ID``,
+falling back to the standard ``JAX_COORDINATOR_ADDRESS`` etc.) > config
+``learner.distributed`` section > single-process no-op.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+_info: dict | None = None  # cached result of the first successful resolution
+
+
+def _env(*names: str) -> str | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+    config: Mapping[str, Any] | None = None,
+) -> dict:
+    """Initialize ``jax.distributed`` when a multi-process topology is
+    configured; no-op for single-process. Repeat calls return the cached
+    topology from the first call (regardless of later args). Must run
+    before any other JAX use on the process (jax.distributed contract).
+
+    Returns ``{"multi_host": bool, "process_id": int, "num_processes": int}``.
+    """
+    global _info
+    if _info is not None:
+        return dict(_info)
+
+    import jax
+
+    dist_cfg = dict((config or {}).get("distributed", {})) if config else {}
+    coordinator_address = (
+        coordinator_address
+        or _env("RELAYRL_COORDINATOR", "JAX_COORDINATOR_ADDRESS")
+        or dist_cfg.get("coordinator"))
+    if num_processes is None:
+        raw = _env("RELAYRL_NUM_PROCESSES", "JAX_NUM_PROCESSES")
+        num_processes = int(raw) if raw else int(dist_cfg.get("num_processes", 1))
+
+    if num_processes <= 1 or coordinator_address is None:
+        _info = {"multi_host": False, "process_id": 0, "num_processes": 1}
+        return dict(_info)
+
+    if process_id is None:
+        raw = _env("RELAYRL_PROCESS_ID", "JAX_PROCESS_ID")
+        if raw:
+            process_id = int(raw)
+        elif "process_id" in dist_cfg:
+            # A config file is naturally shared between hosts, so a config
+            # process_id would make every host claim the same rank and the
+            # coordinator barrier would hang waiting for the others. Only
+            # accept it alongside an explicit single-host-style setup.
+            raise ValueError(
+                "multi-host setup (num_processes="
+                f"{num_processes}) needs a per-host process id: pass "
+                "process_id= or set RELAYRL_PROCESS_ID on each host — a "
+                "process_id in the shared config would give every host the "
+                "same rank")
+        else:
+            raise ValueError(
+                "multi-host setup (num_processes="
+                f"{num_processes}) needs a per-host process id: pass "
+                "process_id= or set RELAYRL_PROCESS_ID on each host")
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _info = {
+        "multi_host": True,
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+    }
+    return dict(_info)
+
+
+def process_index() -> int:
+    """Rank of this host. Uses the cached topology when
+    :func:`initialize_distributed` has run (does not touch the JAX backend
+    otherwise — calling into jax here before distributed init would
+    initialize the single-process backend and break a later init)."""
+    if _info is not None:
+        return int(_info["process_id"])
+    return 0
+
+
+def broadcast_from_coordinator(tree):
+    """Ship a host pytree from the coordinator to every process.
+
+    The actor plane is asymmetric (trajectory sockets bind on the
+    coordinator only — SURVEY.md §7.4 item 5) while the learner step is
+    SPMD: every process must hold the same host batch before
+    ``place_batch`` builds the global device array. Single-process: the
+    tree is returned unchanged. Multi-host: rank 0's values win
+    (non-coordinators pass zeros_like or their stale copy).
+    """
+    if _info is None or not _info["multi_host"]:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def is_coordinator() -> bool:
+    """True on the host that should run ingest/logging (process 0) — the
+    asymmetric actor-plane side of SURVEY.md §7.4 item 5: trajectory
+    sockets bind on the coordinator; learner steps run SPMD on all hosts.
+    Call :func:`initialize_distributed` first on multi-host setups."""
+    return process_index() == 0

@@ -1,0 +1,111 @@
+"""Sharded learner-step compilation.
+
+Takes the pure ``(state, batch) -> (state, metrics)`` update an algorithm
+already defines and re-jits it over a mesh with explicit in/out shardings:
+batch split over dp×fsdp, state placed by the param rules, metrics
+replicated. XLA GSPMD inserts every collective (SURVEY.md §5.8 — the
+reference's "communication backend" is sockets between processes; the
+TPU-native learner's backend is ICI/DCN collectives compiled by XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh
+
+from relayrl_tpu.parallel.context import use_mesh
+from relayrl_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    sequence_batch_pspec,
+    state_shardings,
+)
+from jax.sharding import NamedSharding
+
+
+def make_sharded_update(update_fn: Callable, mesh: Mesh, state_template,
+                        donate_state: bool = True,
+                        shard_time: bool = False) -> Callable:
+    """Compile ``update_fn`` with mesh shardings.
+
+    ``state_template`` is an abstract or concrete state pytree used to derive
+    placements; the returned callable expects state already placed (use
+    :func:`place_state` once) and a host or device batch dict.
+
+    ``shard_time=True`` additionally shards axis 1 (time) of rank>=2 batch
+    arrays over ``sp`` — the sequence-parallel path for transformer policies
+    whose attention runs as a ring over ``sp``. The mesh is installed as the
+    ambient mesh (:mod:`relayrl_tpu.parallel.context`) around tracing so
+    ``attention: "ring"`` models pick it up.
+    """
+    state_sh = state_shardings(state_template, mesh)
+
+    def batch_shardings_for(batch):
+        return batch_shardings(mesh, batch, shard_time)
+
+    compiled_cache = {}
+
+    def sharded_update(state, batch):
+        key = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in batch.items()))
+        fn = compiled_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                update_fn,
+                in_shardings=(state_sh, batch_shardings_for(batch)),
+                out_shardings=(state_sh, replicated(mesh)),
+                donate_argnums=(0,) if donate_state else (),
+            )
+            compiled_cache[key] = fn
+        with use_mesh(mesh):
+            return fn(state, batch)
+
+    return sharded_update
+
+
+def batch_shardings(mesh: Mesh, batch: dict, shard_time: bool = False) -> dict:
+    """Per-key NamedShardings for a batch dict: batch axis over dp×fsdp,
+    plus (``shard_time=True``) the time axis of rank>=2 arrays over ``sp``."""
+    if shard_time:
+        return {
+            k: NamedSharding(mesh, sequence_batch_pspec(mesh, v.ndim))
+            for k, v in batch.items()
+        }
+    sh = batch_sharding(mesh)
+    return {k: sh for k in batch}
+
+
+def _global_put(x, sharding):
+    """Place one host array under a sharding that may span processes.
+
+    Single-process (and any fully-addressable sharding): plain
+    ``jax.device_put``. Multi-host: the mesh's devices are not all
+    addressable from this process, so build the global array from this
+    process's copy of the (host-global) data — each process contributes
+    the slices its local devices own. Callers must hold the same host
+    values on every process (the coordinator-ingest path broadcasts the
+    batch first; states are constructed identically from shared seeds).
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
+def place_state(state, mesh: Mesh):
+    """Device-put a host/single-device state onto the mesh per the rules."""
+    return jax.tree_util.tree_map(_global_put, state,
+                                  state_shardings(state, mesh))
+
+
+def place_batch(batch: dict, mesh: Mesh, shard_time: bool = False) -> dict:
+    """Host batch → device-sharded arrays (the jax.device_put ingest path —
+    BASELINE.md north-star names this explicitly). ``shard_time`` must match
+    the :func:`make_sharded_update` flag. Works on multi-host meshes (the
+    batch must be host-global and identical across processes — see
+    :func:`relayrl_tpu.parallel.distributed.broadcast_from_coordinator`)."""
+    sh = batch_shardings(mesh, batch, shard_time)
+    return {k: _global_put(v, sh[k]) for k, v in batch.items()}

@@ -1,0 +1,121 @@
+"""Sharding rules: param PartitionSpecs + batch specs for a mesh.
+
+The recipe (per the public scaling-book methodology): pick a mesh, annotate
+in/out shardings on the jitted step, let XLA GSPMD insert the collectives
+(psum for DP grads, all-gathers for FSDP params, reduce-scatters as needed)
+— nothing here ever calls a collective directly for the learner path.
+
+Rules implemented:
+
+* **dp**    — params replicated, batch sharded on axis 0; GSPMD turns the
+              grad sum into a psum over ``dp``.
+* **fsdp**  — every param whose first axis is divisible by the ``fsdp`` size
+              is sharded there (ZeRO-3 style); XLA all-gathers per layer.
+* **tp**    — MLP trunks alternate column/row parallel over ``tp``:
+              even layers split output features P(None, "tp"), odd layers
+              split input features P("tp", None) — one psum per pair.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_pspec(mesh: Mesh) -> P:
+    """Leading (batch) axis sharded over dp×fsdp; rest replicated."""
+    from relayrl_tpu.parallel.mesh import data_axes
+
+    axes = data_axes(mesh)
+    return P(axes if axes else None)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec(mesh))
+
+
+def sequence_batch_pspec(mesh: Mesh, ndim: int) -> P:
+    """Spec for a ``[B, T, ...]`` batch array: batch over dp×fsdp AND time
+    over ``sp`` (the sequence-parallel ingest path feeding ring attention).
+    Rank-1 arrays (per-episode scalars like ``last_val``) shard batch only."""
+    from relayrl_tpu.parallel.mesh import data_axes
+
+    axes = data_axes(mesh)
+    b = axes if axes else None
+    if ndim >= 2 and mesh.shape.get("sp", 1) > 1:
+        return P(b, "sp")
+    return P(b)
+
+
+_DENSE_LAYER = re.compile(r"dense_(\d+)$")
+
+
+def param_pspec(path: tuple, leaf: Any, mesh: Mesh) -> P:
+    """PartitionSpec for one param leaf, by tree path + shape."""
+    tp = mesh.shape.get("tp", 1)
+    fsdp = mesh.shape.get("fsdp", 1)
+    names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    shape = getattr(leaf, "shape", ())
+
+    # -- tensor parallel: alternate split of MLP trunk Dense kernels --
+    if tp > 1 and len(shape) == 2:
+        for name in names:
+            m = _DENSE_LAYER.search(str(name))
+            if m and "kernel" in names:
+                layer = int(m.group(1))
+                if layer % 2 == 0 and shape[1] % tp == 0:
+                    return _maybe_fsdp(P(None, "tp"), shape, fsdp, axis=0)
+                if layer % 2 == 1 and shape[0] % tp == 0:
+                    return P("tp", None)
+    # bias of a column-parallel layer follows its output split
+    if tp > 1 and len(shape) == 1 and "bias" in names:
+        for name in names:
+            m = _DENSE_LAYER.search(str(name))
+            if m and int(m.group(1)) % 2 == 0 and shape[0] % tp == 0:
+                return P("tp")
+
+    # -- fsdp: shard the first divisible axis --
+    if fsdp > 1:
+        for axis, dim in enumerate(shape):
+            if dim % fsdp == 0 and dim >= fsdp:
+                return P(*([None] * axis), "fsdp")
+    return P()
+
+
+def _maybe_fsdp(spec: P, shape, fsdp: int, axis: int) -> P:
+    """Layer a leading-axis fsdp split under a tp split when both fit."""
+    if fsdp > 1 and len(shape) > axis and shape[axis] % fsdp == 0:
+        parts = list(spec)
+        if parts[axis] is None:
+            parts[axis] = "fsdp"
+            return P(*parts)
+    return spec
+
+
+def params_shardings(params, mesh: Mesh):
+    """Pytree of NamedShardings matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, param_pspec(path, leaf, mesh)),
+        params,
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def state_shardings(state, mesh: Mesh):
+    """Shardings for a full train state tree.
+
+    Optimizer moments live under paths that still contain the layer names
+    (optax trees mirror the param tree), so the same path-based rules place
+    them exactly like their params; scalars/RNG keys fall through to
+    replicated.
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, param_pspec(path, leaf, mesh)),
+        state,
+    )

@@ -1,0 +1,14 @@
+"""Runtime processes: actors, local runner, training server."""
+
+from relayrl_tpu.runtime.policy_actor import PolicyActor
+from relayrl_tpu.runtime.local_runner import LocalRunner
+
+__all__ = ["PolicyActor", "LocalRunner"]
+
+
+def __getattr__(name):
+    if name in ("TrainingServer", "Agent"):
+        from relayrl_tpu.runtime import server as _server, agent as _agent
+
+        return {"TrainingServer": _server.TrainingServer, "Agent": _agent.Agent}[name]
+    raise AttributeError(f"module 'relayrl_tpu.runtime' has no attribute {name!r}")

@@ -1,0 +1,167 @@
+"""The agent (actor) process: local policy inference + trajectory streaming
++ model hot-swap.
+
+Capability parity with the reference's agent stack
+(reference: relayrl_framework/src/network/client/agent_wrapper.rs:213-270
+facade; agent_zmq.rs / agent_grpc.rs; PyO3 surface
+src/bindings/python/network/client/o3_agent.rs:49-330 —
+``RelayRLAgent(model_path, config_path, server_type, ...)``,
+``request_for_action(obs, mask, reward)``, ``flag_last_action(reward)``,
+``record_action``, restart/enable/disable).
+
+Bring-up mirrors the reference handshake (agent_zmq.rs:316-442): fetch model
+→ validate with a dummy forward → persist to ``client_model`` path →
+register → start the model listener. Hot-swaps are version-gated and
+arch-checked (the reference's version field is unimplemented server-side —
+training_grpc.rs:722-725; here it's real).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.runtime.policy_actor import PolicyActor
+from relayrl_tpu.transport import make_agent_transport
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle
+
+
+class Agent:
+    def __init__(
+        self,
+        model_path: str | None = None,
+        config_path: str | None = None,
+        server_type: str = "zmq",
+        handshake_timeout_s: float = 60.0,
+        seed: int | None = None,
+        start: bool = True,
+        **addr_overrides,
+    ):
+        self.config = ConfigLoader(None, config_path)
+        self.server_type = server_type
+        self._addr_overrides = addr_overrides
+        self.client_model_path = model_path or self.config.get_client_model_path()
+        self._handshake_timeout_s = handshake_timeout_s
+        self._seed = os.getpid() if seed is None else seed
+        self.actor: PolicyActor | None = None
+        self.transport = None
+        self.active = False
+        if start:
+            self.enable_agent()
+
+    # -- bring-up / lifecycle (ref: agent_zmq.rs:163-300) --
+    def enable_agent(self) -> None:
+        if self.active:
+            return
+        self.transport = make_agent_transport(
+            self.server_type, self.config, **self._addr_overrides)
+        version, bundle_bytes = self.transport.fetch_model(self._handshake_timeout_s)
+        bundle = ModelBundle.from_bytes(bundle_bytes)
+        bundle.version = version
+        # Persist before loading, like the reference writes client_model.pt
+        # (agent_zmq.rs:388-396) — survives restarts / aids debugging.
+        try:
+            bundle.save(self.client_model_path)
+        except OSError:
+            pass
+        if self.actor is None:
+            self.actor = PolicyActor(
+                bundle,
+                max_traj_length=self.config.get_max_traj_length(),
+                on_send=lambda payload: self.transport.send_trajectory(payload),
+                seed=self._seed,
+            )
+        else:
+            self.actor.maybe_swap(bundle)
+            self.actor.trajectory._on_send = (
+                lambda payload: self.transport.send_trajectory(payload))
+        if not self.transport.register(self.transport.identity):
+            raise RuntimeError("agent registration (MODEL_SET/ID_LOGGED) failed")
+        self.transport.on_model = self._on_model
+        self.transport.start_model_listener()
+        self.active = True
+
+    def disable_agent(self) -> None:
+        if not self.active:
+            return
+        self.transport.close()
+        self.transport = None
+        self.active = False
+
+    def restart_agent(self, **addr_overrides) -> None:
+        self.disable_agent()
+        self._addr_overrides.update(addr_overrides)
+        self.enable_agent()
+
+    def _on_model(self, version: int, bundle_bytes: bytes) -> None:
+        try:
+            bundle = ModelBundle.from_bytes(bundle_bytes)
+            bundle.version = version
+            if self.actor.maybe_swap(bundle):
+                try:
+                    bundle.save(self.client_model_path)
+                except OSError:
+                    pass
+        except Exception as e:
+            print(f"[Agent] rejected model update: {e!r}", flush=True)
+
+    # -- action API (ref: o3_agent.rs:117-217) --
+    def request_for_action(self, obs, mask=None, reward: float = 0.0) -> ActionRecord:
+        self._require_active()
+        return self.actor.request_for_action(obs, mask, reward)
+
+    def flag_last_action(self, reward: float = 0.0, truncated: bool = False,
+                         final_obs=None, terminated: bool | None = None,
+                         final_mask=None) -> None:
+        self._require_active()
+        self.actor.flag_last_action(reward, truncated=truncated,
+                                    final_obs=final_obs, terminated=terminated,
+                                    final_mask=final_mask)
+
+    def record_action(self, action: ActionRecord) -> None:
+        self._require_active()
+        self.actor.record_action(action)
+
+    @property
+    def model_version(self) -> int:
+        return -1 if self.actor is None else self.actor.version
+
+    def _require_active(self) -> None:
+        if not self.active or self.actor is None:
+            raise RuntimeError("agent is not active (call enable_agent())")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disable_agent()
+
+
+def run_gym_loop(agent: Agent, env, episodes: int, max_steps: int = 1000,
+                 seed: int | None = None) -> list[float]:
+    """The reference's canonical notebook loop (examples/README.md:125-152):
+    request_for_action → env.step → flag_last_action."""
+    returns = []
+    for ep in range(episodes):
+        obs, _ = env.reset(seed=None if seed is None else seed + ep)
+        ep_ret, reward = 0.0, 0.0
+        terminated = truncated = False
+        for _ in range(max_steps):
+            record = agent.request_for_action(obs, reward=reward)
+            act = record.act
+            act = int(np.asarray(act)) if np.asarray(act).ndim == 0 else np.asarray(act)
+            obs, reward, terminated, truncated, _ = env.step(act)
+            ep_ret += float(reward)
+            if terminated or truncated:
+                break
+        # A time-limit ending (env truncation or this loop's max_steps cap)
+        # ships the post-step obs so value targets bootstrap through it; a
+        # genuine terminal takes precedence even when both flags are set.
+        time_limited = not terminated
+        agent.flag_last_action(reward, truncated=time_limited,
+                               final_obs=obs if time_limited else None)
+        returns.append(ep_ret)
+    return returns

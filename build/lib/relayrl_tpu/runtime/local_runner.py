@@ -1,0 +1,122 @@
+"""In-process actor↔learner loop — the "minimum slice" (SURVEY.md §7.3).
+
+Wires a Gymnasium env → policy apply → epoch buffer → jitted learner step
+with no sockets at all. This validates the learning math end-to-end (the
+reference's equivalent is its example notebooks, examples/README.md:125-152,
+driving CartPole through the full network stack) and doubles as the fake
+in-process transport for integration tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from relayrl_tpu.algorithms import build_algorithm
+from relayrl_tpu.runtime.policy_actor import PolicyActor
+from relayrl_tpu.types.trajectory import deserialize_actions
+
+
+class LocalRunner:
+    """Single-process trainer: env steps feed the algorithm directly.
+
+    The actor still goes through the *wire codec* (serialize → deserialize on
+    episode hand-off) so the exact bytes that would cross the network are
+    exercised every episode.
+    """
+
+    def __init__(
+        self,
+        env,
+        algorithm_name: str = "REINFORCE",
+        config_path: str | None = None,
+        env_dir: str | None = None,
+        seed: int = 0,
+        **hyperparams,
+    ):
+        self.env = env
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = (
+            env.action_space.n
+            if hasattr(env.action_space, "n")
+            else int(np.prod(env.action_space.shape))
+        )
+        self.algorithm = build_algorithm(
+            algorithm_name,
+            env_dir=env_dir,
+            config_path=config_path,
+            obs_dim=obs_dim,
+            act_dim=int(act_dim),
+            **hyperparams,
+        )
+        self._episode_bytes: list[bytes] = []
+        # On-policy epoch buffers expose length buckets; the off-policy step
+        # replay ring has none — cap trajectories at a fixed horizon there.
+        # (PolicyActor adds marker headroom on top of this cap.)
+        buckets = getattr(self.algorithm.buffer, "buckets", None)
+        self.actor = PolicyActor(
+            self.algorithm.bundle(),
+            max_traj_length=buckets[-1] if buckets else 1000,
+            on_send=self._episode_bytes.append,
+            seed=seed,
+        )
+        self.seed = seed
+        self.updates = 0
+
+    def run_episode(self, max_steps: int = 1000) -> tuple[float, int]:
+        obs, _ = self.env.reset(seed=None)
+        ep_ret, ep_len = 0.0, 0
+        reward = 0.0
+        terminated = truncated = False
+        for _ in range(max_steps):
+            record = self.actor.request_for_action(obs, reward=reward)
+            obs, reward, terminated, truncated, _ = self.env.step(
+                self._to_env_action(record.act)
+            )
+            ep_ret += float(reward)
+            ep_len += 1
+            if terminated or truncated:
+                break
+        # Ending by time limit (env truncation or the max_steps cap here)
+        # is not a terminal state: ship the post-step obs so value targets
+        # bootstrap through it. A genuine terminal takes precedence even if
+        # it coincides with the time limit (Gymnasium allows both True).
+        time_limited = not terminated
+        self.actor.flag_last_action(
+            reward, truncated=time_limited,
+            final_obs=obs if time_limited else None)
+
+        # Hand the wire bytes to the learner exactly as the server would.
+        for buf in self._episode_bytes:
+            actions = deserialize_actions(buf)
+            if self.algorithm.receive_trajectory(actions):
+                self.updates += 1
+                self.actor.maybe_swap(self.algorithm.bundle())
+        self._episode_bytes.clear()
+        return ep_ret, ep_len
+
+    def train(self, epochs: int = 10, max_steps: int = 1000) -> dict[str, Any]:
+        """Run until ``epochs`` learner updates have happened."""
+        returns: list[float] = []
+        target_updates = self.updates + epochs
+        while self.updates < target_updates:
+            ep_ret, _ = self.run_episode(max_steps)
+            returns.append(ep_ret)
+        window = returns[-min(len(returns), 50):]
+        return {
+            "episodes": len(returns),
+            "updates": self.updates,
+            "avg_return_last_window": float(np.mean(window)),
+            "returns": returns,
+        }
+
+    def _to_env_action(self, act: np.ndarray):
+        arr = np.asarray(act)
+        if arr.ndim == 0:
+            return int(arr) if np.issubdtype(arr.dtype, np.integer) else float(arr)
+        return arr
+
+
+def reward_threshold_reached(result: Mapping[str, Any], threshold: float) -> bool:
+    return result["avg_return_last_window"] >= threshold

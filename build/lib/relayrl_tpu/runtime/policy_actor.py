@@ -1,0 +1,158 @@
+"""Actor-side policy holder: inference + ActionRecord assembly + hot-swap.
+
+This is the compute core of the reference's agent
+(reference: relayrl_framework/src/network/client/agent_zmq.rs:458-571 —
+``request_for_action`` runs TorchScript ``step(obs, mask)`` under no_grad,
+wraps the result + ``{logp_a, v}`` into a RelayRLAction and appends it to the
+trajectory; model hot-swap under a mutex at :645-679), shared by the
+in-process LocalRunner and the networked Agent so both paths run identical
+inference code.
+
+The policy apply is jitted once per architecture; on actor hosts without a
+TPU this compiles for CPU — the same ModelBundle serves both placements
+(SURVEY.md §7.4 item 2).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relayrl_tpu.models import build_policy, validate_policy
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import (
+    ModelBundle,
+    arch_equal,
+    exploration_kwargs,
+)
+from relayrl_tpu.types.trajectory import Trajectory
+
+
+class PolicyActor:
+    """Local policy + current trajectory; thread-safe hot-swap."""
+
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        max_traj_length: int = 1000,
+        on_send=None,
+        seed: int = 0,
+        validate: bool = True,
+    ):
+        self._lock = threading.Lock()
+        self.arch = dict(bundle.arch)
+        self.policy = build_policy(self.arch)
+        if validate:
+            validate_policy(self.policy, bundle.params)
+        self.params = bundle.params
+        self.version = bundle.version
+        self._step_fn = jax.jit(self.policy.step)
+        self._explore_kwargs = exploration_kwargs(self.arch)
+        self._rng = jax.random.PRNGKey(seed)
+        self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
+
+    # -- reference API (agent_zmq.rs:458-571 / o3_agent.rs:117-182) --
+    def request_for_action(
+        self,
+        obs,
+        mask=None,
+        reward: float = 0.0,
+    ) -> ActionRecord:
+        """Run the policy, append the step to the current trajectory."""
+        obs = np.asarray(obs, dtype=np.float32)
+        mask_arr = None if mask is None else np.asarray(mask, dtype=np.float32)
+        with self._lock:
+            self._rng, sub = jax.random.split(self._rng)
+            act, aux = self._step_fn(self.params, sub, obs, mask_arr,
+                                     **self._explore_kwargs)
+            record = ActionRecord(
+                obs=obs,
+                act=np.asarray(act),
+                mask=mask_arr,
+                rew=float(reward),
+                data={k: np.asarray(v) for k, v in aux.items()},
+                done=False,
+            )
+            self.trajectory.add_action(record, send_if_done=True)
+        return record
+
+    def flag_last_action(
+        self,
+        reward: float = 0.0,
+        truncated: bool = False,
+        final_obs=None,
+        terminated: bool | None = None,
+        final_mask=None,
+    ) -> None:
+        """Terminal marker: appends a done action carrying the final reward,
+        which triggers the trajectory send (ref: agent_zmq.rs:605-610).
+
+        ``truncated=True`` marks a time-limit ending (Gymnasium semantics):
+        the learner then bootstraps the value target through the boundary
+        instead of zeroing it. Pass the post-step observation as
+        ``final_obs`` so off-policy learners have a successor state to
+        bootstrap from (plus ``final_mask`` in action-masked envs, so the
+        bootstrap max ranges only over actions legal in that state).
+        Gymnasium can report ``terminated`` and ``truncated`` both True; a
+        genuine terminal must win (no bootstrapping past a real end
+        state), so callers mapping ``env.step`` output directly can pass
+        ``terminated`` and let this method resolve the precedence instead
+        of pre-computing it.
+        """
+        if terminated:
+            truncated = False
+        with self._lock:
+            record = ActionRecord(
+                obs=(None if final_obs is None
+                     else np.asarray(final_obs, np.float32)),
+                mask=(None if final_mask is None
+                      else np.asarray(final_mask, np.float32)),
+                rew=float(reward), done=True, truncated=bool(truncated))
+            self.trajectory.add_action(record, send_if_done=True)
+
+    def record_action(self, action: ActionRecord) -> None:
+        """Append an externally-chosen action (the reference declares this
+        but left it ``todo!()`` — agent_zmq.rs:585-596)."""
+        with self._lock:
+            self.trajectory.add_action(action, send_if_done=True)
+
+    # -- model hot-swap --
+    def maybe_swap(self, bundle: ModelBundle) -> bool:
+        """Install a newer model; stale or arch-mismatched bundles are
+        rejected (version checking the reference's proto defines but never
+        implements — training_grpc.rs:722-725)."""
+        if bundle.version <= self.version:
+            return False
+        if not arch_equal(bundle.arch, self.arch):
+            raise ValueError(
+                f"model arch changed {self.arch} -> {bundle.arch}; "
+                "actor refuses hot-swap (param-ABI guard)"
+            )
+        with self._lock:
+            if dict(bundle.arch) != self.arch:
+                # Exploration knobs (epsilon/act_noise) changed: they are
+                # traced step arguments, so only the scalar values refresh —
+                # no policy rebuild, no retrace.
+                self.arch = dict(bundle.arch)
+                self._explore_kwargs = exploration_kwargs(self.arch)
+            self.params = bundle.params
+            self.version = bundle.version
+        return True
+
+    def swap_from_bytes(self, buf: bytes) -> bool:
+        return self.maybe_swap(ModelBundle.from_bytes(buf))
+
+    def deterministic_action(self, obs, mask=None):
+        with self._lock:
+            act = jax.jit(self.policy.mode)(
+                self.params, np.asarray(obs, np.float32),
+                None if mask is None else np.asarray(mask, np.float32))
+        return np.asarray(act)
+
+
+def actor_aux_to_host(aux: Mapping[str, Any]) -> dict[str, np.ndarray]:
+    return {k: np.asarray(v) for k, v in aux.items()}

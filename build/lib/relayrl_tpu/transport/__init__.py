@@ -1,0 +1,93 @@
+"""Transport plane (ref layer L4, SURVEY.md §1): ZMQ, gRPC, native C++.
+
+``make_server_transport`` / ``make_agent_transport`` resolve a backend by
+name the way the reference's wrappers pick ZMQ (default) vs gRPC
+(training_server_wrapper.rs:329-379, agent_wrapper.rs:231-270).
+"""
+
+from __future__ import annotations
+
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.transport.base import (
+    AgentTransport,
+    ServerTransport,
+    pack_model_frame,
+    pack_trajectory_envelope,
+    unpack_model_frame,
+    unpack_trajectory_envelope,
+)
+
+
+def make_server_transport(server_type: str, config: ConfigLoader,
+                          **overrides) -> ServerTransport:
+    server_type = (server_type or "zmq").lower()
+    if server_type == "zmq":
+        from relayrl_tpu.transport.zmq_backend import ZmqServerTransport
+
+        return ZmqServerTransport(
+            agent_listener_addr=overrides.get(
+                "agent_listener_addr", config.get_agent_listener().address),
+            trajectory_addr=overrides.get(
+                "trajectory_addr", config.get_traj_server().address),
+            model_pub_addr=overrides.get(
+                "model_pub_addr", config.get_train_server().address),
+        )
+    if server_type == "grpc":
+        from relayrl_tpu.transport.grpc_backend import GrpcServerTransport
+
+        return GrpcServerTransport(
+            bind_addr=overrides.get("bind_addr", config.get_train_server().host_port),
+            idle_timeout_s=config.get_grpc_idle_timeout_s(),
+        )
+    if server_type == "native":
+        from relayrl_tpu.transport.native_backend import NativeServerTransport
+
+        return NativeServerTransport(
+            bind_addr=overrides.get("bind_addr", config.get_traj_server().host_port),
+        )
+    raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native)")
+
+
+def make_agent_transport(server_type: str, config: ConfigLoader,
+                         **overrides) -> AgentTransport:
+    server_type = (server_type or "zmq").lower()
+    if server_type == "zmq":
+        from relayrl_tpu.transport.zmq_backend import ZmqAgentTransport
+
+        return ZmqAgentTransport(
+            agent_listener_addr=overrides.get(
+                "agent_listener_addr", config.get_agent_listener().address),
+            trajectory_addr=overrides.get(
+                "trajectory_addr", config.get_traj_server().address),
+            model_sub_addr=overrides.get(
+                "model_sub_addr", config.get_train_server().address),
+            identity=overrides.get("identity"),
+        )
+    if server_type == "grpc":
+        from relayrl_tpu.transport.grpc_backend import GrpcAgentTransport
+
+        return GrpcAgentTransport(
+            server_addr=overrides.get("server_addr", config.get_train_server().host_port),
+            identity=overrides.get("identity"),
+            poll_timeout_s=config.get_grpc_idle_timeout_s() + 5.0,
+        )
+    if server_type == "native":
+        from relayrl_tpu.transport.native_backend import NativeAgentTransport
+
+        return NativeAgentTransport(
+            server_addr=overrides.get("server_addr", config.get_traj_server().host_port),
+            identity=overrides.get("identity"),
+        )
+    raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native)")
+
+
+__all__ = [
+    "ServerTransport",
+    "AgentTransport",
+    "make_server_transport",
+    "make_agent_transport",
+    "pack_model_frame",
+    "unpack_model_frame",
+    "pack_trajectory_envelope",
+    "unpack_trajectory_envelope",
+]

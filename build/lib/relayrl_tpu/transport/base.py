@@ -1,0 +1,104 @@
+"""Transport abstractions shared by ZMQ / gRPC / native backends.
+
+The reference hard-wires its two transports into the server/agent classes
+(reference: relayrl_framework/src/network/server/training_server_wrapper.rs:
+329-379 picks TrainingServerZmq vs TrainingServerGrpc; the agent wrapper
+likewise, src/network/client/agent_wrapper.rs:231-270). Here the runtime
+composes against these two small interfaces, so ZMQ, gRPC, the C++ native
+core, and the in-process test transport are interchangeable.
+
+Wire protocol (same message surface as the reference, SURVEY.md §2.3):
+
+* handshake:   agent → ``GET_MODEL``            → server replies model bundle
+               agent → ``MODEL_SET <agent_id>`` → server replies ``ID_LOGGED``
+* trajectory:  agent → envelope{agent_id, trajectory bytes} (fire-and-forget)
+* model push:  server → broadcast {version, bundle bytes} to all agents
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable
+
+import msgpack
+
+# -- command frames (ref: GET_MODEL/MODEL_SET/ID_LOGGED strings,
+#    training_zmq.rs:747-829) --
+CMD_GET_MODEL = b"GET_MODEL"
+CMD_MODEL_SET = b"MODEL_SET"
+REPLY_MODEL = b"MODEL"
+REPLY_ID_LOGGED = b"ID_LOGGED"
+REPLY_ERROR = b"ERROR"
+MODEL_TOPIC = b"model"
+
+
+def pack_trajectory_envelope(agent_id: str, payload: bytes) -> bytes:
+    return msgpack.packb({"id": agent_id, "traj": payload}, use_bin_type=True)
+
+
+def unpack_trajectory_envelope(buf: bytes) -> tuple[str, bytes]:
+    env = msgpack.unpackb(buf, raw=False)
+    return str(env.get("id", "?")), env["traj"]
+
+
+def pack_model_frame(version: int, bundle_bytes: bytes) -> bytes:
+    return msgpack.packb({"ver": int(version), "model": bundle_bytes}, use_bin_type=True)
+
+
+def unpack_model_frame(buf: bytes) -> tuple[int, bytes]:
+    frame = msgpack.unpackb(buf, raw=False)
+    return int(frame["ver"]), frame["model"]
+
+
+class ServerTransport(abc.ABC):
+    """Server-side: accept handshakes, ingest trajectories, publish models.
+
+    ``on_trajectory(agent_id, payload)`` is invoked from transport threads —
+    implementations must be thread-safe; the training server funnels into a
+    queue.
+    ``get_model()`` returns the current ``(version, bundle_bytes)`` for
+    handshakes.
+    ``on_register(agent_id)`` records an agent (multi-actor registry,
+    ref: training_server_wrapper.rs:159-163).
+    """
+
+    def __init__(self):
+        self.on_trajectory: Callable[[str, bytes], None] = lambda *_: None
+        self.get_model: Callable[[], tuple[int, bytes]] = lambda: (0, b"")
+        self.on_register: Callable[[str], None] = lambda *_: None
+
+    @abc.abstractmethod
+    def start(self) -> None: ...
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+    @abc.abstractmethod
+    def publish_model(self, version: int, bundle_bytes: bytes) -> None:
+        """Broadcast a fresh model to every connected agent."""
+
+
+class AgentTransport(abc.ABC):
+    """Agent-side: handshake, trajectory send, model-update subscription."""
+
+    def __init__(self):
+        self.on_model: Callable[[int, bytes], None] = lambda *_: None
+
+    @abc.abstractmethod
+    def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
+        """Blocking initial handshake: returns (version, bundle bytes)
+        (ref: initial_model_handshake, agent_zmq.rs:316-442)."""
+
+    @abc.abstractmethod
+    def register(self, agent_id: str, timeout_s: float = 10.0) -> bool:
+        """MODEL_SET/ID_LOGGED registration."""
+
+    @abc.abstractmethod
+    def send_trajectory(self, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def start_model_listener(self) -> None:
+        """Begin delivering model updates to ``on_model`` asynchronously."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...

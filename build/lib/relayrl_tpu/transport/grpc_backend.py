@@ -86,13 +86,7 @@ class _Servicer:
 
 class GrpcServerTransport(ServerTransport):
     def __init__(self, bind_addr: str, idle_timeout_s: float = 30.0,
-                 max_workers: int = 128):
-        # max_workers bounds concurrent RPCs, and every subscribed agent
-        # parks one long-poll (ClientPoll) thread on the server: the pool
-        # must exceed the fleet size or late joiners' handshakes starve
-        # behind parked polls (observed at 64 actors with the old 16).
-        # The reference's tonic server is async and has no such limit —
-        # this is the sync-grpcio translation of that property.
+                 max_workers: int = 16):
         super().__init__()
         self._bind_addr = bind_addr
         self.idle_timeout_s = float(idle_timeout_s)
@@ -156,7 +150,6 @@ class GrpcAgentTransport(AgentTransport):
             f"/{_SERVICE}/ClientPoll",
             request_serializer=_identity, response_deserializer=_identity)
         self._known_version = -1
-        self._inflight = None
         self._stop = threading.Event()
         self._listener: threading.Thread | None = None
 
@@ -164,15 +157,7 @@ class GrpcAgentTransport(AgentTransport):
         req = msgpack.packb(
             {"id": self.identity, "ver": self._known_version, "first": first},
             use_bin_type=True)
-        # future-based invocation so close() can cancel a parked long-poll
-        # instead of waiting out its full timeout (64 agents x 35 s
-        # otherwise serializes shutdown into minutes).
-        call = self._poll.future(req, timeout=timeout_s)
-        self._inflight = call
-        try:
-            resp = msgpack.unpackb(call.result(), raw=False)
-        finally:
-            self._inflight = None
+        resp = msgpack.unpackb(self._poll(req, timeout=timeout_s), raw=False)
         if resp.get("code") == 1:
             self._known_version = int(resp["ver"])
             return int(resp["ver"]), resp["model"]
@@ -221,8 +206,7 @@ class GrpcAgentTransport(AgentTransport):
         while not self._stop.is_set():
             try:
                 result = self._poll_once(first=False, timeout_s=self._poll_timeout_s)
-            except (grpc.RpcError, grpc.FutureCancelledError):
-                # FutureCancelledError: close() cancelled the parked poll.
+            except grpc.RpcError:
                 if self._stop.wait(1.0):
                     break
                 continue
@@ -232,15 +216,6 @@ class GrpcAgentTransport(AgentTransport):
     def close(self) -> None:
         self._stop.set()
         if self._listener is not None:
-            # Cancel-in-a-loop: a single cancel can miss the window where
-            # the listener is between polls and about to park a fresh
-            # 35 s future (TOCTOU) — keep cancelling whatever is in
-            # flight until the thread exits.
-            deadline = time.monotonic() + 10
-            while self._listener.is_alive() and time.monotonic() < deadline:
-                inflight = self._inflight
-                if inflight is not None:
-                    inflight.cancel()
-                self._listener.join(timeout=0.2)
+            self._listener.join(timeout=self._poll_timeout_s + 5)
             self._listener = None
         self._channel.close()

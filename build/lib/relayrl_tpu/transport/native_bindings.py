@@ -54,11 +54,6 @@ def _load(lib_path: str) -> ctypes.CDLL:
                                        ctypes.c_int]
     lib.rl_client_send_traj.restype = ctypes.c_int
     lib.rl_client_send_traj.argtypes = [ctypes.c_void_p, u8p, ctypes.c_size_t]
-    lib.rl_client_ping.restype = ctypes.c_int
-    lib.rl_client_ping.argtypes = [ctypes.c_void_p, ctypes.c_int]
-    lib.rl_sub_ping.restype = ctypes.c_int
-    lib.rl_sub_ping.argtypes = [ctypes.c_void_p]
-    lib.rl_server_set_idle_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.rl_sub_connect.restype = ctypes.c_void_p
     lib.rl_sub_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
                                    ctypes.c_int]
@@ -80,17 +75,13 @@ def _parse_host_port(addr: str) -> tuple[str, int]:
 
 
 class NativeServerTransportImpl(ServerTransport):
-    def __init__(self, lib_path: str, bind_addr: str,
-                 idle_timeout_s: float = 0.0):
+    def __init__(self, lib_path: str, bind_addr: str):
         super().__init__()
         self._lib = _load(lib_path)
         host, port = _parse_host_port(bind_addr)
         self._handle = self._lib.rl_server_create(host.encode(), port)
         if not self._handle:
             raise RuntimeError(f"native server bind failed on {bind_addr}")
-        # 0 disables reaping; live agents heartbeat well inside any sane
-        # timeout, so only crashed/partitioned peers are dropped.
-        self._idle_timeout_ms = int(idle_timeout_s * 1000)
         self._poller: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -101,9 +92,6 @@ class NativeServerTransportImpl(ServerTransport):
     def start(self) -> None:
         if self._lib.rl_server_start(self._handle) != 0:
             raise RuntimeError("native server start failed")
-        if self._idle_timeout_ms > 0:
-            self._lib.rl_server_set_idle_timeout(self._handle,
-                                                 self._idle_timeout_ms)
         version, bundle = self.get_model()
         data = _buf(bundle)
         self._lib.rl_server_set_model(self._handle, version, data,
@@ -134,9 +122,6 @@ class NativeServerTransportImpl(ServerTransport):
                                       len(bundle_bytes))
 
     def _poll_loop(self) -> None:
-        # One long-lived buffer, grown on demand: allocating a fresh
-        # ctypes array per event zeroes the whole capacity each time and
-        # dominated the ingest path (~5x at 64-actor scale).
         cap = 1 << 20
         buf = (ctypes.c_uint8 * cap)()
         ev_type = ctypes.c_int(0)
@@ -149,7 +134,7 @@ class NativeServerTransportImpl(ServerTransport):
                 cap = int(n) * 2
                 buf = (ctypes.c_uint8 * cap)()
                 continue
-            payload = ctypes.string_at(buf, int(n))
+            payload = bytes(buf[: int(n)])
             if ev_type.value == _EV_TRAJECTORY:
                 try:
                     agent_id, traj = unpack_trajectory_envelope(payload)
@@ -172,7 +157,6 @@ class NativeAgentTransportImpl(AgentTransport):
         self._host, self._port = _parse_host_port(server_addr)
         self._ctrl = None
         self._sub = None
-        self._heartbeat_s = 0.0
         self._listener: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -224,21 +208,13 @@ class NativeAgentTransportImpl(AgentTransport):
         if self._lib.rl_client_send_traj(ctrl, data, len(env)) != 0:
             raise RuntimeError("native trajectory send failed")
 
-    def ping(self, timeout_s: float = 2.0) -> int:
-        """Liveness probe on the control channel: 0 alive, 2 slow (no pong
-        inside the timeout, connection kept), 1 hard failure healed by
-        redial, -1 dead even after redial."""
-        ctrl = self._ensure_ctrl(timeout_s)
-        return int(self._lib.rl_client_ping(ctrl, int(timeout_s * 1000)))
-
-    def start_model_listener(self, heartbeat_s: float = 5.0) -> None:
+    def start_model_listener(self) -> None:
         if self._listener is not None:
             return
         self._sub = self._lib.rl_sub_connect(self._host.encode(), self._port,
                                              5000)
         if not self._sub:
             raise RuntimeError("native subscribe connection failed")
-        self._heartbeat_s = heartbeat_s
         self._stop.clear()
         self._listener = threading.Thread(target=self._sub_loop,
                                           name="native-model-sub", daemon=True)
@@ -246,31 +222,17 @@ class NativeAgentTransportImpl(AgentTransport):
 
     def _sub_loop(self) -> None:
         cap = 1 << 20
-        buf = (ctypes.c_uint8 * cap)()  # reused; fresh alloc zeroes 1 MiB/poll
         version = ctypes.c_uint64(0)
-        last_beat = time.monotonic()
         while not self._stop.is_set():
+            buf = (ctypes.c_uint8 * cap)()
             n = self._lib.rl_sub_poll(self._sub, 200, ctypes.byref(version),
                                       buf, cap)
-            # Heartbeats between sub polls: the control-channel ping
-            # detects a dead server (and redials C++-side) even when the
-            # agent is neither stepping nor receiving models — op-locked
-            # against concurrent trajectory sends; the sub-channel ping is
-            # the send-only keepalive that stops server idle-reaping from
-            # dropping a one-way subscriber.
-            if (self._heartbeat_s > 0
-                    and time.monotonic() - last_beat >= self._heartbeat_s):
-                last_beat = time.monotonic()
-                if self._ctrl:
-                    self._lib.rl_client_ping(self._ctrl, 1000)
-                self._lib.rl_sub_ping(self._sub)
             if n < 0:
                 continue
             if n > cap:
                 cap = int(n) * 2
-                buf = (ctypes.c_uint8 * cap)()
                 continue
-            self.on_model(int(version.value), ctypes.string_at(buf, int(n)))
+            self.on_model(int(version.value), bytes(buf[: int(n)]))
 
     def close(self) -> None:
         self._stop.set()

@@ -1,0 +1,272 @@
+"""ZeroMQ transport backend.
+
+Capability parity with the reference's ZMQ plane
+(reference: relayrl_framework/src/network/server/training_zmq.rs — ROUTER
+agent-listener at :669-864, PULL trajectory ingest at :948-1058, model push
+at :876-934; client side src/network/client/agent_zmq.rs — DEALER handshake
+at :316-442, PUSH trajectory via types/trajectory.rs:69-90, model listener
+thread at :625-698).
+
+Deliberate redesigns (documented, SURVEY.md §7.5):
+
+* **PUB/SUB model broadcast.** The reference has the *agent* bind a PULL
+  socket and the server connect per update (agent_zmq.rs:632-638 /
+  training_zmq.rs:921-927) — one bind address means >1 agent cannot receive
+  models. Server-side PUB with agent-side SUB is the topology that actually
+  broadcasts; it's why the north-star "64 ZMQ actors" config is reachable.
+* **Blocking polls, not 50 ms sleep loops.** All reference loops poll
+  non-blocking sockets every 50 ms (training_zmq.rs:860,1053), a latency
+  floor and a busy-wait; here every loop blocks in ``zmq.Poller`` with a
+  shutdown-check timeout.
+* **Persistent PUSH socket.** The reference opens a fresh PUSH connection per
+  trajectory send (trajectory.rs:69-90); here one connected socket per agent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import zmq
+
+from relayrl_tpu.transport.base import (
+    AgentTransport,
+    CMD_GET_MODEL,
+    CMD_MODEL_SET,
+    MODEL_TOPIC,
+    REPLY_ERROR,
+    REPLY_ID_LOGGED,
+    REPLY_MODEL,
+    ServerTransport,
+    pack_model_frame,
+    unpack_model_frame,
+    unpack_trajectory_envelope,
+)
+
+_POLL_MS = 100  # shutdown-check cadence for otherwise-blocking polls
+
+
+def _bind_with_retry(sock: zmq.Socket, addr: str, timeout_s: float = 3.0) -> None:
+    """Bind, tolerating the brief window where a just-closed socket's port is
+    still being released (restart_server re-binds the same addresses)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            sock.bind(addr)
+            return
+        except zmq.ZMQError as e:
+            if e.errno != zmq.EADDRINUSE or time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+class ZmqServerTransport(ServerTransport):
+    """ROUTER handshake + PULL trajectory ingest + PUB model broadcast."""
+
+    def __init__(self, agent_listener_addr: str, trajectory_addr: str,
+                 model_pub_addr: str):
+        super().__init__()
+        self._addrs = (agent_listener_addr, trajectory_addr, model_pub_addr)
+        self._ctx: zmq.Context | None = None
+        self._pub: zmq.Socket | None = None
+        self._pub_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._ctx = zmq.Context.instance()
+        listener_addr, traj_addr, pub_addr = self._addrs
+        self._pub = self._ctx.socket(zmq.PUB)
+        _bind_with_retry(self._pub, pub_addr)
+        self._threads = [
+            threading.Thread(target=self._listener_loop, args=(listener_addr,),
+                             name="zmq-agent-listener", daemon=True),
+            threading.Thread(target=self._trajectory_loop, args=(traj_addr,),
+                             name="zmq-trajectory-ingest", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+        if self._pub is not None:
+            self._pub.close(linger=0)
+            self._pub = None
+
+    def publish_model(self, version: int, bundle_bytes: bytes) -> None:
+        if self._pub is None:
+            raise RuntimeError("transport not started")
+        with self._pub_lock:
+            self._pub.send_multipart([MODEL_TOPIC, pack_model_frame(version, bundle_bytes)])
+
+    # -- loops --
+    def _listener_loop(self, addr: str) -> None:
+        """ROUTER: GET_MODEL → model reply; MODEL_SET → register + ID_LOGGED
+        (ref: _listen_for_agents, training_zmq.rs:669-864 — minus the
+        break-after-first-registration single-actor quirk at :826-829)."""
+        sock = self._ctx.socket(zmq.ROUTER)
+        _bind_with_retry(sock, addr)
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        try:
+            while not self._stop.is_set():
+                if not dict(poller.poll(_POLL_MS)):
+                    continue
+                frames = sock.recv_multipart()
+                # ROUTER framing: [identity, (empty,) cmd, args...]
+                identity, rest = frames[0], frames[1:]
+                if rest and rest[0] == b"":
+                    rest = rest[1:]
+                if not rest:
+                    continue
+                cmd = rest[0]
+                if cmd == CMD_GET_MODEL:
+                    version, bundle = self.get_model()
+                    sock.send_multipart(
+                        [identity, REPLY_MODEL, pack_model_frame(version, bundle)])
+                elif cmd == CMD_MODEL_SET:
+                    agent_id = rest[1].decode() if len(rest) > 1 else identity.decode(
+                        errors="replace")
+                    self.on_register(agent_id)
+                    sock.send_multipart([identity, REPLY_ID_LOGGED])
+                else:
+                    sock.send_multipart([identity, REPLY_ERROR, b"unknown command"])
+        finally:
+            sock.close(linger=0)
+
+    def _trajectory_loop(self, addr: str) -> None:
+        """PULL ingest (ref: _start_training_loop recv half,
+        training_zmq.rs:948-1011)."""
+        sock = self._ctx.socket(zmq.PULL)
+        _bind_with_retry(sock, addr)
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        try:
+            while not self._stop.is_set():
+                if not dict(poller.poll(_POLL_MS)):
+                    continue
+                buf = sock.recv()
+                try:
+                    agent_id, payload = unpack_trajectory_envelope(buf)
+                except Exception:
+                    continue  # malformed frame: drop, never crash ingest
+                self.on_trajectory(agent_id, payload)
+        finally:
+            sock.close(linger=0)
+
+
+class ZmqAgentTransport(AgentTransport):
+    """DEALER handshake + PUSH trajectories + SUB model updates."""
+
+    def __init__(self, agent_listener_addr: str, trajectory_addr: str,
+                 model_sub_addr: str, identity: str | None = None):
+        super().__init__()
+        import os
+        import secrets
+
+        self._identity = (identity or
+                          f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}").encode()
+        self._ctx = zmq.Context.instance()
+        self._addrs = (agent_listener_addr, trajectory_addr, model_sub_addr)
+        self._dealer = self._ctx.socket(zmq.DEALER)
+        self._dealer.setsockopt(zmq.IDENTITY, self._identity)
+        self._dealer.connect(agent_listener_addr)
+        self._push = self._ctx.socket(zmq.PUSH)
+        self._push.connect(trajectory_addr)
+        self._push_lock = threading.Lock()
+        self._sub: zmq.Socket | None = None
+        self._listener: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    @property
+    def identity(self) -> str:
+        return self._identity.decode()
+
+    def _dealer_request(self, frames: list[bytes], timeout_s: float,
+                        want: bytes):
+        """Send a request and wait for a reply whose first frame is ``want``.
+
+        Replies of other types are discarded: the handshake may re-send
+        GET_MODEL on a slow server, leaving stale MODEL replies queued ahead
+        of a later ID_LOGGED — request/response pairing on a DEALER is by
+        reply type, not ordering.
+        """
+        deadline = time.monotonic() + timeout_s
+        poller = zmq.Poller()
+        poller.register(self._dealer, zmq.POLLIN)
+        self._dealer.send_multipart(frames)
+        while time.monotonic() < deadline:
+            if dict(poller.poll(_POLL_MS)):
+                reply = self._dealer.recv_multipart()
+                if reply and reply[0] == want:
+                    return reply
+        return None
+
+    def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
+        """Retrying GET_MODEL handshake (ref: agent_zmq.rs:316-442 retries
+        every 1 s forever; here the caller bounds it)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"model handshake timed out after {timeout_s}s "
+                    f"(server at {self._addrs[0]} unreachable?)")
+            reply = self._dealer_request([CMD_GET_MODEL], min(remaining, 2.0),
+                                         want=REPLY_MODEL)
+            if reply and len(reply) > 1:
+                return unpack_model_frame(reply[1])
+
+    def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
+        reply = self._dealer_request(
+            [CMD_MODEL_SET, (agent_id or self.identity).encode()], timeout_s,
+            want=REPLY_ID_LOGGED)
+        return reply is not None
+
+    def send_trajectory(self, payload: bytes) -> None:
+        from relayrl_tpu.transport.base import pack_trajectory_envelope
+
+        with self._push_lock:
+            self._push.send(pack_trajectory_envelope(self.identity, payload))
+
+    def start_model_listener(self) -> None:
+        if self._listener is not None:
+            return
+        self._sub = self._ctx.socket(zmq.SUB)
+        self._sub.connect(self._addrs[2])
+        self._sub.setsockopt(zmq.SUBSCRIBE, MODEL_TOPIC)
+        self._stop.clear()
+        self._listener = threading.Thread(
+            target=self._model_loop, name="zmq-model-listener", daemon=True)
+        self._listener.start()
+
+    def _model_loop(self) -> None:
+        """SUB loop → on_model (ref: OS-thread PULL listener,
+        agent_zmq.rs:625-698)."""
+        poller = zmq.Poller()
+        poller.register(self._sub, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(_POLL_MS)):
+                continue
+            frames = self._sub.recv_multipart()
+            if len(frames) != 2 or frames[0] != MODEL_TOPIC:
+                continue
+            try:
+                version, bundle = unpack_model_frame(frames[1])
+            except Exception:
+                continue
+            self.on_model(version, bundle)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.join(timeout=5)
+            self._listener = None
+        for sock in (self._dealer, self._push, self._sub):
+            if sock is not None:
+                sock.close(linger=0)
+        self._sub = None

@@ -1,0 +1,28 @@
+"""Core data types and wire codecs (ref layer L0/L1, SURVEY.md §1)."""
+
+from relayrl_tpu.types.dtypes import DType, from_numpy_dtype, to_numpy_dtype
+from relayrl_tpu.types.tensor import TensorSpec, decode_tensor, encode_tensor, spec_of
+from relayrl_tpu.types.action import ActionRecord, EXT_TENSOR
+from relayrl_tpu.types.trajectory import (
+    Trajectory,
+    deserialize_actions,
+    serialize_actions,
+)
+from relayrl_tpu.types.model_bundle import ModelBundle, arch_equal
+
+__all__ = [
+    "DType",
+    "from_numpy_dtype",
+    "to_numpy_dtype",
+    "TensorSpec",
+    "encode_tensor",
+    "decode_tensor",
+    "spec_of",
+    "ActionRecord",
+    "EXT_TENSOR",
+    "Trajectory",
+    "serialize_actions",
+    "deserialize_actions",
+    "ModelBundle",
+    "arch_equal",
+]

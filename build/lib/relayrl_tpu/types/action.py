@@ -1,0 +1,163 @@
+"""The per-step record type.
+
+Capability parity with the reference's ``RelayRLAction``
+(reference: relayrl_framework/src/types/action.rs:428-525 — `{obs?, act?,
+mask?, rew: f32, data?: map<String, RelayRLData>, done, reward_updated}` with
+getters and `update_reward`). The aux-data union RelayRLData
+(action.rs:206-218) maps onto msgpack-native scalars plus an ExtType for
+tensors, so the whole record packs as one msgpack map instead of the
+reference's pickle (zmq path, types/trajectory.rs:50-55) or
+JSON-bytes-in-proto (grpc path, sys_utils/grpc_utils.rs:31-66).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import msgpack
+import numpy as np
+
+from relayrl_tpu.types.tensor import decode_tensor, encode_tensor
+
+# msgpack ExtType code for a wire tensor frame. Part of the wire ABI.
+EXT_TENSOR = 1
+
+AuxValue = Any  # np.ndarray | int | float | str | bool
+
+
+@dataclasses.dataclass
+class ActionRecord:
+    """One environment step: observation, action, mask, reward, aux data.
+
+    ``data`` carries algorithm side-channel values — the reference's REINFORCE
+    stores ``logp_a`` and ``v`` there (algorithms/REINFORCE/REINFORCE.py usage
+    of ``data['v']``/``data['logp_a']``) and this framework's policies do the
+    same, so trajectories are self-contained for the learner.
+    """
+
+    obs: np.ndarray | None = None
+    act: np.ndarray | None = None
+    mask: np.ndarray | None = None
+    rew: float = 0.0
+    data: dict[str, AuxValue] | None = None
+    done: bool = False
+    reward_updated: bool = False
+    # Terminated-vs-truncated distinction the reference lacks: ``done`` says
+    # the episode ended; ``truncated`` says it ended by time limit, not by
+    # reaching a terminal state — value targets must still bootstrap through
+    # a truncation (Gymnasium step() semantics).
+    truncated: bool = False
+
+    # -- reference getter parity (action.rs:454-525) --
+    def get_obs(self) -> np.ndarray | None:
+        return self.obs
+
+    def get_act(self) -> np.ndarray | None:
+        return self.act
+
+    def get_mask(self) -> np.ndarray | None:
+        return self.mask
+
+    def get_rew(self) -> float:
+        return self.rew
+
+    def get_data(self) -> dict[str, AuxValue] | None:
+        return self.data
+
+    def get_done(self) -> bool:
+        return self.done
+
+    def get_truncated(self) -> bool:
+        return self.truncated
+
+    def update_reward(self, reward: float) -> None:
+        self.rew = float(reward)
+        self.reward_updated = True
+
+    # -- wire codec --
+    def to_wire(self) -> dict:
+        return {
+            "o": _pack_opt_tensor(self.obs),
+            "a": _pack_opt_tensor(self.act),
+            "m": _pack_opt_tensor(self.mask),
+            "r": float(self.rew),
+            "d": _pack_aux(self.data),
+            "t": bool(self.done),
+            "u": bool(self.reward_updated),
+            "x": bool(self.truncated),
+        }
+
+    @classmethod
+    def from_wire(cls, wire: Mapping) -> "ActionRecord":
+        return cls(
+            obs=_unpack_opt_tensor(wire.get("o")),
+            act=_unpack_opt_tensor(wire.get("a")),
+            mask=_unpack_opt_tensor(wire.get("m")),
+            rew=float(wire.get("r", 0.0)),
+            data=_unpack_aux(wire.get("d")),
+            done=bool(wire.get("t", False)),
+            reward_updated=bool(wire.get("u", False)),
+            truncated=bool(wire.get("x", False)),
+        )
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb(self.to_wire(), use_bin_type=True)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes) -> "ActionRecord":
+        return cls.from_wire(
+            msgpack.unpackb(buf, raw=False, ext_hook=_ext_hook, strict_map_key=False)
+        )
+
+
+def _pack_opt_tensor(value) -> msgpack.ExtType | None:
+    if value is None:
+        return None
+    return msgpack.ExtType(EXT_TENSOR, encode_tensor(value))
+
+
+def _unpack_opt_tensor(value):
+    if value is None:
+        return None
+    if isinstance(value, np.ndarray):  # already decoded by ext_hook
+        return value
+    if isinstance(value, msgpack.ExtType):
+        return decode_tensor(value.data)
+    raise TypeError(f"expected tensor ext frame, got {type(value)!r}")
+
+
+def _pack_aux(data: Mapping[str, AuxValue] | None):
+    if data is None:
+        return None
+    out = {}
+    for key, value in data.items():
+        if isinstance(value, (np.ndarray, np.generic)) and getattr(value, "shape", None) != ():
+            out[key] = msgpack.ExtType(EXT_TENSOR, encode_tensor(value))
+        elif isinstance(value, np.generic):
+            out[key] = value.item()
+        elif isinstance(value, (bool, int, float, str, bytes)):
+            out[key] = value
+        elif hasattr(value, "dtype") and hasattr(value, "shape"):  # jax.Array
+            out[key] = msgpack.ExtType(EXT_TENSOR, encode_tensor(np.asarray(value)))
+        else:
+            raise TypeError(f"aux data {key!r} has unsupported type {type(value)!r}")
+    return out
+
+
+def _unpack_aux(data):
+    if data is None:
+        return None
+    out = {}
+    for key, value in data.items():
+        if isinstance(value, msgpack.ExtType):
+            out[key] = decode_tensor(value.data)
+        else:
+            out[key] = value
+    return out
+
+
+def _ext_hook(code: int, payload: bytes):
+    if code == EXT_TENSOR:
+        return decode_tensor(payload)
+    return msgpack.ExtType(code, payload)

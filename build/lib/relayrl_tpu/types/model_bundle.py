@@ -1,0 +1,108 @@
+"""Model distribution format: params + architecture config + version.
+
+The reference ships whole TorchScript files as the model artifact
+(reference: relayrl_framework/src/sys_utils/grpc_utils.rs:171-205 serializes
+a tch CModule through a temp `.pt` file; agents re-load and validate it,
+src/network/client/agent_wrapper.rs:88-168). A TorchScript blob carries both
+code and weights; JAX params are data-only, so the TPU-native bundle ships
+
+* ``arch``   — a JSON-able architecture config consumed by the model
+               registry (relayrl_tpu.models) to rebuild the pure apply fn on
+               any host (TPU learner or CPU actor),
+* ``params`` — the parameter pytree, serialized with flax.serialization
+               (msgpack of the state dict),
+* ``version`` — a monotonically increasing int. The reference's proto has a
+               version field that the server never increments
+               (training_grpc.rs:722-725); here versioning is real and actors
+               use it to skip stale updates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import msgpack
+
+WIRE_VERSION = 1
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    version: int
+    arch: dict[str, Any]
+    params: Any  # parameter pytree
+
+    def to_bytes(self) -> bytes:
+        from flax import serialization
+
+        wire = {
+            "v": WIRE_VERSION,
+            "ver": int(self.version),
+            "arch": dict(self.arch),
+            "params": serialization.to_bytes(self.params),
+        }
+        return msgpack.packb(wire, use_bin_type=True)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes, params_template: Any | None = None) -> "ModelBundle":
+        """Decode a bundle.
+
+        ``params_template`` — when given, params are restored *into* this
+        pytree structure (flax ``from_bytes``), preserving custom node types;
+        otherwise they come back as nested dicts of numpy arrays, which is
+        exactly what a pure apply fn needs.
+        """
+        from flax import serialization
+
+        wire = msgpack.unpackb(buf, raw=False, strict_map_key=False)
+        if wire.get("v") != WIRE_VERSION:
+            raise ValueError(f"unsupported model bundle version: {wire.get('v')}")
+        raw = wire["params"]
+        if params_template is not None:
+            params = serialization.from_bytes(params_template, raw)
+        else:
+            params = serialization.msgpack_restore(raw)
+        return cls(version=int(wire["ver"]), arch=dict(wire["arch"]), params=params)
+
+    # -- file helpers (the reference's server reads model bytes off disk to
+    #    serve agents, training_zmq.rs:905-919; we keep a file path too so
+    #    checkpoint/resume and debugging can inspect the artifact) --
+    def save(self, path) -> None:
+        import os
+
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(self.to_bytes())
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path, params_template: Any | None = None) -> "ModelBundle":
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read(), params_template)
+
+
+# Arch keys the learner may legitimately change between publishes without
+# changing the parameter ABI — exploration schedules ride the arch config
+# (e.g. DQN anneals `epsilon`, DDPG/TD3 tune `act_noise`). Everything else
+# is structural: a mismatch means the params won't fit the network.
+EXPLORATION_ARCH_KEYS = frozenset({"epsilon", "act_noise"})
+
+
+def exploration_kwargs(arch: Mapping[str, Any]) -> dict[str, Any]:
+    """Exploration knobs present in ``arch`` as device scalars, to pass as
+    traced ``step`` kwargs — the single construction both in-process actors
+    and the networked PolicyActor use, so annealing a knob never retraces."""
+    import jax.numpy as jnp
+
+    return {k: jnp.float32(arch[k]) for k in EXPLORATION_ARCH_KEYS
+            if k in arch}
+
+
+def arch_equal(a: Mapping[str, Any], b: Mapping[str, Any]) -> bool:
+    """Structural arch-config equality — the actor refuses a hot-swap whose
+    arch differs from the one it validated at handshake (param-ABI guard,
+    SURVEY.md §7.4 item 2). Exploration-only keys are exempt."""
+    sa = {k: v for k, v in a.items() if k not in EXPLORATION_ARCH_KEYS}
+    sb = {k: v for k, v in b.items() if k not in EXPLORATION_ARCH_KEYS}
+    return sa == sb

@@ -1,0 +1,125 @@
+"""Trajectory type + wire codec.
+
+Capability parity with the reference's ``RelayRLTrajectory``
+(reference: relayrl_framework/src/types/trajectory.rs:95-203 — Vec of actions
++ max_length + `add_action(action, send_if_done)` which serializes and PUSHes
+to the trajectory server when a done action arrives).
+
+Deliberate departures from the reference (documented per SURVEY.md §7.5):
+
+* **msgpack, not pickle.** The reference pickles `Vec<RelayRLAction>`
+  (trajectory.rs:50-55); unpickling network input is code execution on the
+  training server. The wire format here is msgpack + tensor ext frames.
+* **Transport-agnostic send hook.** The reference hardcodes a fresh ZMQ PUSH
+  socket per send (trajectory.rs:69-90); here the owner injects an
+  ``on_send(bytes)`` callable so the same type serves ZMQ, gRPC, the native
+  C++ transport, and in-process tests.
+* **Buffer always clears after send.** The reference clears only when
+  ``len >= max_length`` so earlier episodes are re-sent cumulatively
+  (trajectory.rs:196-202) — a bug we do not replicate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import msgpack
+
+from relayrl_tpu.types.action import ActionRecord, _ext_hook
+
+WIRE_VERSION = 1
+
+
+class Trajectory:
+    """Ordered actions for one (or part of one) episode."""
+
+    def __init__(
+        self,
+        max_length: int = 1000,
+        on_send: Callable[[bytes], None] | None = None,
+    ):
+        if max_length <= 0:
+            raise ValueError("max_length must be positive")
+        self.max_length = int(max_length)
+        self._on_send = on_send
+        self._actions: list[ActionRecord] = []
+
+    # -- reference API parity (trajectory.rs:95-203) --
+    @property
+    def actions(self) -> list[ActionRecord]:
+        return self._actions
+
+    def get_actions(self) -> list[ActionRecord]:
+        return self._actions
+
+    def __len__(self) -> int:
+        return len(self._actions)
+
+    def add_action(self, action: ActionRecord, send_if_done: bool = True) -> bool:
+        """Append; on a done action (or overflow) ship and clear.
+
+        Returns True only when the trajectory was actually handed to a
+        transport. Without an ``on_send`` hook the actions are retained for
+        the caller to read (local/offline collection), bounded by eviction of
+        the oldest entries at capacity.
+
+        Capacity is enforced *before* appending a real step, so chunks
+        never exceed ``max_length`` steps — but a terminal marker (act-less
+        record from ``flag_last_action``) always joins the chunk it ends:
+        markers fold into the preceding step learner-side, so the chunk
+        still pads into its ``max_length`` bucket, and flushing before the
+        marker instead would strand it in a marker-only send that loses
+        the final reward and bootstrap obs.
+        """
+        is_marker = action.act is None
+        if not is_marker and len(self._actions) >= self.max_length:
+            if send_if_done and self._on_send is not None:
+                self.flush()
+            else:
+                # No transport attached: evict oldest rather than grow
+                # unbounded.
+                del self._actions[: max(1, self.max_length // 2)]
+        self._actions.append(action)
+        if action.done and send_if_done and self._on_send is not None:
+            self.flush()
+            return True
+        return False
+
+    def flush(self) -> None:
+        """Serialize + hand off to the transport, then clear.
+
+        No-op without a transport — data is never silently discarded; use
+        :meth:`clear` to drop it explicitly.
+        """
+        if not self._actions or self._on_send is None:
+            return
+        self._on_send(self.to_bytes())
+        self._actions.clear()
+
+    def clear(self) -> None:
+        self._actions.clear()
+
+    # -- wire codec --
+    def to_bytes(self) -> bytes:
+        return serialize_actions(self._actions)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes, max_length: int | None = None) -> "Trajectory":
+        actions = deserialize_actions(buf)
+        traj = cls(max_length=max_length or max(len(actions), 1))
+        traj._actions = actions
+        return traj
+
+
+def serialize_actions(actions: Iterable[ActionRecord]) -> bytes:
+    """Actions → one msgpack frame (ref codec: trajectory.rs:50-55)."""
+    wire = {"v": WIRE_VERSION, "acts": [a.to_wire() for a in actions]}
+    return msgpack.packb(wire, use_bin_type=True)
+
+
+def deserialize_actions(buf: bytes | memoryview) -> list[ActionRecord]:
+    wire = msgpack.unpackb(buf, raw=False, ext_hook=_ext_hook, strict_map_key=False)
+    version = wire.get("v")
+    if version != WIRE_VERSION:
+        raise ValueError(f"unsupported trajectory wire version: {version}")
+    return [ActionRecord.from_wire(w) for w in wire["acts"]]

@@ -1,0 +1,27 @@
+"""Observability + misc utilities (ref layer L8, SURVEY.md §1)."""
+
+from relayrl_tpu.utils.logger import (
+    EpochLogger,
+    Logger,
+    colorize,
+    setup_logger_kwargs,
+    statistics_scalar,
+)
+from relayrl_tpu.utils.profiling import (
+    annotate,
+    start_trace_server,
+    timed,
+    trace,
+)
+
+__all__ = [
+    "EpochLogger",
+    "Logger",
+    "colorize",
+    "setup_logger_kwargs",
+    "statistics_scalar",
+    "annotate",
+    "start_trace_server",
+    "timed",
+    "trace",
+]

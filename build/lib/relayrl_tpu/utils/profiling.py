@@ -1,0 +1,53 @@
+"""Profiling hooks: jax.profiler surface (trace server, traces, scopes).
+
+TPU-native equivalent of the reference's tracing stack (SURVEY.md §5.1 —
+tokio-console behind a feature flag plus an optional flamegraph dep):
+a TensorBoard-profile trace server, scoped trace capture to disk, named
+annotations that show up on the TPU timeline, and a block-until-ready
+timing helper for quick latency checks without the full profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+def start_trace_server(port: int = 9999):
+    """Start the profiler gRPC server (connect TensorBoard's profile plugin
+    or `jax.profiler.trace_remote` to it). Returns the server object."""
+    import jax
+
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a trace of the enclosed block to ``log_dir`` (viewable in
+    TensorBoard -> Profile, or Perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named scope that appears on the device timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def timed(fn, *args, **kwargs):
+    """(result, seconds) with device work flushed — the
+    ``block_until_ready`` timing harness of SURVEY.md §5.1."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0

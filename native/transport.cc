@@ -48,6 +48,8 @@ constexpr uint8_t kFrameModelSet = 4;
 constexpr uint8_t kFrameIdLogged = 5;
 constexpr uint8_t kFrameSubscribe = 6;
 constexpr uint8_t kFrameModelPush = 7;
+constexpr uint8_t kFramePing = 8;
+constexpr uint8_t kFramePong = 9;
 
 constexpr size_t kMaxFrame = 1ull << 30;  // 1 GiB hard cap
 constexpr size_t kHeader = 5;             // u32 len + u8 type
@@ -73,6 +75,8 @@ struct Conn {
   std::vector<uint8_t> rbuf;
   std::deque<std::vector<uint8_t>> wqueue;
   size_t woff = 0;  // offset into wqueue.front()
+  std::chrono::steady_clock::time_point last_activity =
+      std::chrono::steady_clock::now();
 };
 
 struct Event {
@@ -168,6 +172,8 @@ class Server {
 
   uint16_t port() const { return port_; }
 
+  void set_idle_timeout(int ms) { idle_timeout_ms_.store(ms); }
+
  private:
   void wake() {
     if (wake_fd_ >= 0) {
@@ -215,7 +221,26 @@ class Server {
         }
       }
       maybe_broadcast();
+      reap_idle();
     }
+  }
+
+  // Drop connections silent past the configured idle timeout (0 = never).
+  // Live agents heartbeat (kFramePing) well inside any sane timeout, so
+  // only crashed/partitioned peers are reaped; their fd/queue state stops
+  // accumulating in a long-lived server.
+  void reap_idle() {
+    int timeout_ms = idle_timeout_ms_.load();
+    if (timeout_ms <= 0) return;
+    auto now = std::chrono::steady_clock::now();
+    std::vector<int> dead;
+    for (auto& [fd, conn] : conns_) {
+      auto idle = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      now - conn.last_activity)
+                      .count();
+      if (idle > timeout_ms) dead.push_back(fd);
+    }
+    for (int fd : dead) drop(fd);
   }
 
   void accept_new() {
@@ -240,6 +265,7 @@ class Server {
   }
 
   bool handle_read(Conn& c) {
+    c.last_activity = std::chrono::steady_clock::now();
     char tmp[65536];
     while (true) {
       ssize_t r = recv(c.fd, tmp, sizeof(tmp), 0);
@@ -293,6 +319,12 @@ class Server {
       case kFrameSubscribe:
         c.subscriber = true;
         return true;
+      case kFramePing:
+        // Heartbeat: clients ping to detect a dead server and keep
+        // middleboxes from reaping idle connections; the pong doubles as
+        // the server-side liveness proof (last_activity is refreshed by
+        // any read, including this ping).
+        return send_frame(c, kFramePong, nullptr, 0);
       default:
         return true;  // ignore unknown frame types (forward compat)
     }
@@ -327,8 +359,14 @@ class Server {
     std::vector<int> dead;
     for (auto& [fd, conn] : conns_) {
       if (!conn.subscriber) continue;
-      if (!send_frame(conn, kFrameModelPush, body.data(), body.size()))
+      if (send_frame(conn, kFrameModelPush, body.data(), body.size())) {
+        // A successful broadcast write counts as liveness for reaping:
+        // subscribers are one-way and must not be churned between their
+        // keepalive pings.
+        conn.last_activity = std::chrono::steady_clock::now();
+      } else {
         dead.push_back(fd);
+      }
     }
     for (int fd : dead) drop(fd);
   }
@@ -370,6 +408,7 @@ class Server {
 
   int listen_fd_ = -1, epoll_fd_ = -1, wake_fd_ = -1;
   uint16_t port_ = 0;
+  std::atomic<int> idle_timeout_ms_{0};
   std::atomic<bool> running_{false};
   std::thread loop_;
   std::map<int, Conn> conns_;
@@ -391,28 +430,47 @@ class Server {
 class Client {
  public:
   bool connect_to(const char* host, uint16_t port, int timeout_ms) {
-    fd_ = socket(AF_INET, SOCK_STREAM, 0);
-    if (fd_ < 0) return false;
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(port);
-    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
-    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
-    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-    if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
-      return false;
-    int one = 1;
-    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    host_ = host;
+    port_ = port;
+    timeout_ms_ = timeout_ms;
+    return dial();
+  }
+
+  // Tear down and redial the stored endpoint, replaying the Subscribe
+  // frame when this client is a model-broadcast subscriber. The transport
+  // survives a server restart without the embedding process rebuilding
+  // its client objects (the reference's agents retry-loop by hand —
+  // agent_zmq.rs:369-441; here it's in the native core). Holds op_mu_:
+  // the control Client is shared between the env thread (trajectory
+  // sends) and the heartbeat thread — closing/redialling fd_ under a
+  // concurrent send would write a frame tail onto a reused descriptor
+  // and corrupt the length-prefixed stream.
+  bool reconnect() {
+    std::lock_guard<std::recursive_mutex> g(op_mu_);
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+    if (!dial()) return false;
+    if (subscribed_) {
+      if (!send_frame(kFrameSubscribe, nullptr, 0)) return false;
+    }
     return true;
   }
+
+  // Serializes whole operations (send+recv+reconnect sequences) across
+  // the threads sharing this client. Recursive: ops call send_frame /
+  // reconnect which re-lock.
+  std::recursive_mutex op_mu_;
 
   ~Client() {
     if (fd_ >= 0) close(fd_);
   }
 
+  void mark_subscribed() { subscribed_ = true; }
+
   bool send_frame(uint8_t type, const uint8_t* data, size_t len) {
-    std::lock_guard<std::mutex> g(mu_);
+    std::lock_guard<std::recursive_mutex> g(op_mu_);
     auto frame = encode_frame(type, data, len);
     size_t off = 0;
     while (off < frame.size()) {
@@ -427,8 +485,11 @@ class Client {
   }
 
   // Blocking read of the next frame of the wanted type (discarding others),
-  // honoring the socket timeout. Returns false on timeout/error.
+  // honoring the socket timeout. Returns false on timeout/error;
+  // timed_out() distinguishes the two afterwards (timeouts must not
+  // trigger reconnects — the connection is fine, the server is quiet).
   bool recv_frame(uint8_t want, Frame* out) {
+    timed_out_ = false;
     while (true) {
       uint8_t header[kHeader];
       if (!read_exact(header, kHeader)) return false;
@@ -447,15 +508,42 @@ class Client {
   }
 
   void set_timeout(int timeout_ms) {
-    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
-    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    std::lock_guard<std::recursive_mutex> g(op_mu_);
+    timeout_ms_ = timeout_ms;
+    apply_timeout();
   }
+
+  int timeout_ms() const { return timeout_ms_; }
+
+  bool timed_out() const { return timed_out_; }
 
   // A frame held back because the caller's buffer was too small.
   bool has_pending_ = false;
   Frame pending_;
 
  private:
+  bool dial() {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port_);
+    if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) return false;
+    apply_timeout();
+    if (connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return true;
+  }
+
+  void apply_timeout() {
+    if (fd_ < 0) return;
+    timeval tv{timeout_ms_ / 1000, (timeout_ms_ % 1000) * 1000};
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
   bool read_exact(uint8_t* buf, size_t n) {
     size_t off = 0;
     while (off < n) {
@@ -466,14 +554,19 @@ class Client {
         return false;
       } else {
         if (errno == EINTR) continue;
-        return false;  // includes EAGAIN from SO_RCVTIMEO
+        timed_out_ = (errno == EAGAIN || errno == EWOULDBLOCK) && off == 0;
+        return false;
       }
     }
     return true;
   }
 
   int fd_ = -1;
-  std::mutex mu_;
+  std::string host_;
+  uint16_t port_ = 0;
+  int timeout_ms_ = 5000;
+  bool subscribed_ = false;
+  bool timed_out_ = false;
 };
 
 }  // namespace
@@ -498,6 +591,10 @@ uint16_t rl_server_port(void* h) { return static_cast<Server*>(h)->port(); }
 void rl_server_set_model(void* h, uint64_t version, const uint8_t* data,
                          size_t len) {
   static_cast<Server*>(h)->set_model(version, data, len);
+}
+
+void rl_server_set_idle_timeout(void* h, int ms) {
+  static_cast<Server*>(h)->set_idle_timeout(ms);
 }
 
 void rl_server_broadcast(void* h, uint64_t version, const uint8_t* data,
@@ -525,6 +622,7 @@ void rl_client_close(void* h) { delete static_cast<Client*>(h); }
 long rl_client_get_model(void* h, int timeout_ms, uint64_t* version,
                          uint8_t* buf, size_t cap) {
   auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::recursive_mutex> g(c->op_mu_);
   Frame f;
   if (c->has_pending_) {
     f = std::move(c->pending_);
@@ -547,6 +645,7 @@ long rl_client_get_model(void* h, int timeout_ms, uint64_t* version,
 
 int rl_client_register(void* h, const char* id, int timeout_ms) {
   auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::recursive_mutex> g(c->op_mu_);
   c->set_timeout(timeout_ms);
   if (!c->send_frame(kFrameModelSet, reinterpret_cast<const uint8_t*>(id),
                      strlen(id)))
@@ -556,7 +655,32 @@ int rl_client_register(void* h, const char* id, int timeout_ms) {
 }
 
 int rl_client_send_traj(void* h, const uint8_t* data, size_t len) {
-  return static_cast<Client*>(h)->send_frame(kFrameTraj, data, len) ? 0 : -1;
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::recursive_mutex> g(c->op_mu_);
+  if (c->send_frame(kFrameTraj, data, len)) return 0;
+  // One reconnect-and-retry: a dead server connection (restart, network
+  // blip) self-heals without the caller rebuilding the client.
+  if (!c->reconnect()) return -1;
+  return c->send_frame(kFrameTraj, data, len) ? 0 : -1;
+}
+
+// Liveness probe: Ping and wait for the Pong. 0 = alive (pong received),
+// 2 = no pong inside timeout but the connection is intact (slow server —
+// NOT a reconnect trigger), 1 = hard failure healed by redial, -1 = dead
+// even after redial. The previous socket timeout is restored so the probe
+// doesn't clobber the control channel's send/recv deadlines.
+int rl_client_ping(void* h, int timeout_ms) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::recursive_mutex> g(c->op_mu_);
+  int prev_timeout = c->timeout_ms();
+  c->set_timeout(timeout_ms);
+  Frame f;
+  bool sent = c->send_frame(kFramePing, nullptr, 0);
+  bool got = sent && c->recv_frame(kFramePong, &f);
+  c->set_timeout(prev_timeout);
+  if (got) return 0;
+  if (sent && c->timed_out()) return 2;
+  return c->reconnect() ? 1 : -1;
 }
 
 // ---- client subscription channel ----
@@ -567,7 +691,17 @@ void* rl_sub_connect(const char* host, uint16_t port, int timeout_ms) {
     delete c;
     return nullptr;
   }
+  c->mark_subscribed();
   return c;
+}
+
+// Send-only keepalive on the subscription channel: refreshes the server's
+// last_activity for this conn so idle reaping never drops a live
+// subscriber (subscribers otherwise write exactly one frame, ever). The
+// server's Pong is discarded by rl_sub_poll's want-filter.
+int rl_sub_ping(void* h) {
+  auto* c = static_cast<Client*>(h);
+  return c->send_frame(kFramePing, nullptr, 0) ? 0 : (c->reconnect() ? 1 : -1);
 }
 
 long rl_sub_poll(void* h, int timeout_ms, uint64_t* version, uint8_t* buf,
@@ -579,7 +713,12 @@ long rl_sub_poll(void* h, int timeout_ms, uint64_t* version, uint8_t* buf,
     c->has_pending_ = false;
   } else {
     c->set_timeout(timeout_ms);
-    if (!c->recv_frame(kFrameModelPush, &f) || f.payload.size() < 8) return -1;
+    if (!c->recv_frame(kFrameModelPush, &f) || f.payload.size() < 8) {
+      // Hard failure (peer gone) → redial + resubscribe so the next poll
+      // resumes receiving broadcasts; plain timeouts just return -1.
+      if (!c->timed_out()) c->reconnect();
+      return -1;
+    }
   }
   memcpy(version, f.payload.data(), 8);
   size_t n = f.payload.size() - 8;
