@@ -22,7 +22,8 @@ if os.environ.get("RELAYRL_TPU") != "1":
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--algo", default="PPO", choices=["PPO", "IMPALA"])
+    ap.add_argument("--algo", default="PPO",
+                    choices=["PPO", "IMPALA", "DQN", "C51"])
     ap.add_argument("--env", default="synthetic",
                     help='"synthetic" (in-repo catch toy) or an ALE id '
                          'like "ALE/Pong-v5" (needs gymnasium[atari])')
@@ -36,12 +37,10 @@ def main():
 
     env = make_atari(args.env, frame_size=args.frame_size)
     h, w, c = env.obs_shape
-    runner = LocalRunner(
-        env, algorithm_name=args.algo,
-        obs_shape=[h, w, c],
-        model_kind="cnn_discrete",
-        traj_per_epoch=8,
-    )
+    hp = {"obs_shape": [h, w, c], "traj_per_epoch": 8}
+    if args.algo in ("PPO", "IMPALA"):
+        hp["model_kind"] = "cnn_discrete"  # DQN/C51 switch on obs_shape alone
+    runner = LocalRunner(env, algorithm_name=args.algo, **hp)
     done_updates = 0
     while done_updates < args.updates:
         result = runner.train(epochs=min(5, args.updates - done_updates),

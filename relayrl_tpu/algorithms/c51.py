@@ -121,12 +121,18 @@ class C51(EpsilonGreedyMixin, OffPolicyAlgorithm):
             "epsilon": eps0,
             "precision": str(learner.get("precision", "float32")),
         }
+        for key in ("obs_shape", "conv_spec", "dense", "scale_obs"):
+            if key in params:
+                self.arch[key] = params[key]
         self.policy = build_policy(self.arch)
+        from relayrl_tpu.models.q_networks import conv_trunk_kwargs
+
         self._module = DistributionalQNet(
             act_dim=self.act_dim,
             n_atoms=n_atoms,
             hidden_sizes=tuple(self.arch["hidden_sizes"]),
-            compute_dtype=_compute_dtype(self.arch))
+            compute_dtype=_compute_dtype(self.arch),
+            **conv_trunk_kwargs(self.arch))
         support = jnp.linspace(self.arch["v_min"], self.arch["v_max"], n_atoms)
         net_params = self.policy.init_params(self._rng_init)
         tx = optax.adam(float(params.get("lr", 1e-3)))

@@ -88,11 +88,19 @@ class DQN(EpsilonGreedyMixin, OffPolicyAlgorithm):
             "epsilon": eps0,
             "precision": str(learner.get("precision", "float32")),
         }
+        # Pixel variant: obs_shape switches the q-net to the Nature conv
+        # trunk (same arch keys as the cnn_discrete family).
+        for key in ("obs_shape", "conv_spec", "dense", "scale_obs"):
+            if key in params:
+                self.arch[key] = params[key]
         self.policy = build_policy(self.arch)
+        from relayrl_tpu.models.q_networks import conv_trunk_kwargs
+
         self._module = DiscreteQNet(
             act_dim=self.act_dim,
             hidden_sizes=tuple(self.arch["hidden_sizes"]),
-            compute_dtype=_compute_dtype(self.arch))
+            compute_dtype=_compute_dtype(self.arch),
+            **conv_trunk_kwargs(self.arch))
         net_params = self.policy.init_params(self._rng_init)
         tx = optax.adam(float(params.get("lr", 1e-3)))
         self.state = DQNState(

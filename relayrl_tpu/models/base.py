@@ -53,6 +53,13 @@ class Policy:
     * ``evaluate(params, obs, act, mask) -> (logp, entropy, v)`` — the
       learner-side forward for loss computation on ``[..., obs_dim]``.
     * ``mode(params, obs, mask) -> act`` — deterministic action (greedy).
+    * ``step_window(params, rng, window, t, mask) -> (act, aux)`` —
+      optional, sequence policies only: act from a fixed-size
+      right-zero-padded observation window ``[W, obs_dim]`` whose first
+      ``t`` rows are real. One jit signature regardless of history length
+      (causal attention never attends past the read position, so the
+      padding is inert). PolicyActor uses this to serve sequence policies
+      with real context instead of context-1 per request.
     """
 
     arch: dict[str, Any]
@@ -60,6 +67,8 @@ class Policy:
     step: Callable
     evaluate: Callable
     mode: Callable
+    step_window: Callable | None = None
+    mode_window: Callable | None = None
 
     @property
     def input_dim(self) -> int:

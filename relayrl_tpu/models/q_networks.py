@@ -33,37 +33,76 @@ from relayrl_tpu.models.mlp import _MASK_FILL, MLPTrunk, _compute_dtype
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
 
 
+def _q_trunk(hidden_sizes, compute_dtype, obs_shape, conv_spec, dense,
+             scale_obs) -> nn.Module:
+    """The shared trunk switch for both q-heads: ``obs_shape`` set →
+    Nature conv trunk over pixel observations (flat wire vectors or
+    [..., H, W, C]); None → MLP trunk (the reference-parity default).
+    One construction site keeps the DQN and C51 pixel trunks identical."""
+    if obs_shape is not None:
+        from relayrl_tpu.models.cnn import NATURE_CONV, ConvTrunk
+
+        return ConvTrunk(obs_shape, conv_spec or NATURE_CONV, dense,
+                         scale_obs, compute_dtype, name="q_trunk")
+    return MLPTrunk(hidden_sizes, "relu", compute_dtype, name="q_trunk")
+
+
 class DiscreteQNet(nn.Module):
-    """obs -> Q[A] (DQN head)."""
+    """obs -> Q[A] (DQN head); trunk per :func:`_q_trunk`."""
 
     act_dim: int
     hidden_sizes: Sequence[int]
     compute_dtype: Any = jnp.float32
+    obs_shape: Sequence[int] | None = None
+    conv_spec: Sequence[Sequence[int]] | None = None
+    dense: int = 512
+    scale_obs: bool = True
 
     @nn.compact
     def __call__(self, obs):
-        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
-                     name="q_trunk")(obs)
+        h = _q_trunk(self.hidden_sizes, self.compute_dtype, self.obs_shape,
+                     self.conv_spec, self.dense, self.scale_obs)(obs)
         q = nn.Dense(self.act_dim, dtype=self.compute_dtype, name="q_head")(h)
         return q.astype(jnp.float32)
 
 
 class DistributionalQNet(nn.Module):
-    """obs -> logits[A, n_atoms] (C51 head)."""
+    """obs -> logits[A, n_atoms] (C51 head); trunk per :func:`_q_trunk`."""
 
     act_dim: int
     n_atoms: int
     hidden_sizes: Sequence[int]
     compute_dtype: Any = jnp.float32
+    obs_shape: Sequence[int] | None = None
+    conv_spec: Sequence[Sequence[int]] | None = None
+    dense: int = 512
+    scale_obs: bool = True
 
     @nn.compact
     def __call__(self, obs):
-        h = MLPTrunk(self.hidden_sizes, "relu", self.compute_dtype,
-                     name="q_trunk")(obs)
+        h = _q_trunk(self.hidden_sizes, self.compute_dtype, self.obs_shape,
+                     self.conv_spec, self.dense, self.scale_obs)(obs)
         logits = nn.Dense(self.act_dim * self.n_atoms,
                           dtype=self.compute_dtype, name="q_head")(h)
         return logits.astype(jnp.float32).reshape(
             *logits.shape[:-1], self.act_dim, self.n_atoms)
+
+
+def conv_trunk_kwargs(arch: Mapping[str, Any]) -> dict:
+    """Arch → the pixel-trunk kwargs shared by the q-net builders and the
+    DQN/C51 learner modules (both must construct identical module configs
+    or the param trees diverge)."""
+    obs_shape = arch.get("obs_shape")
+    if obs_shape is None:
+        return {}
+    return {
+        "obs_shape": tuple(int(d) for d in obs_shape),
+        "conv_spec": tuple(tuple(int(x) for x in row)
+                           for row in arch["conv_spec"])
+        if arch.get("conv_spec") else None,
+        "dense": int(arch.get("dense", 512)),
+        "scale_obs": bool(arch.get("scale_obs", True)),
+    }
 
 
 class QValueNet(nn.Module):
@@ -170,6 +209,7 @@ def build_qnet_discrete(arch: Mapping[str, Any]) -> Policy:
         act_dim=int(arch["act_dim"]),
         hidden_sizes=mlp_sizes(arch),
         compute_dtype=_compute_dtype(arch),
+        **conv_trunk_kwargs(arch),
     )
     obs_dim = int(arch["obs_dim"])
     epsilon_default = float(arch.get("epsilon", 0.05))
@@ -217,6 +257,7 @@ def build_c51_discrete(arch: Mapping[str, Any]) -> Policy:
         n_atoms=int(arch.get("n_atoms", 51)),
         hidden_sizes=mlp_sizes(arch),
         compute_dtype=_compute_dtype(arch),
+        **conv_trunk_kwargs(arch),
     )
     obs_dim = int(arch["obs_dim"])
     epsilon_default = float(arch.get("epsilon", 0.05))

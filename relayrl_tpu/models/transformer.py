@@ -207,5 +207,28 @@ def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
         act = jnp.argmax(logits[:, -1], axis=-1)
         return act if lead == "batch" else act[0]
 
+    def _window_logits(params, window, t, mask):
+        obs_b, mask_b, _ = _as_btd(window, mask)
+        logits, v = core.apply(params, obs_b, mask_b)
+        idx = jnp.clip(t - 1, 0, obs_b.shape[1] - 1)
+        return logits[0, idx], v[0, idx]
+
+    def step_window(params, rng, window, t, mask=None):
+        """Act from a right-zero-padded history window ``[W, obs_dim]``
+        with ``t`` real rows: the readout position t-1 only attends
+        positions < t (causal), so the zero padding is never seen and one
+        fixed shape serves every history length — the actor-side fix for
+        the train(full sequence)/serve(context-1) mismatch."""
+        logits_t, v_t = _window_logits(params, window, t, mask)
+        act = jax.random.categorical(rng, logits_t, axis=-1)
+        return act, {"logp_a": _categorical_logp(logits_t, act), "v": v_t}
+
+    def mode_window(params, window, t, mask=None):
+        """Greedy readout from the history window (the deterministic-eval
+        counterpart of step_window)."""
+        logits_t, _ = _window_logits(params, window, t, mask)
+        return jnp.argmax(logits_t, axis=-1)
+
     return Policy(arch=dict(arch), init_params=init_params, step=step,
-                  evaluate=evaluate, mode=mode)
+                  evaluate=evaluate, mode=mode, step_window=step_window,
+                  mode_window=mode_window)

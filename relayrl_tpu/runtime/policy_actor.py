@@ -51,6 +51,30 @@ class PolicyActor:
         self.params = bundle.params
         self.version = bundle.version
         self._step_fn = jax.jit(self.policy.step)
+        self._mode_fn = jax.jit(self.policy.mode)
+        # Sequence policies act from a rolling obs-history window so
+        # serving context matches training (ADVICE r1: context-1 serving).
+        # Default window = the model's full context, so serving positions
+        # match training exactly up to max_seq_len; past that the window
+        # rolls (newest max_seq_len obs at positions 0..W-1), an
+        # approximation since training pads/truncates from the episode
+        # start — keep episodes within max_seq_len for exact parity.
+        self._window_fn = None
+        self._mode_window_fn = None
+        self._window = None
+        self._window_len = 0
+        if self.policy.step_window is not None:
+            max_seq = int(self.arch.get("max_seq_len", 64))
+            ctx = int(self.arch.get("actor_context", max_seq))
+            if ctx > max_seq:
+                raise ValueError(
+                    f"actor_context {ctx} exceeds the model's max_seq_len "
+                    f"{max_seq} (positional table size)")
+            self._window = np.zeros((ctx, int(self.arch["obs_dim"])),
+                                    np.float32)
+            self._window_fn = jax.jit(self.policy.step_window)
+            if self.policy.mode_window is not None:
+                self._mode_window_fn = jax.jit(self.policy.mode_window)
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
@@ -67,8 +91,13 @@ class PolicyActor:
         mask_arr = None if mask is None else np.asarray(mask, dtype=np.float32)
         with self._lock:
             self._rng, sub = jax.random.split(self._rng)
-            act, aux = self._step_fn(self.params, sub, obs, mask_arr,
-                                     **self._explore_kwargs)
+            if self._window_fn is not None:
+                self._push_window(obs)
+                act, aux = self._window_fn(self.params, sub, self._window,
+                                           self._window_len, mask_arr)
+            else:
+                act, aux = self._step_fn(self.params, sub, obs, mask_arr,
+                                         **self._explore_kwargs)
             record = ActionRecord(
                 obs=obs,
                 act=np.asarray(act),
@@ -106,6 +135,11 @@ class PolicyActor:
         if terminated:
             truncated = False
         with self._lock:
+            if self._window is not None:
+                # Episode boundary: the next episode must not attend this
+                # one's observations.
+                self._window[:] = 0.0
+                self._window_len = 0
             record = ActionRecord(
                 obs=(None if final_obs is None
                      else np.asarray(final_obs, np.float32)),
@@ -146,11 +180,29 @@ class PolicyActor:
     def swap_from_bytes(self, buf: bytes) -> bool:
         return self.maybe_swap(ModelBundle.from_bytes(buf))
 
+    def _push_window(self, obs: np.ndarray) -> None:
+        """Append one observation to the rolling history (lock held)."""
+        if self._window_len < self._window.shape[0]:
+            self._window[self._window_len] = obs
+            self._window_len += 1
+        else:  # rolling: drop the oldest step
+            self._window[:-1] = self._window[1:]
+            self._window[-1] = obs
+
     def deterministic_action(self, obs, mask=None):
+        """Greedy action. For sequence policies this ADVANCES the history
+        window (greedy eval episodes need context too); call
+        flag_last_action at episode end to reset it, as in the sampling
+        loop."""
+        obs_arr = np.asarray(obs, np.float32)
+        mask_arr = None if mask is None else np.asarray(mask, np.float32)
         with self._lock:
-            act = jax.jit(self.policy.mode)(
-                self.params, np.asarray(obs, np.float32),
-                None if mask is None else np.asarray(mask, np.float32))
+            if self._mode_window_fn is not None:
+                self._push_window(obs_arr)
+                act = self._mode_window_fn(self.params, self._window,
+                                           self._window_len, mask_arr)
+            else:
+                act = self._mode_fn(self.params, obs_arr, mask_arr)
         return np.asarray(act)
 
 

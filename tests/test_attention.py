@@ -266,3 +266,84 @@ class TestTransformerPolicy:
             out = jax.jit(ring.evaluate)(params, obs, act)
         for a, b in zip(ref, out):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestStepWindow:
+    """Actor-side history window (train/serve context parity fix)."""
+
+    def test_padded_window_matches_unpadded_sequence(self):
+        # Right-zero padding past t must be inert: causal attention at the
+        # readout position t-1 never attends positions >= t.
+        policy = build_policy(ARCH)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(3)
+        t, W = 5, 12
+        seq = rng.standard_normal((t, 8)).astype(np.float32)
+        window = np.zeros((W, 8), np.float32)
+        window[:t] = seq
+        key = jax.random.PRNGKey(7)
+        act_w, aux_w = policy.step_window(params, key, window, t)
+        act_s, aux_s = policy.step(params, key, seq)
+        assert int(act_w) == int(act_s)
+        np.testing.assert_allclose(float(aux_w["logp_a"]),
+                                   float(aux_s["logp_a"]), rtol=1e-5)
+        np.testing.assert_allclose(float(aux_w["v"]), float(aux_s["v"]),
+                                   rtol=1e-5)
+
+    def test_actor_serves_with_context(self):
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        policy = build_policy({**ARCH, "actor_context": 8})
+        params = policy.init_params(jax.random.PRNGKey(0))
+        actor = PolicyActor(ModelBundle(version=1, arch={**ARCH,
+                                                         "actor_context": 8},
+                                        params=params))
+        rng = np.random.default_rng(0)
+        for i in range(11):  # overflow the 8-window: rolling path runs
+            actor.request_for_action(rng.standard_normal(8))
+        assert actor._window_len == 8
+        # Window holds the newest observations, oldest dropped.
+        actor.flag_last_action(0.0, terminated=True)
+        assert actor._window_len == 0 and not actor._window.any()
+
+    def test_history_changes_action_distribution(self):
+        # Same current obs, different history -> different logp through
+        # the actor path (context is actually used at serving time).
+        policy = build_policy(ARCH)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(5)
+        obs = np.ones((8,), np.float32)
+        W = 16
+        w1 = np.zeros((W, 8), np.float32)
+        w2 = np.zeros((W, 8), np.float32)
+        w1[0], w1[1] = 1.0, obs
+        w2[0], w2[1] = -3.0, obs
+        _, aux1 = policy.step_window(params, key, w1, 2)
+        _, aux2 = policy.step_window(params, key, w2, 2)
+        assert abs(float(aux1["v"]) - float(aux2["v"])) > 1e-6
+
+    def test_actor_context_exceeding_model_rejected(self):
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        import pytest
+
+        arch = {**ARCH, "actor_context": ARCH["max_seq_len"] + 1}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            PolicyActor(ModelBundle(version=1, arch=arch, params=params))
+
+    def test_deterministic_action_uses_window(self):
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        policy = build_policy(ARCH)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        actor = PolicyActor(ModelBundle(version=1, arch=dict(ARCH),
+                                        params=params))
+        rng = np.random.default_rng(1)
+        for _ in range(3):
+            actor.deterministic_action(rng.standard_normal(8))
+        assert actor._window_len == 3  # greedy eval advances history too
