@@ -23,11 +23,22 @@ import time
 
 
 def setup_platform() -> None:
-    """Pick the JAX platform BEFORE jax initializes. Forced (not
-    setdefault): the ambient environment may point JAX_PLATFORMS at a
-    tunneled TPU backend that only the headline bench should use."""
+    """Pin the bench to CPU JAX. Forced (not setdefault): the ambient
+    environment may point JAX_PLATFORMS at a tunneled TPU backend that only
+    the headline bench should use.
+
+    The env var alone is NOT enough on images whose sitecustomize imports
+    jax at interpreter startup (the config snapshots JAX_PLATFORMS before
+    this code runs), so also update the live config — valid as long as no
+    backend has been initialized, which is the case at bench startup."""
     if os.environ.get("RELAYRL_BENCH_TPU") != "1":
         os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
 
 def quick() -> bool:

@@ -121,11 +121,15 @@ class C51(EpsilonGreedyMixin, OffPolicyAlgorithm):
             "epsilon": eps0,
             "precision": str(learner.get("precision", "float32")),
         }
-        for key in ("obs_shape", "conv_spec", "dense", "scale_obs"):
+        from relayrl_tpu.models.q_networks import (
+            PIXEL_ARCH_KEYS,
+            conv_trunk_kwargs,
+        )
+
+        for key in PIXEL_ARCH_KEYS:
             if key in params:
                 self.arch[key] = params[key]
         self.policy = build_policy(self.arch)
-        from relayrl_tpu.models.q_networks import conv_trunk_kwargs
 
         self._module = DistributionalQNet(
             act_dim=self.act_dim,

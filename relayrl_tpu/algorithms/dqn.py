@@ -90,11 +90,15 @@ class DQN(EpsilonGreedyMixin, OffPolicyAlgorithm):
         }
         # Pixel variant: obs_shape switches the q-net to the Nature conv
         # trunk (same arch keys as the cnn_discrete family).
-        for key in ("obs_shape", "conv_spec", "dense", "scale_obs"):
+        from relayrl_tpu.models.q_networks import (
+            PIXEL_ARCH_KEYS,
+            conv_trunk_kwargs,
+        )
+
+        for key in PIXEL_ARCH_KEYS:
             if key in params:
                 self.arch[key] = params[key]
         self.policy = build_policy(self.arch)
-        from relayrl_tpu.models.q_networks import conv_trunk_kwargs
 
         self._module = DiscreteQNet(
             act_dim=self.act_dim,

@@ -88,6 +88,12 @@ class DistributionalQNet(nn.Module):
             *logits.shape[:-1], self.act_dim, self.n_atoms)
 
 
+# Arch keys that switch a q-net to the pixel (conv-trunk) variant; the
+# DQN/C51 _setup()s copy exactly these from hyperparams into the arch so
+# actor-side build_policy and learner-side module construction agree.
+PIXEL_ARCH_KEYS = ("obs_shape", "conv_spec", "dense", "scale_obs")
+
+
 def conv_trunk_kwargs(arch: Mapping[str, Any]) -> dict:
     """Arch → the pixel-trunk kwargs shared by the q-net builders and the
     DQN/C51 learner modules (both must construct identical module configs

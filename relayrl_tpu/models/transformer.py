@@ -51,6 +51,23 @@ def _resolve_attention(arch: Mapping[str, Any]) -> Callable:
         return lambda q, k, v: dense_attention(q, k, v, causal=True)
     if kind == "blockwise":
         return lambda q, k, v: blockwise_attention(q, k, v, block, causal=True)
+    if kind == "flash":
+        def flash_or_local(q, k, v):
+            # Pallas kernel on TPU; off-TPU (CPU actor hosts, CI) the same
+            # arch config resolves to the lax.scan blockwise path — the
+            # heterogeneous-placement rule ring attention also follows.
+            import jax as _jax
+
+            from relayrl_tpu.ops.flash import flash_attention
+
+            T = q.shape[1]
+            if _jax.default_backend() == "tpu" and T % min(block, T) == 0:
+                return flash_attention(q, k, v, causal=True,
+                                       block_q=block, block_kv=block)
+            if T % block == 0:
+                return blockwise_attention(q, k, v, block, causal=True)
+            return dense_attention(q, k, v, causal=True)
+        return flash_or_local
     if kind == "ring":
         def ring_or_local(q, k, v):
             from relayrl_tpu.parallel.context import current_mesh

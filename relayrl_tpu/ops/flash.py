@@ -1,0 +1,243 @@
+"""Fused flash-attention Pallas TPU kernel (forward) + blockwise VJP.
+
+The reference has no attention at all (SURVEY.md §5.7 — its largest model
+is a 2x128 MLP, relayrl_framework/src/native/python/algorithms/REINFORCE/
+kernel.py:14-21); :mod:`relayrl_tpu.ops.attention` adds dense and blockwise
+(lax.scan online-softmax) variants. This module is the TPU-kernel tier of
+the same op: one fused Pallas kernel that keeps the running-softmax state
+``(acc, m, l)`` in VMEM scratch across the KV grid axis, so the [Tq, Tk]
+score matrix never materializes in HBM and the two matmuls per block hit
+the MXU back-to-back.
+
+Grid layout: ``(B*H, num_q_blocks, num_kv_blocks)`` with the KV axis
+innermost — TPU grids execute sequentially, so scratch initialized at
+``kv == 0`` and finalized at ``kv == last`` implements the flash
+recurrence without inter-kernel communication. Causal blocks strictly
+above the diagonal are predicated off with ``pl.when`` (their loads still
+happen — index maps are static — but the matmuls are skipped).
+
+The backward pass recomputes attention blockwise in plain JAX from the
+saved ``(out, lse)`` residuals — the standard flash-attention VJP identity
+
+    ds = p * (dp - rowsum(do * o))
+
+with O(T * block) peak memory, letting XLA fuse it; a hand-written Pallas
+backward kernel is a further step if profiles demand it.
+
+Numerics: scores/softmax in float32 regardless of input dtype; the second
+matmul runs in float32 against the f32 accumulator (MXU-friendly since
+p is produced on-core). Outputs cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal: bool, block_q: int, block_kv: int, scale: float):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ik * block_kv
+    # Causal: the whole KV block is masked iff its first key comes after the
+    # last query of this Q block.
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _block():
+        # Inputs stay in their storage dtype (bf16 in production): the MXU
+        # runs bf16 x bf16 -> f32 at full rate, while casting to f32 first
+        # would quarter the matmul throughput. Softmax math is f32.
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # Masked entries carry s == _NEG_INF; exp(s - m_new) underflows to 0
+        # except when m_new itself is _NEG_INF (a fully-masked row, which
+        # causal + ik==0 never produces for valid rows) — guard anyway.
+        p = jnp.where(s > 0.5 * _NEG_INF, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = (m_ref[:] + jnp.log(l)).reshape(lse_ref.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fwd(T: int, D: int, causal: bool, block_q: int, block_kv: int,
+               in_dtype_name: str, interpret: bool):
+    """Compile-cached pallas_call for a [BH, T, D] layout forward."""
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=block_q, block_kv=block_kv,
+        scale=1.0 / (D ** 0.5))
+    grid = (None, T // block_q, T // block_kv)  # BH filled per call
+
+    def call(qr, kr, vr):
+        bh = qr.shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(bh,) + grid[1:],
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                # Trailing singleton keeps the lse block (block_q, 1)-tiled,
+                # which the Mosaic layout rules accept (a bare (1, block_q)
+                # block would violate the (8, 128) tile constraint).
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, T, D), jnp.dtype(in_dtype_name)),
+                jax.ShapeDtypeStruct((bh, T, 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qr, kr, vr)
+
+    return call
+
+
+def _bthd_to_bht(x):
+    """[B,T,H,D] -> [B*H, T, D] (the kernel's flat layout)."""
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _bht_to_bthd(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_kv, interpret):
+    B, T, H, D = q.shape
+    call = _build_fwd(T, D, causal, block_q, block_kv, q.dtype.name,
+                      interpret)
+    out, lse = call(_bthd_to_bht(q), _bthd_to_bht(k), _bthd_to_bht(v))
+    return _bht_to_bthd(out, B, H), lse.reshape(B, H, T)
+
+
+def _bwd_blockwise(q, k, v, out, lse, do, causal, block_kv):
+    """Flash-attention VJP by blockwise recompute from (out, lse).
+
+    All math in f32 over the flat [BH, T, D] layout; a lax.scan over KV
+    blocks bounds peak memory at O(T * block_kv) like the forward.
+    """
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    qf = _bthd_to_bht(q).astype(jnp.float32)
+    kf = _bthd_to_bht(k).astype(jnp.float32)
+    vf = _bthd_to_bht(v).astype(jnp.float32)
+    dof = _bthd_to_bht(do).astype(jnp.float32)
+    of = _bthd_to_bht(out).astype(jnp.float32)
+    lsef = lse.reshape(B * H, T)
+
+    delta = jnp.sum(dof * of, axis=-1)          # [BH, T]
+    n_blocks = T // block_kv
+    k_blocks = jnp.moveaxis(kf.reshape(-1, n_blocks, block_kv, D), 1, 0)
+    v_blocks = jnp.moveaxis(vf.reshape(-1, n_blocks, block_kv, D), 1, 0)
+    q_pos = jnp.arange(T)
+
+    def scan_step(dq, blk):
+        k_blk, v_blk, j = blk
+        kv_pos = j * block_kv + jnp.arange(block_kv)
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lsef[..., None])
+        if causal:
+            p = jnp.where((q_pos[:, None] >= kv_pos[None, :])[None], p, 0.0)
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, dof,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_blk,
+                             preferred_element_type=jnp.float32)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf,
+                          preferred_element_type=jnp.float32)
+        return dq, (dk_j, dv_j)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        scan_step, jnp.zeros_like(qf),
+        (k_blocks, v_blocks, jnp.arange(n_blocks)))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(-1, T, D)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(-1, T, D)
+    return (_bht_to_bthd(dq, B, H).astype(q.dtype),
+            _bht_to_bthd(dk, B, H).astype(k.dtype),
+            _bht_to_bthd(dv, B, H).astype(v.dtype))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(causal: bool, block_q: int, block_kv: int, interpret: bool):
+    @jax.custom_vjp
+    def flash(q, k, v):
+        out, _ = _fwd(q, k, v, causal, block_q, block_kv, interpret)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _fwd(q, k, v, causal, block_q, block_kv, interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return _bwd_blockwise(q, k, v, out, lse, do, causal, block_kv)
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 128,
+                    block_kv: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """Fused attention on ``[B, T, H, D]`` via a Pallas TPU kernel.
+
+    ``interpret=None`` auto-selects: compiled on TPU backends, interpreter
+    mode elsewhere (slow — tests only; CPU production paths should call
+    :func:`relayrl_tpu.ops.attention.blockwise_attention` instead, which is
+    what the model-level ``attention="flash"`` config does off-TPU).
+    Requires ``T`` divisible by both block sizes; callers pad or fall back.
+    """
+    B, T, H, D = q.shape
+    block_q = min(block_q, T)
+    block_kv = min(block_kv, T)
+    if T % block_q or T % block_kv:
+        raise ValueError(
+            f"seq len {T} not divisible by blocks ({block_q}, {block_kv})")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    return _make_flash(causal, block_q, block_kv, interpret)(q, k, v)

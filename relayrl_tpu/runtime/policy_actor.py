@@ -64,7 +64,11 @@ class PolicyActor:
         self._window = None
         self._window_len = 0
         if self.policy.step_window is not None:
-            max_seq = int(self.arch.get("max_seq_len", 64))
+            # Same default as build_transformer_discrete (transformer.py):
+            # the model's positional table is 1024 rows when the arch omits
+            # the key, so the serving window must agree or context silently
+            # truncates.
+            max_seq = int(self.arch.get("max_seq_len", 1024))
             ctx = int(self.arch.get("actor_context", max_seq))
             if ctx > max_seq:
                 raise ValueError(
