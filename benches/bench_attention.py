@@ -24,7 +24,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from benches.common import emit, time_fn
+try:  # standalone from benches/ (the directory convention) ...
+    from common import emit
+except ImportError:  # ... or as a module from the repo root
+    from benches.common import emit
 
 
 def attention_flops(B, T, H, D, causal=True):
@@ -95,10 +98,17 @@ def run_shape(B, T, H, D, block, quick=False) -> None:
              flops_fwd / dt / 1e12, "TFLOP/s")
 
         grad = jax.jit(jax.grad(
-            lambda qq, fn=fn: jnp.sum(fn(qq, k, v).astype(jnp.float32))))
-        # Chain through dq (same shape as q); tanh keeps values bounded so
-        # the timed programs stay NaN/inf-free.
-        dt = timed_chain(lambda qq: jnp.tanh(grad(qq)), q)
+            lambda qq, kk, vv, fn=fn: jnp.sum(
+                fn(qq, kk, vv).astype(jnp.float32)), argnums=(0, 1, 2)))
+        # Full backward: differentiate w.r.t. q, k AND v (grad through q
+        # alone would let XLA dead-code-eliminate the dk/dv work) and chain
+        # through the sum of all three so none is pruned; tanh keeps the
+        # timed programs NaN/inf-free.
+        def bwd_step(qq):
+            dq, dk, dv = grad(qq, k, v)
+            return jnp.tanh(dq + dk + dv)
+
+        dt = timed_chain(bwd_step, q)
         emit(f"attention_fwdbwd_{name}", cfg, dt * 1e3, "ms")
         emit(f"attention_fwdbwd_{name}_tflops", cfg,
              2.5 * flops_fwd / dt / 1e12, "TFLOP/s")

@@ -21,6 +21,13 @@ import statistics
 import sys
 import time
 
+# Make `import relayrl_tpu` work for direct script invocation from either
+# the repo root (`python benches/bench_X.py` — script dir, not cwd, lands
+# on sys.path) or this directory — no PYTHONPATH needed.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
 
 def setup_platform() -> None:
     """Pin the bench to CPU JAX. Forced (not setdefault): the ambient

@@ -114,6 +114,38 @@ class TransformerBlock(nn.Module):
         return x + h.astype(x.dtype)
 
 
+def _embed_obs(parent: nn.Module, obs, d_model: int, max_seq_len: int):
+    """Obs embedding + positional table, built in the CALLER's param scope
+    (layer names land flat: obs_embed / pos_embed) — the single source of
+    truth shared by TransformerCore and the pipeline family's _PPEmbed."""
+    _, T, _ = obs.shape
+    x = nn.Dense(d_model, dtype=jnp.float32, name="obs_embed")(obs)
+    pos = parent.param("pos_embed", nn.initializers.normal(0.02),
+                       (max_seq_len, d_model), jnp.float32)
+    return x + jax.lax.dynamic_slice_in_dim(pos, 0, T, axis=0)[None]
+
+
+def _readout_heads(x, mask, act_dim: int, d_model: int, has_critic: bool):
+    """Final LN + pi/vf heads in the caller's scope (shared with _PPReadout;
+    the vf optimizer partition keys off these exact `vf*` names)."""
+    x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+    logits = nn.Dense(act_dim, dtype=jnp.float32, name="pi_head")(x)
+    if mask is not None:
+        logits = jnp.where(mask > 0, logits, _MASK_FILL)
+    if has_critic:
+        # Shared-trunk actor-critic: unlike the MLP family's separate
+        # vf_trunk, the critic reads the policy-shaped features, so the
+        # vf optimizer partition (labels by `vf*` prefix) trains only
+        # this head — a 2-layer MLP rather than a single linear probe to
+        # give the vf steps real capacity.
+        h = nn.Dense(d_model, dtype=jnp.float32, name="vf_head_up")(x)
+        v = nn.Dense(1, dtype=jnp.float32, name="vf_head")(nn.tanh(h))
+        v = jnp.squeeze(v, axis=-1)
+    else:
+        v = jnp.zeros(logits.shape[:-1], jnp.float32)
+    return logits, v
+
+
 class TransformerCore(nn.Module):
     """Obs sequence -> per-step (logits, v). Residual stream stays f32."""
 
@@ -129,34 +161,13 @@ class TransformerCore(nn.Module):
 
     @nn.compact
     def __call__(self, obs, mask=None):
-        B, T, _ = obs.shape
-        x = nn.Dense(self.d_model, dtype=jnp.float32, name="obs_embed")(obs)
-        pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(0.02), (self.max_seq_len, self.d_model),
-            jnp.float32)
-        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, T, axis=0)[None]
+        x = _embed_obs(self, obs, self.d_model, self.max_seq_len)
         for i in range(self.n_layers):
             x = TransformerBlock(
                 self.d_model, self.n_heads, self.mlp_ratio, self.attn_fn,
                 self.compute_dtype, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        logits = nn.Dense(self.act_dim, dtype=jnp.float32,
-                          name="pi_head")(x)
-        if mask is not None:
-            logits = jnp.where(mask > 0, logits, _MASK_FILL)
-        if self.has_critic:
-            # Shared-trunk actor-critic: unlike the MLP family's separate
-            # vf_trunk, the critic reads the policy-shaped features, so the
-            # vf optimizer partition (labels by `vf*` prefix) trains only
-            # this head — a 2-layer MLP rather than a single linear probe to
-            # give the vf steps real capacity.
-            h = nn.Dense(self.d_model, dtype=jnp.float32, name="vf_head_up")(x)
-            v = nn.Dense(1, dtype=jnp.float32, name="vf_head")(nn.tanh(h))
-            v = jnp.squeeze(v, axis=-1)
-        else:
-            v = jnp.zeros(logits.shape[:-1], jnp.float32)
-        return logits, v
+        return _readout_heads(x, mask, self.act_dim, self.d_model,
+                              self.has_critic)
 
 
 def _as_btd(obs, mask):
@@ -175,28 +186,14 @@ def _as_btd(obs, mask):
     return obs, mask, lead
 
 
-@register_model("transformer_discrete")
-def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
-    obs_dim = int(arch["obs_dim"])
-    max_seq_len = int(arch.get("max_seq_len", 1024))
-    core = TransformerCore(
-        act_dim=int(arch["act_dim"]),
-        d_model=int(arch.get("d_model", 128)),
-        n_layers=int(arch.get("n_layers", 2)),
-        n_heads=int(arch.get("n_heads", 4)),
-        mlp_ratio=int(arch.get("mlp_ratio", 4)),
-        max_seq_len=max_seq_len,
-        has_critic=bool(arch.get("has_critic", True)),
-        attn_fn=_resolve_attention(arch),
-        compute_dtype=_compute_dtype(arch),
-    )
-
-    def init_params(rng):
-        return core.init(rng, jnp.zeros((1, 1, obs_dim), jnp.float32))
+def _policy_from_apply(arch: Mapping[str, Any], init_params, apply_fn) -> Policy:
+    """Build the sequence-policy ABI (step/evaluate/mode/windowed variants)
+    over any ``apply_fn(params, obs[B,T,D], mask) -> (logits[B,T,A],
+    v[B,T])`` — shared by the plain and pipeline transformer families."""
 
     def step(params, rng, obs, mask=None):
         obs, mask, lead = _as_btd(obs, mask)
-        logits, v = core.apply(params, obs, mask)
+        logits, v = apply_fn(params, obs, mask)
         logits_last, v_last = logits[:, -1], v[:, -1]
         act = jax.random.categorical(rng, logits_last, axis=-1)
         logp = _categorical_logp(logits_last, act)
@@ -209,7 +206,7 @@ def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
         act_b = jnp.asarray(act)
         while act_b.ndim < 2:  # scalar -> [1,1], [T] -> [1,T]
             act_b = act_b[None]
-        logits, v = core.apply(params, obs, mask)
+        logits, v = apply_fn(params, obs, mask)
         logp = _categorical_logp(logits, act_b)
         ent = _categorical_entropy(logits)
         if lead != "batch":
@@ -220,13 +217,13 @@ def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
 
     def mode(params, obs, mask=None):
         obs, mask, lead = _as_btd(obs, mask)
-        logits, _ = core.apply(params, obs, mask)
+        logits, _ = apply_fn(params, obs, mask)
         act = jnp.argmax(logits[:, -1], axis=-1)
         return act if lead == "batch" else act[0]
 
     def _window_logits(params, window, t, mask):
         obs_b, mask_b, _ = _as_btd(window, mask)
-        logits, v = core.apply(params, obs_b, mask_b)
+        logits, v = apply_fn(params, obs_b, mask_b)
         idx = jnp.clip(t - 1, 0, obs_b.shape[1] - 1)
         return logits[0, idx], v[0, idx]
 
@@ -249,3 +246,112 @@ def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
     return Policy(arch=dict(arch), init_params=init_params, step=step,
                   evaluate=evaluate, mode=mode, step_window=step_window,
                   mode_window=mode_window)
+
+
+@register_model("transformer_discrete")
+def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
+    obs_dim = int(arch["obs_dim"])
+    core = TransformerCore(
+        act_dim=int(arch["act_dim"]),
+        d_model=int(arch.get("d_model", 128)),
+        n_layers=int(arch.get("n_layers", 2)),
+        n_heads=int(arch.get("n_heads", 4)),
+        mlp_ratio=int(arch.get("mlp_ratio", 4)),
+        max_seq_len=int(arch.get("max_seq_len", 1024)),
+        has_critic=bool(arch.get("has_critic", True)),
+        attn_fn=_resolve_attention(arch),
+        compute_dtype=_compute_dtype(arch),
+    )
+
+    def init_params(rng):
+        return core.init(rng, jnp.zeros((1, 1, obs_dim), jnp.float32))
+
+    return _policy_from_apply(arch, init_params, core.apply)
+
+
+class _PPEmbed(nn.Module):
+    """Input half of the pipeline transformer (stage-0-adjacent params);
+    delegates to the shared :func:`_embed_obs` so names/math match
+    TransformerCore exactly."""
+
+    d_model: int
+    max_seq_len: int
+
+    @nn.compact
+    def __call__(self, obs):
+        return _embed_obs(self, obs, self.d_model, self.max_seq_len)
+
+
+class _PPReadout(nn.Module):
+    """Output half: delegates to the shared :func:`_readout_heads` (the vf
+    optimizer partition keys off the same `vf*` names)."""
+
+    act_dim: int
+    d_model: int
+    has_critic: bool
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        return _readout_heads(x, mask, self.act_dim, self.d_model,
+                              self.has_critic)
+
+
+_PP_IO_KEYS = ("obs_embed", "pos_embed")
+
+
+@register_model("transformer_pp_discrete")
+def build_transformer_pp_discrete(arch: Mapping[str, Any]) -> Policy:
+    """Pipeline-parallel transformer: identical math to
+    ``transformer_discrete`` but the layer stack is STACKED on a leading
+    axis (param subtree ``blocks``, sharded ``P("pp", ...)`` by the rules in
+    parallel/sharding.py). With an ambient mesh whose ``pp`` axis > 1 the
+    stack runs as a GPipe microbatch pipeline over ``pp``
+    (:func:`relayrl_tpu.parallel.pipeline.pipeline_apply`); otherwise a
+    plain ``lax.scan`` over layers — so the SAME arch config serves CPU
+    actor hosts and the pipelined TPU learner (SURVEY.md §7.4 item 2).
+    """
+    obs_dim = int(arch["obs_dim"])
+    d_model = int(arch.get("d_model", 128))
+    n_layers = int(arch.get("n_layers", 2))
+    n_micro = arch.get("pp_microbatches")
+    block = TransformerBlock(
+        d_model, int(arch.get("n_heads", 4)), int(arch.get("mlp_ratio", 4)),
+        _resolve_attention(arch), _compute_dtype(arch))
+    embed = _PPEmbed(d_model, int(arch.get("max_seq_len", 1024)))
+    readout = _PPReadout(int(arch["act_dim"]), d_model,
+                         bool(arch.get("has_critic", True)))
+
+    def init_params(rng):
+        r_embed, r_read, r_blocks = jax.random.split(rng, 3)
+        e = embed.init(r_embed, jnp.zeros((1, 1, obs_dim), jnp.float32))
+        r = readout.init(r_read, jnp.zeros((1, 1, d_model), jnp.float32))
+        stacked = jax.vmap(
+            lambda k: block.init(k, jnp.zeros((1, 1, d_model), jnp.float32))
+        )(jax.random.split(r_blocks, n_layers))
+        return {"params": {**e["params"], **r["params"],
+                           "blocks": stacked["params"]}}
+
+    def _stage(local_blocks, h):
+        return jax.lax.scan(
+            lambda c, p: (block.apply({"params": p}, c), None),
+            h, local_blocks)[0]
+
+    def apply_fn(params, obs, mask=None):
+        from relayrl_tpu.parallel.context import current_mesh
+
+        inner = params["params"]
+        x = embed.apply(
+            {"params": {k: inner[k] for k in _PP_IO_KEYS}}, obs)
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            from relayrl_tpu.parallel.pipeline import pipeline_apply
+
+            x = pipeline_apply(_stage, inner["blocks"], x, mesh,
+                               n_microbatches=n_micro)
+        else:
+            x = _stage(inner["blocks"], x)
+        ro = {k: v for k, v in inner.items()
+              if k not in _PP_IO_KEYS + ("blocks",)}
+        return readout.apply({"params": ro}, x, mask)
+
+    return _policy_from_apply(arch, init_params, apply_fn)

@@ -9,9 +9,14 @@ first-class config object. Axis convention (order matters for ICI layout):
 * ``tp``   — tensor parallel (weight matrices split within a layer)
 * ``sp``   — sequence/context parallel (trajectory time axis, ring
              collectives — long-context path)
+* ``pp``   — pipeline parallel (layer stages, ppermute activation
+             hand-off — :mod:`relayrl_tpu.parallel.pipeline`); last in the
+             axis order so consecutive stages land on adjacent device ids
+             (ICI neighbors on a real slice)
 
 Config form (learner.mesh in relayrl_config.json): ``{"dp": -1, "fsdp": 1,
-"tp": 1, "sp": 1}`` where -1 means "fill with the remaining devices".
+"tp": 1, "sp": 1, "pp": 1}`` where -1 means "fill with the remaining
+devices".
 """
 
 from __future__ import annotations
@@ -22,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "tp", "sp", "pp")
 
 
 def resolve_mesh_shape(spec: Mapping[str, int], n_devices: int) -> dict[str, int]:
@@ -60,7 +65,7 @@ def make_mesh(spec: Mapping[str, int] | None = None,
 
 
 def single_device_mesh() -> Mesh:
-    return make_mesh({"dp": 1, "fsdp": 1, "tp": 1, "sp": 1}, jax.devices()[:1])
+    return make_mesh({ax: 1 for ax in AXES}, jax.devices()[:1])
 
 
 def data_axes(mesh: Mesh) -> tuple[str, ...]:

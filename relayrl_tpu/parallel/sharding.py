@@ -57,8 +57,17 @@ def param_pspec(path: tuple, leaf: Any, mesh: Mesh) -> P:
     """PartitionSpec for one param leaf, by tree path + shape."""
     tp = mesh.shape.get("tp", 1)
     fsdp = mesh.shape.get("fsdp", 1)
+    pp = mesh.shape.get("pp", 1)
     names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
     shape = getattr(leaf, "shape", ())
+
+    # -- pipeline parallel: stacked layer stacks (leading axis = layers)
+    #    live under a "blocks" subtree (models/transformer.py pp family);
+    #    each pp stage owns a contiguous slice of layers. Checked first so
+    #    fsdp doesn't grab the layer axis.
+    if pp > 1 and "blocks" in names and len(shape) >= 1 \
+            and shape[0] % pp == 0:
+        return P(*(("pp",) + (None,) * (len(shape) - 1)))
 
     # -- tensor parallel: alternate split of MLP trunk Dense kernels --
     if tp > 1 and len(shape) == 2:
