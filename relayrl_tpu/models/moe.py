@@ -1,0 +1,79 @@
+"""Mixture-of-experts MLP with per-token top-k routing (the ``ep`` family).
+
+No counterpart exists in the reference (its only models are 2x128 MLPs —
+SURVEY.md §2.5); this is a TPU-first capacity-scaling component: the
+transformer block's FFN becomes E experts whose stacked weights shard over
+the mesh ``ep`` axis (rule in parallel/sharding.py), so parameter capacity
+scales with devices.
+
+Routing is **per-token top-k** (default k=2): each token's gate picks its
+own experts from its own features alone, so routing is exactly causal and
+IDENTICAL between training batches and single-window actor serving — a
+hard requirement for RL policies, where logp at step t must condition only
+on history (capacity-competition schemes like expert-choice or
+token-dropping leak future timesteps / sibling sequences into the gate and
+bias the policy gradient).
+
+Dispatch is dense: every expert runs on every token and the top-k mask
+zeroes the rest in the combine einsum. That spends E× the FFN FLOPs of a
+capacity-based sparse dispatch — the honest tradeoff at RL model scale,
+where exactness beats the flop savings; under GSPMD each ``ep`` shard
+computes only its own experts and the combine contracts over E with a
+psum. A sparse gather/scatter dispatch is a later optimization for models
+where the FFN dominates.
+
+Shapes: tokens flatten to ``[N = B*T, d]``; expert stacks are
+``moe_w_up [E, d, ff]`` / ``moe_w_down [E, ff, d]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MoEMLP(nn.Module):
+    """Per-token top-k MoE FFN over flattened tokens (dense dispatch)."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, d = x.shape
+        n = B * T
+        k = max(1, min(self.top_k, self.n_experts))
+        tokens = x.reshape(n, d)
+
+        # Gate in f32; per-token top-k -> renormalized combine weights,
+        # scattered back to a dense [N, E] mask (static shapes, XLA-safe).
+        gate = nn.Dense(self.n_experts, dtype=jnp.float32, name="moe_gate")(
+            tokens.astype(jnp.float32))
+        top_vals, top_idx = jax.lax.top_k(gate, k)          # [N, k]
+        top_w = jax.nn.softmax(top_vals, axis=-1)           # [N, k]
+        weights = jnp.zeros((n, self.n_experts), jnp.float32)
+        weights = weights.at[
+            jnp.arange(n)[:, None], top_idx].set(top_w)     # [N, E]
+
+        w_up = self.param(
+            "moe_w_up", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (self.n_experts, d, self.d_ff), jnp.float32)
+        w_down = self.param(
+            "moe_w_down", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (self.n_experts, self.d_ff, d), jnp.float32)
+
+        h = jnp.einsum("nd,edf->enf", tokens.astype(self.compute_dtype),
+                       w_up.astype(self.compute_dtype),
+                       preferred_element_type=jnp.float32)
+        h = nn.gelu(h)
+        out = jnp.einsum("enf,efd->end", h.astype(self.compute_dtype),
+                         w_down.astype(self.compute_dtype),
+                         preferred_element_type=jnp.float32)
+        y = jnp.einsum("ne,end->nd", weights, out)          # psum over ep
+        return y.reshape(B, T, d).astype(x.dtype)

@@ -89,6 +89,12 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int
     attn_fn: Callable
     compute_dtype: Any
+    # MoE variant: >0 replaces the dense FFN with a per-token top-k MoE of
+    # this many experts (models/moe.py; weights shard over the mesh ``ep``
+    # axis). 0 keeps the dense mlp_up/mlp_down FFN — param names for the
+    # dense family are unchanged.
+    moe_experts: int = 0
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, x):
@@ -106,6 +112,13 @@ class TransformerBlock(nn.Module):
         x = x + nn.Dense(self.d_model, dtype=self.compute_dtype,
                          name="attn_out")(attn).astype(x.dtype)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        if self.moe_experts > 0:
+            from relayrl_tpu.models.moe import MoEMLP
+
+            h = MoEMLP(self.d_model, self.mlp_ratio * self.d_model,
+                       self.moe_experts, self.moe_top_k,
+                       self.compute_dtype, name="moe")(h)
+            return x + h.astype(x.dtype)
         h = h.astype(self.compute_dtype)
         h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.compute_dtype,
                      name="mlp_up")(h)
@@ -158,6 +171,8 @@ class TransformerCore(nn.Module):
     has_critic: bool
     attn_fn: Callable
     compute_dtype: Any
+    moe_experts: int = 0
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, obs, mask=None):
@@ -165,7 +180,8 @@ class TransformerCore(nn.Module):
         for i in range(self.n_layers):
             x = TransformerBlock(
                 self.d_model, self.n_heads, self.mlp_ratio, self.attn_fn,
-                self.compute_dtype, name=f"block_{i}")(x)
+                self.compute_dtype, moe_experts=self.moe_experts,
+                moe_top_k=self.moe_top_k, name=f"block_{i}")(x)
         return _readout_heads(x, mask, self.act_dim, self.d_model,
                               self.has_critic)
 
@@ -248,8 +264,7 @@ def _policy_from_apply(arch: Mapping[str, Any], init_params, apply_fn) -> Policy
                   mode_window=mode_window)
 
 
-@register_model("transformer_discrete")
-def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
+def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
     obs_dim = int(arch["obs_dim"])
     core = TransformerCore(
         act_dim=int(arch["act_dim"]),
@@ -261,12 +276,27 @@ def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
         has_critic=bool(arch.get("has_critic", True)),
         attn_fn=_resolve_attention(arch),
         compute_dtype=_compute_dtype(arch),
+        moe_experts=moe_experts,
+        moe_top_k=int(arch.get("moe_top_k", 2)),
     )
 
     def init_params(rng):
         return core.init(rng, jnp.zeros((1, 1, obs_dim), jnp.float32))
 
     return _policy_from_apply(arch, init_params, core.apply)
+
+
+@register_model("transformer_discrete")
+def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
+    return _build_core_policy(arch)
+
+
+@register_model("transformer_moe_discrete")
+def build_transformer_moe_discrete(arch: Mapping[str, Any]) -> Policy:
+    """Transformer whose FFNs are expert-choice MoE layers (models/moe.py);
+    expert stacks shard over the mesh ``ep`` axis via the param rules. Same
+    sequence ABI as transformer_discrete."""
+    return _build_core_policy(arch, moe_experts=int(arch.get("moe_experts", 4)))
 
 
 class _PPEmbed(nn.Module):

@@ -9,14 +9,17 @@ first-class config object. Axis convention (order matters for ICI layout):
 * ``tp``   — tensor parallel (weight matrices split within a layer)
 * ``sp``   — sequence/context parallel (trajectory time axis, ring
              collectives — long-context path)
+* ``ep``   — expert parallel (MoE expert stacks sharded over experts —
+             :mod:`relayrl_tpu.models.moe`; GSPMD inserts the
+             dispatch/combine collectives)
 * ``pp``   — pipeline parallel (layer stages, ppermute activation
              hand-off — :mod:`relayrl_tpu.parallel.pipeline`); last in the
              axis order so consecutive stages land on adjacent device ids
              (ICI neighbors on a real slice)
 
 Config form (learner.mesh in relayrl_config.json): ``{"dp": -1, "fsdp": 1,
-"tp": 1, "sp": 1, "pp": 1}`` where -1 means "fill with the remaining
-devices".
+"ep": 1, "tp": 1, "sp": 1, "pp": 1}`` where -1 means "fill with the
+remaining devices".
 """
 
 from __future__ import annotations
@@ -27,7 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "tp", "sp", "pp")
+AXES = ("dp", "fsdp", "ep", "tp", "sp", "pp")
 
 
 def resolve_mesh_shape(spec: Mapping[str, int], n_devices: int) -> dict[str, int]:
