@@ -187,12 +187,24 @@ def main():
 
     if not native_available():
         backends.remove("native")
+    trials = 1 if "--quick" in sys.argv else 3
     lines = []
     for backend in backends:
-        for fn in (bench_ingest, bench_fanout):
-            r = fn(backend, cfg)
-            lines.append(json.dumps(r))
-            print(lines[-1], flush=True)
+        # Ingest is noisy on a busy host — run multiple trials; the
+        # canonical trajectories_per_sec field is the MEDIAN (single-trial
+        # runs previously flipped the zmq-vs-native ordering between
+        # invocations), with the raw trials and best kept alongside.
+        runs = [bench_ingest(backend, cfg) for _ in range(trials)]
+        tps = [r["trajectories_per_sec"] for r in runs]
+        r = runs[-1]
+        r["trials_trajectories_per_sec"] = tps
+        r["trajectories_per_sec"] = round(statistics.median(tps), 1)
+        r["trajectories_per_sec_best"] = round(max(tps), 1)
+        lines.append(json.dumps(r))
+        print(lines[-1], flush=True)
+        r = bench_fanout(backend, cfg)
+        lines.append(json.dumps(r))
+        print(lines[-1], flush=True)
     if "--write" in sys.argv:
         out = os.path.join(_HERE, "results", "transport_scale.json")
         os.makedirs(os.path.dirname(out), exist_ok=True)

@@ -22,6 +22,10 @@ computes only its own experts and the combine contracts over E with a
 psum. A sparse gather/scatter dispatch is a later optimization for models
 where the FFN dominates.
 
+No auxiliary load-balancing loss is applied (see
+:func:`expert_utilization` for the rationale and the monitoring hook for
+the gate-collapse failure mode that omission leaves open).
+
 Shapes: tokens flatten to ``[N = B*T, d]``; expert stacks are
 ``moe_w_up [E, d, ff]`` / ``moe_w_down [E, ff, d]``.
 """
@@ -68,6 +72,12 @@ class MoEMLP(nn.Module):
             "moe_w_down", nn.initializers.lecun_normal(batch_axis=(0,)),
             (self.n_experts, self.d_ff, d), jnp.float32)
 
+        # Monitoring hook: per-expert share of combine mass (weights sum to
+        # 1 per token, so load/ n == fraction of routing mass per expert).
+        # Inert unless applied with mutable=["intermediates"] — see
+        # expert_utilization() below.
+        self.sow("intermediates", "expert_load", weights.sum(axis=0))
+
         h = jnp.einsum("nd,edf->enf", tokens.astype(self.compute_dtype),
                        w_up.astype(self.compute_dtype),
                        preferred_element_type=jnp.float32)
@@ -77,3 +87,33 @@ class MoEMLP(nn.Module):
                          preferred_element_type=jnp.float32)
         y = jnp.einsum("ne,end->nd", weights, out)          # psum over ep
         return y.reshape(B, T, d).astype(x.dtype)
+
+
+def expert_utilization(arch, params, obs, mask=None) -> dict:
+    """Per-layer routing-mass fraction per expert — the gate-collapse
+    monitor.
+
+    No auxiliary load-balancing loss is applied during training (a
+    deliberate omission: at RL model scale the dense dispatch keeps
+    collapsed gates *correct*, just wasteful, and an aux loss would have to
+    be plumbed through every algorithm's update). The standard top-k
+    failure mode — the gate collapsing onto a few experts — is therefore
+    something to MONITOR: call this on a representative batch and alarm
+    when the max fraction nears 1.0.
+
+    Returns ``{layer_name: [E] fractions summing to 1}``.
+    """
+    import jax.numpy as _jnp
+
+    from relayrl_tpu.models.transformer import _make_core
+
+    core = _make_core(arch, moe_experts=int(arch.get("moe_experts", 4)))
+    _, state = core.apply(params, _jnp.asarray(obs), mask,
+                          mutable=["intermediates"])
+    out = {}
+    for layer, sub in state["intermediates"].items():
+        if not layer.startswith("block_"):
+            continue
+        load = sub["moe"]["expert_load"][0]
+        out[layer] = load / _jnp.maximum(load.sum(), 1e-9)
+    return out

@@ -264,9 +264,11 @@ def _policy_from_apply(arch: Mapping[str, Any], init_params, apply_fn) -> Policy
                   mode_window=mode_window)
 
 
-def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
-    obs_dim = int(arch["obs_dim"])
-    core = TransformerCore(
+def _make_core(arch: Mapping[str, Any], moe_experts: int = 0) -> TransformerCore:
+    """Arch -> TransformerCore module (shared by the policy builders and
+    diagnostics like :func:`relayrl_tpu.models.moe.expert_utilization`,
+    which re-applies the same module with captured intermediates)."""
+    return TransformerCore(
         act_dim=int(arch["act_dim"]),
         d_model=int(arch.get("d_model", 128)),
         n_layers=int(arch.get("n_layers", 2)),
@@ -279,6 +281,11 @@ def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
         moe_experts=moe_experts,
         moe_top_k=int(arch.get("moe_top_k", 2)),
     )
+
+
+def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
+    obs_dim = int(arch["obs_dim"])
+    core = _make_core(arch, moe_experts)
 
     def init_params(rng):
         return core.init(rng, jnp.zeros((1, 1, obs_dim), jnp.float32))
@@ -293,9 +300,10 @@ def build_transformer_discrete(arch: Mapping[str, Any]) -> Policy:
 
 @register_model("transformer_moe_discrete")
 def build_transformer_moe_discrete(arch: Mapping[str, Any]) -> Policy:
-    """Transformer whose FFNs are expert-choice MoE layers (models/moe.py);
-    expert stacks shard over the mesh ``ep`` axis via the param rules. Same
-    sequence ABI as transformer_discrete."""
+    """Transformer whose FFNs are per-token top-k MoE layers (models/moe.py
+    — NOT expert-choice, which is non-causal for policies); expert stacks
+    shard over the mesh ``ep`` axis via the param rules. Same sequence ABI
+    as transformer_discrete."""
     return _build_core_policy(arch, moe_experts=int(arch.get("moe_experts", 4)))
 
 

@@ -6,12 +6,12 @@ first-class config object. Axis convention (order matters for ICI layout):
 
 * ``dp``   — data parallel (batch split, gradient psum)
 * ``fsdp`` — fully-sharded data parallel (params sharded, batch also split)
-* ``tp``   — tensor parallel (weight matrices split within a layer)
-* ``sp``   — sequence/context parallel (trajectory time axis, ring
-             collectives — long-context path)
 * ``ep``   — expert parallel (MoE expert stacks sharded over experts —
              :mod:`relayrl_tpu.models.moe`; GSPMD inserts the
              dispatch/combine collectives)
+* ``tp``   — tensor parallel (weight matrices split within a layer)
+* ``sp``   — sequence/context parallel (trajectory time axis, ring
+             collectives — long-context path)
 * ``pp``   — pipeline parallel (layer stages, ppermute activation
              hand-off — :mod:`relayrl_tpu.parallel.pipeline`); last in the
              axis order so consecutive stages land on adjacent device ids

@@ -61,21 +61,23 @@ def param_pspec(path: tuple, leaf: Any, mesh: Mesh) -> P:
     names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
     shape = getattr(leaf, "shape", ())
 
-    # -- expert parallel: stacked MoE expert weights (leading axis =
-    #    experts) live under a "moe" module (models/moe.py); gate stays
-    #    replicated (its path contains "moe_gate", filtered by ndim>=3).
-    ep = mesh.shape.get("ep", 1)
-    if ep > 1 and any("moe" in str(n) for n in names) and len(shape) >= 3 \
-            and shape[0] % ep == 0:
-        return P(*(("ep",) + (None,) * (len(shape) - 1)))
-
     # -- pipeline parallel: stacked layer stacks (leading axis = layers)
     #    live under a "blocks" subtree (models/transformer.py pp family);
-    #    each pp stage owns a contiguous slice of layers. Checked first so
-    #    fsdp doesn't grab the layer axis.
+    #    each pp stage owns a contiguous slice of layers. Checked before
+    #    ep/fsdp so neither grabs the layer axis (a future pp-stacked MoE
+    #    family would have both "blocks" and "moe" in its paths — the
+    #    leading axis is layers and must go to pp).
     if pp > 1 and "blocks" in names and len(shape) >= 1 \
             and shape[0] % pp == 0:
         return P(*(("pp",) + (None,) * (len(shape) - 1)))
+
+    # -- expert parallel: stacked MoE expert weights (leading axis =
+    #    experts) live under a "moe" module (models/moe.py); gate stays
+    #    replicated (its 2D kernel is filtered by the ndim>=3 guard).
+    ep = mesh.shape.get("ep", 1)
+    if ep > 1 and any(str(n) == "moe" for n in names) and len(shape) >= 3 \
+            and shape[0] % ep == 0:
+        return P(*(("ep",) + (None,) * (len(shape) - 1)))
 
     # -- tensor parallel: alternate split of MLP trunk Dense kernels --
     if tp > 1 and len(shape) == 2:

@@ -18,9 +18,31 @@ from relayrl_tpu.transport.base import (
 )
 
 
+def _resolve_auto() -> str:
+    """``auto`` -> native framed-TCP when the C++ core loads, else zmq.
+
+    The 64-actor shootout (benches/results/transport_scale.json) shows
+    native ~1.5x faster than pyzmq on model fan-out and tied on ingest
+    (both saturate the same Python-callback ceiling). ``zmq`` stays the
+    DEFAULT for reference parity.
+
+    WARNING: ``auto`` resolves PER PROCESS from local .so availability —
+    both ends must land on the same wire protocol, so use it only in
+    homogeneous deployments where every host ships (or lacks) the .so
+    identically. A mixed fleet on ``auto`` splits protocols and the
+    mismatched agents time out on ``fetch_model``; for mixed fleets pin
+    ``server_type`` explicitly on every process.
+    """
+    from relayrl_tpu.transport.native_backend import native_available
+
+    return "native" if native_available() else "zmq"
+
+
 def make_server_transport(server_type: str, config: ConfigLoader,
                           **overrides) -> ServerTransport:
     server_type = (server_type or "zmq").lower()
+    if server_type == "auto":
+        server_type = _resolve_auto()
     if server_type == "zmq":
         from relayrl_tpu.transport.zmq_backend import ZmqServerTransport
 
@@ -45,12 +67,14 @@ def make_server_transport(server_type: str, config: ConfigLoader,
         return NativeServerTransport(
             bind_addr=overrides.get("bind_addr", config.get_traj_server().host_port),
         )
-    raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native)")
+    raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native|auto)")
 
 
 def make_agent_transport(server_type: str, config: ConfigLoader,
                          **overrides) -> AgentTransport:
     server_type = (server_type or "zmq").lower()
+    if server_type == "auto":
+        server_type = _resolve_auto()
     if server_type == "zmq":
         from relayrl_tpu.transport.zmq_backend import ZmqAgentTransport
 
@@ -78,7 +102,7 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
             server_addr=overrides.get("server_addr", config.get_traj_server().host_port),
             identity=overrides.get("identity"),
         )
-    raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native)")
+    raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native|auto)")
 
 
 __all__ = [

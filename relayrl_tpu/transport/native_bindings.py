@@ -207,7 +207,10 @@ class NativeAgentTransportImpl(AgentTransport):
                 cap = int(n) * 2
                 continue
             if time.monotonic() >= deadline:
-                raise TimeoutError("native model handshake timed out")
+                raise TimeoutError(
+                    "native model handshake timed out — check the server is "
+                    "up AND that both ends use the same server_type (a zmq/"
+                    "grpc server will silently ignore native framing)")
 
     def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
         ctrl = self._ensure_ctrl(timeout_s)

@@ -142,3 +142,19 @@ class TestExpertParallel:
         np.testing.assert_allclose(
             float(metrics["LossPi"]), float(single[1]["LossPi"]),
             atol=1e-4, rtol=1e-4)
+
+
+class TestUtilizationMonitor:
+    def test_fractions_sum_to_one_per_layer(self):
+        from relayrl_tpu.models.moe import expert_utilization
+
+        policy, params = _policy_params()
+        obs = np.random.default_rng(5).standard_normal((2, 8, 6)).astype(
+            np.float32)
+        util = expert_utilization(ARCH, params, obs)
+        assert set(util) == {"block_0", "block_1"}
+        for layer, frac in util.items():
+            assert frac.shape == (4,)
+            np.testing.assert_allclose(float(frac.sum()), 1.0, atol=1e-5)
+            # near-uniform at init: no expert should be collapsed-out
+            assert float(frac.max()) < 0.9, (layer, frac)

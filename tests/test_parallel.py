@@ -20,9 +20,9 @@ from relayrl_tpu.parallel import (
 class TestMeshResolve:
     def test_fill_axis(self):
         assert resolve_mesh_shape({"dp": -1}, 8) == {
-            "dp": 8, "fsdp": 1, "tp": 1, "sp": 1, "pp": 1}
+            "dp": 8, "fsdp": 1, "ep": 1, "tp": 1, "sp": 1, "pp": 1}
         assert resolve_mesh_shape({"dp": -1, "tp": 2}, 8) == {
-            "dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "pp": 1}
+            "dp": 4, "fsdp": 1, "ep": 1, "tp": 2, "sp": 1, "pp": 1}
 
     def test_exact(self):
         assert resolve_mesh_shape({"dp": 2, "fsdp": 2, "tp": 2}, 8)["sp"] == 1

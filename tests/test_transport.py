@@ -355,3 +355,54 @@ class TestGrpcTransport:
                 agent.close()
         finally:
             server.stop()
+
+
+class TestAutoBackend:
+    def test_auto_resolves_to_native_or_zmq(self, tmp_cwd):
+        from relayrl_tpu.transport import _resolve_auto
+        from relayrl_tpu.transport.native_backend import native_available
+
+        want = "native" if native_available() else "zmq"
+        assert _resolve_auto() == want
+
+    def test_auto_builds_matching_pair(self, tmp_cwd):
+        # server_type="auto" must yield a working server/agent pair
+        # end-to-end (whichever backend it resolves to).
+        import threading
+
+        from relayrl_tpu.config import ConfigLoader
+        from relayrl_tpu.transport import (
+            make_agent_transport,
+            make_server_transport,
+        )
+
+        cfg = ConfigLoader(None, None)
+        port = free_port()
+        overrides_server = {
+            "bind_addr": f"127.0.0.1:{port}",
+            "agent_listener_addr": f"tcp://127.0.0.1:{port}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        server = make_server_transport("auto", cfg, **overrides_server)
+        got = []
+        done = threading.Event()
+        server.get_model = lambda: (7, b"params")
+        server.on_trajectory = lambda aid, p: (got.append(p), done.set())
+        server.start()
+        agent_overrides = {
+            "server_addr": overrides_server["bind_addr"],
+            "agent_listener_addr": overrides_server["agent_listener_addr"],
+            "trajectory_addr": overrides_server["trajectory_addr"],
+            "model_sub_addr": overrides_server["model_pub_addr"],
+        }
+        agent = make_agent_transport("auto", cfg, **agent_overrides)
+        try:
+            version, payload = agent.fetch_model(timeout_s=30)
+            assert (version, payload) == (7, b"params")
+            agent.send_trajectory(b"episode-bytes")
+            assert done.wait(timeout=30)
+            assert got == [b"episode-bytes"]
+        finally:
+            agent.close()
+            server.stop()
