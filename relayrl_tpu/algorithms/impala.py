@@ -27,6 +27,7 @@ from flax import struct
 from relayrl_tpu.algorithms.base import register_algorithm
 from relayrl_tpu.algorithms.onpolicy import OnPolicyAlgorithm
 from relayrl_tpu.models import build_policy
+from relayrl_tpu.models.base import apply_arch_overrides
 from relayrl_tpu.ops.gae import masked_mean_std
 from relayrl_tpu.ops.vtrace import vtrace
 
@@ -106,6 +107,7 @@ class IMPALA(OnPolicyAlgorithm):
         }
         if kind == "cnn_discrete" and "obs_shape" in params:
             self.arch["obs_shape"] = list(params["obs_shape"])
+        apply_arch_overrides(self.arch, params)
         self.policy = build_policy(self.arch)
 
         init_rng, state_rng = jax.random.split(rng)

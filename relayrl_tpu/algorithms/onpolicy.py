@@ -65,7 +65,12 @@ class OnPolicyAlgorithm(AlgorithmBase):
             act_dim=self.act_dim,
             traj_per_epoch=self.traj_per_epoch,
             discrete=self.discrete,
-            buckets=learner.get("bucket_lengths", (64, 256, 1000)),
+            # Hyperparam override first: short fixed-horizon tasks (memory
+            # envs) want tight buckets so sequence models size max_seq_len
+            # to the real episode length, not the default padding.
+            buckets=params.get(
+                "bucket_lengths",
+                learner.get("bucket_lengths", (64, 256, 1000))),
             max_traj_length=loader.get_max_traj_length(),
         )
 

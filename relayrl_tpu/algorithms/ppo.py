@@ -31,6 +31,7 @@ from relayrl_tpu.algorithms.base import register_algorithm
 from relayrl_tpu.algorithms.onpolicy import OnPolicyAlgorithm
 from relayrl_tpu.algorithms.reinforce import make_optimizers
 from relayrl_tpu.models import build_policy
+from relayrl_tpu.models.base import apply_arch_overrides
 from relayrl_tpu.ops import gae_advantages, masked_mean_std, normalize_advantages
 
 
@@ -191,6 +192,7 @@ class PPO(OnPolicyAlgorithm):
             for key in ("conv_spec", "dense", "scale_obs"):
                 if key in params:
                     self.arch[key] = params[key]
+        apply_arch_overrides(self.arch, params)
         self.policy = build_policy(self.arch)
 
         init_rng, state_rng = jax.random.split(rng)

@@ -31,6 +31,7 @@ from flax import struct
 from relayrl_tpu.algorithms.base import register_algorithm
 from relayrl_tpu.algorithms.onpolicy import OnPolicyAlgorithm
 from relayrl_tpu.models import build_policy
+from relayrl_tpu.models.base import apply_arch_overrides
 from relayrl_tpu.ops import gae_advantages, masked_mean_std, normalize_advantages
 
 
@@ -166,7 +167,9 @@ class REINFORCE(OnPolicyAlgorithm):
         self.lam = float(params.get("lam", 0.97))
 
         self.arch = {
-            "kind": "mlp_discrete" if self.discrete else "mlp_continuous",
+            "kind": str(params.get(
+                "model_kind",
+                "mlp_discrete" if self.discrete else "mlp_continuous")),
             "obs_dim": self.obs_dim,
             "act_dim": self.act_dim,
             "hidden_sizes": list(params.get("hidden_sizes", [128, 128])),
@@ -176,6 +179,7 @@ class REINFORCE(OnPolicyAlgorithm):
             # actors inherit it through the arch so learner/actor agree.
             "precision": str(learner.get("precision", "float32")),
         }
+        apply_arch_overrides(self.arch, params)
         self.policy = build_policy(self.arch)
 
         init_rng, state_rng = jax.random.split(rng)

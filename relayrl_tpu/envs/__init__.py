@@ -15,11 +15,14 @@ from relayrl_tpu.envs.atari import (
     make_atari,
 )
 from relayrl_tpu.envs.classic import CartPoleEnv, PendulumEnv
+from relayrl_tpu.envs.memory import RecallEnv
 from relayrl_tpu.envs.spaces import Box, Discrete
 
 _BUILTIN = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
+    # Memory task (no Gymnasium counterpart): built-in only.
+    "Recall-v0": RecallEnv,
 }
 
 
@@ -43,4 +46,4 @@ def make(env_id: str, **kwargs):
 
 
 __all__ = ["make", "make_atari", "AtariPreprocessing", "SyntheticPixelEnv",
-           "CartPoleEnv", "PendulumEnv", "Box", "Discrete"]
+           "CartPoleEnv", "PendulumEnv", "RecallEnv", "Box", "Discrete"]

@@ -41,6 +41,40 @@ def build_policy(arch: Mapping[str, Any]) -> "Policy":
     return _REGISTRY[kind](arch)
 
 
+# Model-shape hyperparams that algorithms forward verbatim from their
+# hyperparam dict into the arch config when present, so any policy family
+# (e.g. model_kind="transformer_discrete" with d_model/n_layers/attention)
+# is reachable through the algorithm ctor without per-algorithm plumbing.
+ARCH_PASSTHROUGH_KEYS = (
+    "d_model", "n_layers", "n_heads", "mlp_ratio", "max_seq_len",
+    "attention", "attention_block", "actor_context",
+    "moe_experts", "moe_top_k", "pp_microbatches",
+)
+
+
+def apply_arch_overrides(arch: dict, params: Mapping[str, Any]) -> dict:
+    """Copy any present ARCH_PASSTHROUGH_KEYS from hyperparams into arch.
+
+    Algorithms call this once, right before ``build_policy(self.arch)``.
+    Sequence-model keys on a non-sequence kind almost always mean a
+    forgotten ``model_kind`` — warn instead of silently training the
+    default MLP with the overrides ignored.
+    """
+    copied = [k for k in ARCH_PASSTHROUGH_KEYS if k in params]
+    for key in copied:
+        arch[key] = params[key]
+    kind = str(arch.get("kind", ""))
+    if copied and (kind.startswith("mlp") or kind.startswith("cnn")):
+        import warnings
+
+        warnings.warn(
+            f"model overrides {copied} have no effect on model kind "
+            f"{kind!r} — did you forget model_kind="
+            f"\"transformer_discrete\" (or another sequence kind)?",
+            stacklevel=2)
+    return arch
+
+
 @dataclasses.dataclass(frozen=True)
 class Policy:
     """Pure-function policy bundle.
