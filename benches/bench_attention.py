@@ -42,7 +42,7 @@ def main() -> None:
     shapes = ([(2, 512, 4, 64, 128)] if quick
               # (B, T, H, D, block): the trajectory-shaped config and a
               # long-context one where the dense score matrix stops fitting
-              # on-chip (flash measured 40x dense / 1.9x blockwise there).
+              # on-chip (see benches/README.md for the committed numbers).
               else [(8, 2048, 8, 64, 256), (2, 8192, 8, 64, 512)])
     for shape in shapes:
         run_shape(*shape, quick=quick)
@@ -50,6 +50,7 @@ def main() -> None:
 
 def run_shape(B, T, H, D, block, quick=False) -> None:
     platform = jax.default_backend()
+    FLASH_BLOCK = 1024
 
     rng = np.random.default_rng(0)
     q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)),
@@ -62,14 +63,18 @@ def run_shape(B, T, H, D, block, quick=False) -> None:
         "dense": lambda q, k, v: dense_attention(q, k, v, causal=True),
         "blockwise": lambda q, k, v: blockwise_attention(
             q, k, v, block_size=block, causal=True),
+        # Flash takes its own (kernel-scale) block: grid-step count
+        # dominates kernel wall time, unlike the scan path whose block is
+        # a memory/fusion knob.
         "flash": lambda q, k, v: flash_attention(
-            q, k, v, causal=True, block_q=block, block_kv=block),
+            q, k, v, causal=True, block_q=FLASH_BLOCK, block_kv=FLASH_BLOCK),
     }
     if platform != "tpu":
         backends.pop("flash")  # interpreter mode would dominate the chart
 
     flops_fwd = attention_flops(B, T, H, D)
     cfg = {"B": B, "T": T, "H": H, "D": D, "block": block,
+           "flash_block": FLASH_BLOCK,
            "dtype": "bfloat16", "platform": platform}
 
     import time

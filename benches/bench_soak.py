@@ -41,7 +41,7 @@ setup_platform()
 def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
-             traj_per_epoch: int = 64) -> dict:
+             traj_per_epoch: int = 64, algorithm: str = "REINFORCE") -> dict:
     from relayrl_tpu.runtime.server import TrainingServer
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
@@ -50,10 +50,15 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
         "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
     }
+    # IMPALA is the async-fleet north star (BASELINE.md "256 IMPALA
+    # actors"): staleness-corrected, so a big fleet on old versions is the
+    # intended regime, not an edge case.
+    hp = {"traj_per_epoch": traj_per_epoch, "hidden_sizes": [32, 32]}
+    if algorithm == "REINFORCE":
+        hp.update(with_vf_baseline=True, train_vf_iters=5)
     server = TrainingServer(
-        "REINFORCE", obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
-        hyperparams={"traj_per_epoch": traj_per_epoch, "hidden_sizes": [32, 32],
-                     "with_vf_baseline": True, "train_vf_iters": 5},
+        algorithm, obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
+        hyperparams=hp,
         **addrs,
     )
     publishes: list[tuple[int, float]] = []
@@ -117,7 +122,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                  for a in agents for v, t in a["receipts"] if v in pub_times]
     result = {
         "bench": "soak_multi_actor_zmq",
-        "config": {"actors": n_actors, "duration_s": duration_s,
+        "config": {"actors": n_actors, "algorithm": algorithm,
+                   "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
                    "host_cores": os.cpu_count()},
         "agents_completed": len(agents),
@@ -223,6 +229,21 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
 def main():
     quick = "--quick" in sys.argv
     bench_cwd()
+    if "--impala256" in sys.argv:
+        # BASELINE.md north-star fleet shape: 256 async actors feeding one
+        # IMPALA learner. 16 agents/proc keeps the process count sane on
+        # the one-core bench host; spawn+handshake dominate wall time.
+        result = run_soak(n_actors=256, agents_per_proc=16,
+                          duration_s=30.0, algorithm="IMPALA")
+        print(json.dumps(result))
+        assert result["server_stats"]["dropped"] == 0
+        assert result["agents_completed"] == 256
+        if "--write" in sys.argv:
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "results", "soak256_impala.json")
+            with open(out, "w") as f:
+                f.write(json.dumps(result) + "\n")
+        return
     result = run_soak(n_actors=16 if quick else 64,
                       duration_s=8.0 if quick else 30.0)
     blast = run_ingest_blast(n_traj=500 if quick else 2000)

@@ -56,14 +56,20 @@ def _resolve_attention(arch: Mapping[str, Any]) -> Callable:
             # Pallas kernel on TPU; off-TPU (CPU actor hosts, CI) the same
             # arch config resolves to the lax.scan blockwise path — the
             # heterogeneous-placement rule ring attention also follows.
+            # The kernel has its OWN block knob (arch "flash_block"):
+            # grid-step count dominates kernel wall time so it wants large
+            # blocks, while the lax.scan fallback's "attention_block" is a
+            # memory/fusion knob that wants small ones — one shared key
+            # would silently deoptimize whichever path tuned second.
             import jax as _jax
 
             from relayrl_tpu.ops.flash import flash_attention
 
             T = q.shape[1]
-            if _jax.default_backend() == "tpu" and T % min(block, T) == 0:
+            fblock = int(arch.get("flash_block", 1024))
+            if _jax.default_backend() == "tpu" and T % min(fblock, T) == 0:
                 return flash_attention(q, k, v, causal=True,
-                                       block_q=block, block_kv=block)
+                                       block_q=fblock, block_kv=fblock)
             if T % block == 0:
                 return blockwise_attention(q, k, v, block, causal=True)
             return dense_attention(q, k, v, causal=True)

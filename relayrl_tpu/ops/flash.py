@@ -1,4 +1,4 @@
-"""Fused flash-attention Pallas TPU kernel (forward) + blockwise VJP.
+"""Fused flash-attention Pallas TPU kernels (forward + two-pass VJP).
 
 The reference has no attention at all (SURVEY.md §5.7 — its largest model
 is a 2x128 MLP, relayrl_framework/src/native/python/algorithms/REINFORCE/
@@ -16,13 +16,12 @@ recurrence without inter-kernel communication. Causal blocks strictly
 above the diagonal are predicated off with ``pl.when`` (their loads still
 happen — index maps are static — but the matmuls are skipped).
 
-The backward pass recomputes attention blockwise in plain JAX from the
-saved ``(out, lse)`` residuals — the standard flash-attention VJP identity
-
-    ds = p * (dp - rowsum(do * o))
-
-with O(T * block) peak memory, letting XLA fuse it; a hand-written Pallas
-backward kernel is a further step if profiles demand it.
+The backward pass is two more Pallas kernels (the standard two-pass flash
+VJP — no atomics or cross-block communication): a dq pass (grid q-major,
+KV innermost, accumulator in VMEM) and a dk/dv pass (grid kv-major, Q
+innermost), both recomputing p from the saved ``lse`` residual and using
+the identity ``ds = p * (dp - rowsum(do * o))``. Peak memory stays
+O(T * block).
 
 Numerics: scores/softmax in float32 regardless of input dtype; the second
 matmul runs in float32 against the f32 accumulator (MXU-friendly since
@@ -151,54 +150,165 @@ def _fwd(q, k, v, causal, block_q, block_kv, interpret):
     return _bht_to_bthd(out, B, H), lse.reshape(B, H, T)
 
 
-def _bwd_blockwise(q, k, v, out, lse, do, causal, block_kv):
-    """Flash-attention VJP by blockwise recompute from (out, lse).
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, causal: bool, block_q: int, block_kv: int,
+               scale: float):
+    ik = pl.program_id(2)
 
-    All math in f32 over the flat [BH, T, D] layout; a lax.scan over KV
-    blocks bounds peak memory at O(T * block_kv) like the forward.
-    """
-    B, T, H, D = q.shape
-    scale = 1.0 / (D ** 0.5)
-    qf = _bthd_to_bht(q).astype(jnp.float32)
-    kf = _bthd_to_bht(k).astype(jnp.float32)
-    vf = _bthd_to_bht(v).astype(jnp.float32)
-    dof = _bthd_to_bht(do).astype(jnp.float32)
-    of = _bthd_to_bht(out).astype(jnp.float32)
-    lsef = lse.reshape(B * H, T)
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    delta = jnp.sum(dof * of, axis=-1)          # [BH, T]
-    n_blocks = T // block_kv
-    k_blocks = jnp.moveaxis(kf.reshape(-1, n_blocks, block_kv, D), 1, 0)
-    v_blocks = jnp.moveaxis(vf.reshape(-1, n_blocks, block_kv, D), 1, 0)
-    q_pos = jnp.arange(T)
+    q_start = pl.program_id(1) * block_q
+    k_start = ik * block_kv
+    live = (k_start <= q_start + block_q - 1) if causal else True
 
-    def scan_step(dq, blk):
-        k_blk, v_blk, j = blk
-        kv_pos = j * block_kv + jnp.arange(block_kv)
-        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk,
-                       preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lsef[..., None])
+    @pl.when(live)
+    def _block():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if causal:
-            p = jnp.where((q_pos[:, None] >= kv_pos[None, :])[None], p, 0.0)
-        dv_j = jnp.einsum("bqk,bqd->bkd", p, dof,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk,
-                        preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_blk,
-                             preferred_element_type=jnp.float32)
-        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf,
-                          preferred_element_type=jnp.float32)
-        return dq, (dk_j, dv_j)
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        scan_step, jnp.zeros_like(qf),
-        (k_blocks, v_blocks, jnp.arange(n_blocks)))
-    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(-1, T, D)
-    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(-1, T, D)
-    return (_bht_to_bthd(dq, B, H).astype(q.dtype),
-            _bht_to_bthd(dk, B, H).astype(k.dtype),
-            _bht_to_bthd(dv, B, H).astype(v.dtype))
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                block_q: int, block_kv: int, scale: float):
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = iq * block_q
+    k_start = pl.program_id(1) * block_kv
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _block():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_bwd(T: int, D: int, causal: bool, block_q: int, block_kv: int,
+               in_dtype_name: str, interpret: bool):
+    """Compile-cached backward pallas_calls over the [BH, T, D] layout:
+    a dq pass (grid q-major, KV innermost) and a dk/dv pass (grid kv-major,
+    Q innermost) — the standard two-pass flash backward, so neither pass
+    needs atomics or cross-block communication."""
+    dtype = jnp.dtype(in_dtype_name)
+    scale = 1.0 / (D ** 0.5)
+    dq_kernel = functools.partial(_dq_kernel, causal=causal, block_q=block_q,
+                                  block_kv=block_kv, scale=scale)
+    dkv_kernel = functools.partial(_dkv_kernel, causal=causal,
+                                   block_q=block_q, block_kv=block_kv,
+                                   scale=scale)
+    row_spec_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    row_spec_kv_inner = pl.BlockSpec((1, block_q, 1),
+                                     lambda b, j, i: (b, i, 0))
+
+    def call(qr, kr, vr, dor, lse, delta):
+        bh = qr.shape[0]
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(bh, T // block_q, T // block_kv),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                row_spec_q,
+                row_spec_q,
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, T, D), dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            interpret=interpret,
+        )(qr, kr, vr, dor, lse, delta)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(bh, T // block_kv, T // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                row_spec_kv_inner,
+                row_spec_kv_inner,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, T, D), dtype),
+                jax.ShapeDtypeStruct((bh, T, D), dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_kv, D), jnp.float32),
+                pltpu.VMEM((block_kv, D), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qr, kr, vr, dor, lse, delta)
+        return dq, dk, dv
+
+    return call
+
+
+def _bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_kv, interpret):
+    B, T, H, D = q.shape
+    qr, kr, vr, dor = (_bthd_to_bht(x) for x in (q, k, v, do))
+    of = _bthd_to_bht(out)
+    delta = jnp.sum(dor.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [BH, T, 1]
+    lse3 = lse.reshape(B * H, T, 1)
+    call = _build_bwd(T, D, causal, block_q, block_kv, q.dtype.name,
+                      interpret)
+    dq, dk, dv = call(qr, kr, vr, dor, lse3, delta)
+    return (_bht_to_bthd(dq, B, H), _bht_to_bthd(dk, B, H),
+            _bht_to_bthd(dv, B, H))
 
 
 @functools.lru_cache(maxsize=None)
@@ -214,15 +324,16 @@ def _make_flash(causal: bool, block_q: int, block_kv: int, interpret: bool):
 
     def bwd(res, do):
         q, k, v, out, lse = res
-        return _bwd_blockwise(q, k, v, out, lse, do, causal, block_kv)
+        return _bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_kv,
+                           interpret)
 
     flash.defvjp(fwd, bwd)
     return flash
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 128,
-                    block_kv: int = 128,
+                    causal: bool = True, block_q: int = 1024,
+                    block_kv: int = 1024,
                     interpret: bool | None = None) -> jax.Array:
     """Fused attention on ``[B, T, H, D]`` via a Pallas TPU kernel.
 
@@ -231,6 +342,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     :func:`relayrl_tpu.ops.attention.blockwise_attention` instead, which is
     what the model-level ``attention="flash"`` config does off-TPU).
     Requires ``T`` divisible by both block sizes; callers pad or fall back.
+
+    Default blocks are 1024 (clamped to T): the grid-step count dominates
+    kernel wall time on v5e at these head dims — halving the block size
+    measured ~1.6x slower fwd+bwd at T=8192, and the lax.scan recompute
+    VJP this replaced was ~2x slower still. benches/results/attention.json
+    holds the CURRENT committed numbers (run benches/bench_attention.py to
+    refresh). Shrink blocks only if VMEM pressure forces it (the in-kernel
+    score tile is block_q x block_kv f32).
     """
     B, T, H, D = q.shape
     block_q = min(block_q, T)

@@ -84,3 +84,25 @@ def test_transformer_flash_arch_runs_off_tpu():
     assert act.shape == (2,)
     logp, ent, v = policy.evaluate(params, obs, jnp.zeros((2, 32), jnp.int32))
     assert logp.shape == (2, 32)
+
+
+@pytest.mark.parametrize("causal,bq,bk", [
+    (True, 16, 32), (True, 32, 16), (False, 16, 32), (False, 32, 16),
+])
+def test_flash_grads_uneven_and_noncausal(causal, bq, bk):
+    # The two-pass Pallas VJP has distinct grid orderings per pass (dq is
+    # q-major, dk/dv is kv-major) and per-pass live-block predicates; cover
+    # uneven blocks and the non-causal branch explicitly.
+    q, k, v = _qkv()
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+    got = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=bq, block_kv=bk)),
+        argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(lambda q, k, v: dense_attention(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(g, w, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
