@@ -35,6 +35,9 @@ def free_port() -> int:
 CELLS = [
     ("REINFORCE", {"with_vf_baseline": True}, "zmq"),
     ("REINFORCE", {"with_vf_baseline": False}, "grpc"),
+    # The native C++ framed-TCP core, end-to-end through the same loop
+    # (skipped with a notice when the .so isn't built).
+    ("REINFORCE", {"with_vf_baseline": True}, "native"),
     ("PPO", {}, "zmq"),
     ("PPO", {}, "grpc"),
 ]
@@ -111,9 +114,16 @@ def main():
     ap.add_argument("--out", default="matrix_artifacts")
     args = ap.parse_args()
 
+    from relayrl_tpu.transport.native_backend import native_available
+
+    cells = [c for c in CELLS
+             if c[2] != "native" or native_available()]
+    if len(cells) < len(CELLS):
+        print("[matrix] native .so unavailable — skipping native cell",
+              flush=True)
     os.makedirs(args.out, exist_ok=True)
     results = [run_cell(algo, hp, transport, args.updates, args.out)
-               for algo, hp, transport in CELLS]
+               for algo, hp, transport in cells]
     assert all(r["dropped"] == 0 for r in results)
     assert all(r["final_model_version"] >= 1 for r in results), (
         "model hot-swap must reach the agent in every cell")

@@ -40,6 +40,25 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _masked_scores(q_ref, k_ref, q_start, k_start, causal: bool,
+                   block_q: int, block_kv: int, scale: float):
+    """Scaled (and causally masked) score tile for the current block pair —
+    the recompute shared by the forward and both backward kernels. Inputs
+    stay in their storage dtype (bf16 in production): the MXU runs
+    bf16 x bf16 -> f32 at full rate, while casting to f32 first would
+    quarter the matmul throughput; softmax math stays f32."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, causal: bool, block_q: int, block_kv: int, scale: float):
     ik = pl.program_id(2)
@@ -58,18 +77,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(live)
     def _block():
-        # Inputs stay in their storage dtype (bf16 in production): the MXU
-        # runs bf16 x bf16 -> f32 at full rate, while casting to f32 first
-        # would quarter the matmul throughput. Softmax math is f32.
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _masked_scores(q_ref, k_ref, q_start, k_start, causal,
+                           block_q, block_kv, scale)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # Masked entries carry s == _NEG_INF; exp(s - m_new) underflows to 0
@@ -165,15 +174,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _block():
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _masked_scores(q_ref, k_ref, q_start, k_start, causal,
+                           block_q, block_kv, scale)
         p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -204,15 +206,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _block():
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _masked_scores(q_ref, k_ref, q_start, k_start, causal,
+                           block_q, block_kv, scale)
         p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
