@@ -27,10 +27,13 @@ ARCHS = {
              "hidden_sizes": [128, 128], "epsilon": 0.05},
     "sac": {"kind": "sac_continuous", "obs_dim": 17, "act_dim": 6,
             "hidden_sizes": [256, 256], "act_limit": 1.0},
-    "transformer_t64": {"kind": "transformer_discrete", "obs_dim": 8,
-                        "act_dim": 4, "d_model": 64, "n_layers": 2,
-                        "n_heads": 4, "max_seq_len": 64},
 }
+
+# Sequence serving is measured separately (window vs KV-cache paths, per
+# context length) — the per-step cost model differs from the stateless
+# families above.
+SEQ_ARCH = {"kind": "transformer_discrete", "obs_dim": 8, "act_dim": 4,
+            "d_model": 64, "n_layers": 2, "n_heads": 4}
 
 
 def main():
@@ -42,10 +45,7 @@ def main():
         actor = PolicyActor(
             ModelBundle(version=1, arch=arch, params=params),
             max_traj_length=10_000)
-        if name.startswith("transformer"):
-            obs = np.zeros((16, arch["obs_dim"]), np.float32)  # 16-step ctx
-        else:
-            obs = np.zeros((arch["obs_dim"],), np.float32)
+        obs = np.zeros((arch["obs_dim"],), np.float32)
 
         def step():
             actor.request_for_action(obs)
@@ -54,6 +54,30 @@ def main():
         emit("agent_inference", {"model": name}, t["median_s"] * 1e6, "us")
         emit("agent_inference_throughput", {"model": name},
              1.0 / t["mean_s"], "steps/s")
+
+    for W in ([64] if quick() else [64, 256]):
+        arch = {**SEQ_ARCH, "max_seq_len": W}
+        policy = build_policy(arch)
+        params = policy.init_params(jax.random.PRNGKey(0))
+        obs_seq = np.zeros((W, arch["obs_dim"]), np.float32)
+        for mode in ("cached", "window"):
+            actor = PolicyActor(
+                ModelBundle(version=1, arch=arch, params=params),
+                max_traj_length=W + 10,
+                use_kv_cache=(mode == "cached"))
+            for t_i in range(W):         # warmup episode (compile)
+                actor.request_for_action(obs_seq[t_i])
+            actor.flag_last_action()
+            import time as _time
+
+            t0 = _time.perf_counter()
+            for t_i in range(W):
+                actor.request_for_action(obs_seq[t_i])
+            dt = (_time.perf_counter() - t0) / W
+            actor.flag_last_action()
+            emit("seq_serving_per_step",
+                 {"model": f"transformer_W{W}", "mode": mode},
+                 dt * 1e6, "us")
 
 
 if __name__ == "__main__":

@@ -87,7 +87,8 @@ class OffPolicyAlgorithm(AlgorithmBase):
         # actor processes explore differently.
         self._rng_init = jax.random.PRNGKey(seed)
         self._rng_state = jax.random.fold_in(
-            jax.random.PRNGKey(seed ^ 0x5EED), os.getpid())
+            jax.random.PRNGKey(seed ^ 0x5EED),
+            int(params.get("seed_salt", os.getpid())))
 
         self.buffer = StepReplayBuffer(
             obs_dim=self.obs_dim,

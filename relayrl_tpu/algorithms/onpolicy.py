@@ -55,7 +55,10 @@ class OnPolicyAlgorithm(AlgorithmBase):
         seed = int(params.get("seed", 1))
         # Ref seeds `seed + 10000 * proc_id` (REINFORCE.py:40-42); fold_in is
         # the JAX-native equivalent with better key hygiene.
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), os.getpid())
+        # seed_salt overrides the pid fold-in for deterministic runs
+        # (learning tests, reproducibility studies) without patching os.
+        salt = int(params.get("seed_salt", os.getpid()))
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), salt)
 
         # Subclass: sets self.arch, self.policy, self.state, self._update.
         self._setup(params, learner, rng)

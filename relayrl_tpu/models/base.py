@@ -103,6 +103,17 @@ class Policy:
     mode: Callable
     step_window: Callable | None = None
     mode_window: Callable | None = None
+    # KV-cache incremental serving (sequence policies): ``init_cache(W,
+    # batch_size) -> cache`` and ``step_cached(params, rng, cache, obs, t,
+    # mask) -> (act, aux, new_cache)`` — O(W) per step vs step_window's
+    # full-window recompute. Numerics identical to step_window while
+    # t < W (PolicyActor falls back to the window path past that, and
+    # replays the window to rebuild the cache after a model hot-swap).
+    init_cache: Callable | None = None
+    step_cached: Callable | None = None
+    # ``prefill_cache(params, cache, window) -> cache`` rebuilds the whole
+    # cache from the padded window in one dispatch (used after hot-swaps).
+    prefill_cache: Callable | None = None
 
     @property
     def input_dim(self) -> int:

@@ -103,7 +103,16 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 2
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, t=None):
+        """Full mode (``cache=None``): x ``[B, T, d]`` -> ``[B, T, d]``.
+
+        Decode mode: x is ONE position ``[B, 1, d]``; ``cache`` is this
+        layer's ``(k, v)`` pair ``[B, W, H, hd]`` and ``t`` the write
+        index. Attention runs q against the cache prefix (positions <= t)
+        instead of recomputing the whole window — O(W) per step vs the
+        window path's O(W^2). Returns ``(out, new_cache)``. Param
+        names/creation order are identical in both modes (init always runs
+        the full path), so one param tree serves both."""
         B, T, _ = x.shape
         head_dim = self.d_model // self.n_heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
@@ -112,8 +121,28 @@ class TransformerBlock(nn.Module):
                        name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, T, self.n_heads, head_dim)
-        attn = self.attn_fn(q.reshape(shape), k.reshape(shape),
-                            v.reshape(shape))
+        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        if cache is None:
+            attn = self.attn_fn(q, k, v)
+            new_cache = None
+        else:
+            k_cache, v_cache = cache
+            W = k_cache.shape[1]
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k.astype(k_cache.dtype), t, axis=1)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v.astype(v_cache.dtype), t, axis=1)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                           preferred_element_type=jnp.float32)
+            s = s / jnp.sqrt(jnp.float32(head_dim))
+            # Query j sits at absolute position t+j (T=1 per-step decode;
+            # T=W prefill rebuilds the whole prefix in one dispatch).
+            live = jnp.arange(W)[None, :] <= (t + jnp.arange(T))[:, None]
+            s = jnp.where(live[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype),
+                              v_cache)
+            new_cache = (k_cache, v_cache)
         attn = attn.reshape(B, T, self.d_model)
         x = x + nn.Dense(self.d_model, dtype=self.compute_dtype,
                          name="attn_out")(attn).astype(x.dtype)
@@ -124,24 +153,30 @@ class TransformerBlock(nn.Module):
             h = MoEMLP(self.d_model, self.mlp_ratio * self.d_model,
                        self.moe_experts, self.moe_top_k,
                        self.compute_dtype, name="moe")(h)
-            return x + h.astype(x.dtype)
-        h = h.astype(self.compute_dtype)
-        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.compute_dtype,
-                     name="mlp_up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.d_model, dtype=self.compute_dtype, name="mlp_down")(h)
-        return x + h.astype(x.dtype)
+            out = x + h.astype(x.dtype)
+        else:
+            h = h.astype(self.compute_dtype)
+            h = nn.Dense(self.mlp_ratio * self.d_model,
+                         dtype=self.compute_dtype, name="mlp_up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                         name="mlp_down")(h)
+            out = x + h.astype(x.dtype)
+        return out if cache is None else (out, new_cache)
 
 
-def _embed_obs(parent: nn.Module, obs, d_model: int, max_seq_len: int):
+def _embed_obs(parent: nn.Module, obs, d_model: int, max_seq_len: int,
+               start=0):
     """Obs embedding + positional table, built in the CALLER's param scope
     (layer names land flat: obs_embed / pos_embed) — the single source of
-    truth shared by TransformerCore and the pipeline family's _PPEmbed."""
+    truth shared by TransformerCore (full AND cached-decode modes, which
+    differ only in the ``start`` position) and the pipeline family's
+    _PPEmbed."""
     _, T, _ = obs.shape
     x = nn.Dense(d_model, dtype=jnp.float32, name="obs_embed")(obs)
     pos = parent.param("pos_embed", nn.initializers.normal(0.02),
                        (max_seq_len, d_model), jnp.float32)
-    return x + jax.lax.dynamic_slice_in_dim(pos, 0, T, axis=0)[None]
+    return x + jax.lax.dynamic_slice_in_dim(pos, start, T, axis=0)[None]
 
 
 def _readout_heads(x, mask, act_dim: int, d_model: int, has_critic: bool):
@@ -181,15 +216,29 @@ class TransformerCore(nn.Module):
     moe_top_k: int = 2
 
     @nn.compact
-    def __call__(self, obs, mask=None):
-        x = _embed_obs(self, obs, self.d_model, self.max_seq_len)
+    def __call__(self, obs, mask=None, cache=None, t=None):
+        """Full mode: obs ``[B, T, D]`` -> (logits, v). Decode mode
+        (``cache`` = tuple of per-layer (k, v) pairs, ``t`` = position):
+        obs is ``[B, 1, D]``; returns ``((logits, v), new_cache)`` for the
+        single position. Init always traces the full path, so both modes
+        share one param tree."""
+        decode = cache is not None
+        x = _embed_obs(self, obs, self.d_model, self.max_seq_len,
+                       start=t if decode else 0)
+        new_cache = []
         for i in range(self.n_layers):
-            x = TransformerBlock(
+            block = TransformerBlock(
                 self.d_model, self.n_heads, self.mlp_ratio, self.attn_fn,
                 self.compute_dtype, moe_experts=self.moe_experts,
-                moe_top_k=self.moe_top_k, name=f"block_{i}")(x)
-        return _readout_heads(x, mask, self.act_dim, self.d_model,
-                              self.has_critic)
+                moe_top_k=self.moe_top_k, name=f"block_{i}")
+            if decode:
+                x, layer_cache = block(x, cache=cache[i], t=t)
+                new_cache.append(layer_cache)
+            else:
+                x = block(x)
+        heads = _readout_heads(x, mask, self.act_dim, self.d_model,
+                               self.has_critic)
+        return (heads, tuple(new_cache)) if decode else heads
 
 
 def _as_btd(obs, mask):
@@ -296,7 +345,56 @@ def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
     def init_params(rng):
         return core.init(rng, jnp.zeros((1, 1, obs_dim), jnp.float32))
 
-    return _policy_from_apply(arch, init_params, core.apply)
+    head_dim = core.d_model // core.n_heads
+    cache_dtype = core.compute_dtype
+
+    def init_cache(length: int, batch_size: int = 1):
+        """Zeroed per-layer (k, v) caches for incremental decoding."""
+        shape = (batch_size, int(length), core.n_heads, head_dim)
+        return tuple(
+            (jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype))
+            for _ in range(core.n_layers))
+
+    def step_cached(params, rng, cache, obs, t, mask=None):
+        """One O(W) decode step: writes position ``t`` into the cache and
+        samples the action for it. Numerics match ``step_window`` at the
+        same position (tests/test_kv_cache.py)."""
+        obs = jnp.asarray(obs)
+        while obs.ndim < 3:                     # [D] / [B,D] -> [B,1,D]
+            obs = obs[None]
+        mask_b = None
+        if mask is not None:
+            mask_b = jnp.asarray(mask)
+            while mask_b.ndim < 3:
+                mask_b = mask_b[None]
+        (logits, v), new_cache = core.apply(params, obs, mask_b,
+                                            cache=cache, t=t)
+        logits_t, v_t = logits[:, 0], v[:, 0]
+        act = jax.random.categorical(rng, logits_t, axis=-1)
+        aux = {"logp_a": _categorical_logp(logits_t, act), "v": v_t}
+        if obs.shape[0] == 1:
+            act = act[0]
+            aux = {k: a[0] for k, a in aux.items()}
+        return act, aux, new_cache
+
+    def prefill_cache(params, cache, window):
+        """Rebuild the whole cache from a padded window in ONE dispatch
+        (post-hot-swap path): runs decode mode with T = W queries at
+        t=0. Padding rows write garbage K/V beyond the real prefix, which
+        later per-step decodes never attend (their causal mask stops at
+        the current t) and overwrite in order."""
+        window = jnp.asarray(window)
+        if window.ndim == 2:
+            window = window[None]
+        _, new_cache = core.apply(params, window, None, cache=cache, t=0)
+        return new_cache
+
+    policy = _policy_from_apply(arch, init_params, core.apply)
+    import dataclasses as _dc
+
+    return _dc.replace(policy, init_cache=init_cache,
+                       step_cached=step_cached,
+                       prefill_cache=prefill_cache)
 
 
 @register_model("transformer_discrete")

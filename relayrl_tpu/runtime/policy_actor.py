@@ -42,6 +42,7 @@ class PolicyActor:
         on_send=None,
         seed: int = 0,
         validate: bool = True,
+        use_kv_cache: bool = True,
     ):
         self._lock = threading.Lock()
         self.arch = dict(bundle.arch)
@@ -79,6 +80,24 @@ class PolicyActor:
             self._window_fn = jax.jit(self.policy.step_window)
             if self.policy.mode_window is not None:
                 self._mode_window_fn = jax.jit(self.policy.mode_window)
+        # KV-cache incremental serving: O(W) per step instead of the
+        # window path's O(W^2) full recompute. The window is still
+        # maintained alongside — it is the replay source after a model
+        # hot-swap (cache holds K/V computed by the OLD params) and the
+        # fallback once an episode outgrows the context and the window
+        # starts rolling (absolute positions shift, invalidating the
+        # cache wholesale).
+        self._cached_fn = None
+        self._prefill_fn = None
+        self._cache = None
+        self._cache_version = -1
+        if (use_kv_cache and self.policy.step_cached is not None
+                and self._window is not None):
+            self._cached_fn = jax.jit(self.policy.step_cached,
+                                      donate_argnums=(2,))
+            if self.policy.prefill_cache is not None:
+                self._prefill_fn = jax.jit(self.policy.prefill_cache,
+                                           donate_argnums=(1,))
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
@@ -96,9 +115,19 @@ class PolicyActor:
         with self._lock:
             self._rng, sub = jax.random.split(self._rng)
             if self._window_fn is not None:
-                self._push_window(obs)
-                act, aux = self._window_fn(self.params, sub, self._window,
-                                           self._window_len, mask_arr)
+                rolled = self._push_window(obs)
+                t = self._window_len - 1
+                if self._cached_fn is not None and not rolled:
+                    if (self._cache is None
+                            or self._cache_version != self.version):
+                        self._rebuild_cache(t)
+                    act, aux, self._cache = self._cached_fn(
+                        self.params, sub, self._cache, obs, t, mask_arr)
+                else:
+                    self._cache = None  # rolling: positions shifted
+                    act, aux = self._window_fn(
+                        self.params, sub, self._window,
+                        self._window_len, mask_arr)
             else:
                 act, aux = self._step_fn(self.params, sub, obs, mask_arr,
                                          **self._explore_kwargs)
@@ -144,6 +173,7 @@ class PolicyActor:
                 # one's observations.
                 self._window[:] = 0.0
                 self._window_len = 0
+                self._cache = None
             record = ActionRecord(
                 obs=(None if final_obs is None
                      else np.asarray(final_obs, np.float32)),
@@ -184,14 +214,30 @@ class PolicyActor:
     def swap_from_bytes(self, buf: bytes) -> bool:
         return self.maybe_swap(ModelBundle.from_bytes(buf))
 
-    def _push_window(self, obs: np.ndarray) -> None:
-        """Append one observation to the rolling history (lock held)."""
+    def _push_window(self, obs: np.ndarray) -> bool:
+        """Append one observation to the rolling history (lock held).
+        Returns True once the window has started rolling."""
         if self._window_len < self._window.shape[0]:
             self._window[self._window_len] = obs
             self._window_len += 1
-        else:  # rolling: drop the oldest step
-            self._window[:-1] = self._window[1:]
-            self._window[-1] = obs
+            return False
+        self._window[:-1] = self._window[1:]  # rolling: drop the oldest
+        self._window[-1] = obs
+        return True
+
+    def _rebuild_cache(self, t: int) -> None:
+        """Fresh cache, refilled from the stored window (lock held) —
+        called lazily after a model hot-swap (old params' K/V are stale)
+        or on the first cached step of an episode. One prefill dispatch
+        over the full padded window (fixed shape, so one jit signature;
+        padding rows write K/V that later steps overwrite in order and
+        never attend before that). Masks are not replayed: they only gate
+        the readout logits, never the K/V trunk."""
+        self._cache = self.policy.init_cache(self._window.shape[0])
+        if t > 0:
+            self._cache = self._prefill_fn(self.params, self._cache,
+                                           self._window)
+        self._cache_version = self.version
 
     def deterministic_action(self, obs, mask=None):
         """Greedy action. For sequence policies this ADVANCES the history
@@ -203,6 +249,10 @@ class PolicyActor:
         with self._lock:
             if self._mode_window_fn is not None:
                 self._push_window(obs_arr)
+                # The greedy path bypasses the cache but still advances the
+                # window; drop the cache so the sampling path rebuilds with
+                # every position present.
+                self._cache = None
                 act = self._mode_window_fn(self.params, self._window,
                                            self._window_len, mask_arr)
             else:

@@ -1,0 +1,128 @@
+"""KV-cache incremental decoding: policy-level numerics + actor behavior.
+
+The cached path must be numerically identical to the full-window recompute
+(same logits ⇒ same sampled actions for the same key), survive model
+hot-swaps mid-episode (replay rebuild), and hand off to the window path
+once the episode outgrows the context window.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relayrl_tpu.models import build_policy
+from relayrl_tpu.runtime.policy_actor import PolicyActor
+from relayrl_tpu.types.model_bundle import ModelBundle
+
+ARCH = {"kind": "transformer_discrete", "obs_dim": 6, "act_dim": 3,
+        "d_model": 32, "n_layers": 2, "n_heads": 2, "max_seq_len": 12}
+
+
+def _policy_params(seed=0):
+    policy = build_policy(ARCH)
+    return policy, policy.init_params(jax.random.PRNGKey(seed))
+
+
+class TestStepCachedNumerics:
+    def test_matches_step_window(self):
+        policy, params = _policy_params()
+        rng = np.random.default_rng(0)
+        W = 8
+        cache = policy.init_cache(W)
+        window = np.zeros((W, 6), np.float32)
+        for t in range(W):
+            obs = rng.standard_normal(6).astype(np.float32)
+            window[t] = obs
+            key = jax.random.PRNGKey(100 + t)
+            a_w, aux_w = policy.step_window(params, key,
+                                            jnp.asarray(window), t + 1)
+            a_c, aux_c, cache = policy.step_cached(params, key, cache,
+                                                   obs, t)
+            assert int(a_w) == int(a_c), f"t={t}"
+            np.testing.assert_allclose(float(aux_w["v"]),
+                                       float(aux_c["v"]), atol=1e-4)
+            np.testing.assert_allclose(float(aux_w["logp_a"]),
+                                       float(aux_c["logp_a"]), atol=1e-4)
+
+    def test_moe_family_has_cache(self):
+        moe = build_policy({**ARCH, "kind": "transformer_moe_discrete",
+                            "moe_experts": 2})
+        params = moe.init_params(jax.random.PRNGKey(0))
+        cache = moe.init_cache(4)
+        act, aux, cache = moe.step_cached(
+            params, jax.random.PRNGKey(1), cache,
+            np.zeros(6, np.float32), 0)
+        assert np.isfinite(float(aux["logp_a"]))
+
+    def test_mask_applies_to_readout(self):
+        policy, params = _policy_params()
+        cache = policy.init_cache(4)
+        mask = np.array([1.0, 0.0, 0.0], np.float32)
+        act, _, _ = policy.step_cached(params, jax.random.PRNGKey(0),
+                                       cache, np.zeros(6, np.float32), 0,
+                                       mask)
+        assert int(act) == 0  # only legal action
+
+
+def _actor(version=1, seed=0, use_kv_cache=True, **arch_over):
+    policy, params = _policy_params()
+    arch = {**ARCH, **arch_over}
+    return PolicyActor(ModelBundle(arch=arch, params=params,
+                                   version=version), seed=seed,
+                       max_traj_length=200, use_kv_cache=use_kv_cache)
+
+
+class TestActorCachedServing:
+    def test_cached_equals_window_actor(self):
+        # Two actors, same seed/params: one with the cache disabled.
+        rng = np.random.default_rng(1)
+        obs_seq = [rng.standard_normal(6).astype(np.float32)
+                   for _ in range(8)]
+        a_cached = _actor(seed=3)
+        a_window = _actor(seed=3, use_kv_cache=False)
+        assert a_cached._cached_fn is not None
+        for obs in obs_seq:
+            r1 = a_cached.request_for_action(obs)
+            r2 = a_window.request_for_action(obs)
+            assert int(np.asarray(r1.act)) == int(np.asarray(r2.act))
+            np.testing.assert_allclose(
+                np.asarray(r1.data["logp_a"]), np.asarray(r2.data["logp_a"]),
+                atol=1e-4)
+
+    def test_hot_swap_mid_episode_rebuilds(self):
+        policy, params2 = _policy_params(seed=9)
+        actor = _actor(seed=5)
+        control = _actor(seed=5, use_kv_cache=False)
+        rng = np.random.default_rng(2)
+        obs_seq = [rng.standard_normal(6).astype(np.float32)
+                   for _ in range(6)]
+        for obs in obs_seq[:3]:
+            actor.request_for_action(obs)
+            control.request_for_action(obs)
+        bundle = ModelBundle(arch=ARCH, params=params2, version=2)
+        assert actor.maybe_swap(bundle) and control.maybe_swap(bundle)
+        for obs in obs_seq[3:]:
+            r1 = actor.request_for_action(obs)
+            r2 = control.request_for_action(obs)
+            assert int(np.asarray(r1.act)) == int(np.asarray(r2.act))
+            np.testing.assert_allclose(
+                np.asarray(r1.data["v"]), np.asarray(r2.data["v"]),
+                atol=1e-4)
+
+    def test_rolling_window_falls_back(self):
+        actor = _actor(seed=7, actor_context=4)
+        control = _actor(seed=7, actor_context=4, use_kv_cache=False)
+        rng = np.random.default_rng(3)
+        for i in range(7):  # rolls after 4 steps
+            obs = rng.standard_normal(6).astype(np.float32)
+            r1 = actor.request_for_action(obs)
+            r2 = control.request_for_action(obs)
+            assert int(np.asarray(r1.act)) == int(np.asarray(r2.act)), i
+        assert actor._cache is None  # rolled -> cache dropped
+
+    def test_episode_boundary_resets_cache(self):
+        actor = _actor(seed=11)
+        actor.request_for_action(np.zeros(6, np.float32))
+        assert actor._cache is not None
+        actor.flag_last_action(reward=1.0)
+        assert actor._cache is None and actor._window_len == 0
