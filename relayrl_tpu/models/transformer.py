@@ -127,21 +127,16 @@ class TransformerBlock(nn.Module):
             new_cache = None
         else:
             k_cache, v_cache = cache
-            W = k_cache.shape[1]
             k_cache = jax.lax.dynamic_update_slice_in_dim(
                 k_cache, k.astype(k_cache.dtype), t, axis=1)
             v_cache = jax.lax.dynamic_update_slice_in_dim(
                 v_cache, v.astype(v_cache.dtype), t, axis=1)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
-                           preferred_element_type=jnp.float32)
-            s = s / jnp.sqrt(jnp.float32(head_dim))
             # Query j sits at absolute position t+j (T=1 per-step decode;
-            # T=W prefill rebuilds the whole prefix in one dispatch).
-            live = jnp.arange(W)[None, :] <= (t + jnp.arange(T))[:, None]
-            s = jnp.where(live[None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cache.dtype),
-                              v_cache)
+            # T=W prefill rebuilds the whole prefix in one dispatch) —
+            # exactly dense_attention's offset-causal mask, so the cached
+            # path shares the window path's attention code verbatim.
+            attn = dense_attention(q, k_cache, v_cache, causal=True,
+                                   q_offset=t)
             new_cache = (k_cache, v_cache)
         attn = attn.reshape(B, T, self.d_model)
         x = x + nn.Dense(self.d_model, dtype=self.compute_dtype,
@@ -360,13 +355,17 @@ def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
         samples the action for it. Numerics match ``step_window`` at the
         same position (tests/test_kv_cache.py)."""
         obs = jnp.asarray(obs)
-        while obs.ndim < 3:                     # [D] / [B,D] -> [B,1,D]
-            obs = obs[None]
+        if obs.ndim == 1:                       # [D] -> [1,1,D]
+            obs = obs[None, None]
+        elif obs.ndim == 2:                     # [B,D] -> [B,1,D]
+            obs = obs[:, None]
         mask_b = None
         if mask is not None:
             mask_b = jnp.asarray(mask)
-            while mask_b.ndim < 3:
-                mask_b = mask_b[None]
+            if mask_b.ndim == 1:                # [A] -> [1,1,A]
+                mask_b = mask_b[None, None]
+            elif mask_b.ndim == 2:              # [B,A] -> [B,1,A]
+                mask_b = mask_b[:, None]
         (logits, v), new_cache = core.apply(params, obs, mask_b,
                                             cache=cache, t=t)
         logits_t, v_t = logits[:, 0], v[:, 0]

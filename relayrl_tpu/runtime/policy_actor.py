@@ -92,12 +92,14 @@ class PolicyActor:
         self._cache = None
         self._cache_version = -1
         if (use_kv_cache and self.policy.step_cached is not None
+                and self.policy.prefill_cache is not None
                 and self._window is not None):
+            # prefill is required, not optional: cache rebuild (hot-swap,
+            # greedy-path interleave) calls it with t > 0.
             self._cached_fn = jax.jit(self.policy.step_cached,
                                       donate_argnums=(2,))
-            if self.policy.prefill_cache is not None:
-                self._prefill_fn = jax.jit(self.policy.prefill_cache,
-                                           donate_argnums=(1,))
+            self._prefill_fn = jax.jit(self.policy.prefill_cache,
+                                       donate_argnums=(1,))
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)

@@ -139,3 +139,31 @@ class TestImpala:
         for s in range(4):
             algo.receive_trajectory(_episode(0.9, seed=s))
         assert 0.0 < algo._last_metrics["RhoMean"] <= 1.0 + 1e-6
+
+
+def test_impala_with_sequence_policy(tmp_cwd):
+    """model_kind passthrough: IMPALA trains a transformer policy (the
+    async-fleet algorithm with the long-context family)."""
+    import numpy as np
+
+    from relayrl_tpu.algorithms import build_algorithm
+    from relayrl_tpu.types.action import ActionRecord
+
+    algo = build_algorithm(
+        "IMPALA", obs_dim=6, act_dim=3, traj_per_epoch=4,
+        model_kind="transformer_discrete", d_model=16, n_layers=1,
+        n_heads=2, max_seq_len=16, bucket_lengths=(16,),
+        env_dir=str(tmp_cwd), logger_kwargs={"output_dir": str(tmp_cwd)})
+    assert algo.arch["kind"] == "transformer_discrete"
+    rng = np.random.default_rng(0)
+    for ep in range(4):
+        records = [
+            ActionRecord(obs=rng.standard_normal(6).astype(np.float32),
+                         act=np.int64(rng.integers(3)), rew=1.0,
+                         data={"logp_a": np.float32(-1.1),
+                               "v": np.float32(0.2)},
+                         done=(i == 7))
+            for i in range(8)
+        ]
+        updated = algo.receive_trajectory(records)
+    assert updated and algo.version == 1

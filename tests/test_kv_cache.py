@@ -126,3 +126,24 @@ class TestActorCachedServing:
         assert actor._cache is not None
         actor.flag_last_action(reward=1.0)
         assert actor._cache is None and actor._window_len == 0
+
+
+def test_step_cached_batched():
+    # init_cache(W, batch_size=B): a [B, D] obs batch is B parallel
+    # episodes at the same position, NOT a time axis.
+    policy, params = _policy_params()
+    B, W = 4, 8
+    cache = policy.init_cache(W, batch_size=B)
+    rng = np.random.default_rng(4)
+    obs = rng.standard_normal((B, 6)).astype(np.float32)
+    act, aux, cache = policy.step_cached(params, jax.random.PRNGKey(0),
+                                         cache, obs, 0)
+    assert act.shape == (B,)
+    assert aux["v"].shape == (B,)
+    # against per-episode single decode
+    for b in range(B):
+        c1 = policy.init_cache(W)
+        a1, aux1, _ = policy.step_cached(params, jax.random.PRNGKey(0),
+                                         c1, obs[b], 0)
+        np.testing.assert_allclose(float(aux1["v"]), float(aux["v"][b]),
+                                   atol=1e-5)

@@ -142,3 +142,51 @@ class TestPPTransformerPolicy:
              jax.tree_util.DictKey("qkv"), jax.tree_util.DictKey("kernel")),
             jnp.zeros((4, 16, 48)), mesh)
         assert spec[0] == "pp"
+
+
+class TestCombinedAxes:
+    def test_pp_with_fsdp_and_dp(self):
+        # pp shards the layer stack; fsdp takes non-block params; dp splits
+        # the batch — all three in one mesh must compose (the rule order
+        # in parallel/sharding.py: pp before ep/fsdp).
+        from relayrl_tpu.algorithms.reinforce import (
+            ReinforceState,
+            make_optimizers,
+            make_reinforce_update,
+        )
+        from relayrl_tpu.parallel import (
+            make_sharded_update,
+            place_batch,
+            place_state,
+        )
+
+        mesh = make_mesh({"dp": 2, "fsdp": 2, "pp": 2})
+        policy = build_policy({"kind": "transformer_pp_discrete",
+                               "obs_dim": 6, "act_dim": 3, "d_model": 16,
+                               "n_layers": 4, "n_heads": 2,
+                               "max_seq_len": 8})
+        params = policy.init_params(jax.random.PRNGKey(0))
+        tx_pi, tx_vf = make_optimizers(params, 3e-4, 1e-3)
+        state = ReinforceState(params=params, pi_opt_state=tx_pi.init(params),
+                               vf_opt_state=tx_vf.init(params),
+                               rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+        update = make_reinforce_update(policy, 3e-4, 1e-3, 1, 0.99, 0.95,
+                                       with_baseline=True)
+        rng = np.random.default_rng(0)
+        B, T = 8, 8
+        batch = {
+            "obs": rng.standard_normal((B, T, 6)).astype(np.float32),
+            "act": rng.integers(0, 3, (B, T)).astype(np.int32),
+            "act_mask": np.ones((B, T, 3), np.float32),
+            "rew": np.ones((B, T), np.float32),
+            "val": np.zeros((B, T), np.float32),
+            "logp": np.zeros((B, T), np.float32),
+            "valid": np.ones((B, T), np.float32),
+            "last_val": np.zeros((B,), np.float32),
+        }
+        sharded = make_sharded_update(update, mesh, state, donate_state=False)
+        new_state, metrics = sharded(place_state(state, mesh),
+                                     place_batch(batch, mesh))
+        jax.block_until_ready(new_state)
+        assert int(new_state.step) == 1
+        assert np.isfinite(float(metrics["LossPi"]))
