@@ -26,13 +26,20 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
     from relayrl_tpu.runtime.agent import Agent
 
     ident = f"soak-{cfg['worker_id']}-{agent_idx}"
+    if cfg.get("server_type", "zmq") == "native":
+        addr_overrides = {"server_addr": cfg["server_addr"]}
+    else:
+        addr_overrides = {
+            "agent_listener_addr": cfg["agent_listener_addr"],
+            "trajectory_addr": cfg["trajectory_addr"],
+            "model_sub_addr": cfg["model_sub_addr"],
+        }
     agent = Agent(
         model_path=os.path.join(cfg["scratch"], f"model_{ident}.msgpack"),
         seed=cfg["worker_id"] * 1000 + agent_idx,
         handshake_timeout_s=cfg["handshake_timeout_s"],
-        agent_listener_addr=cfg["agent_listener_addr"],
-        trajectory_addr=cfg["trajectory_addr"],
-        model_sub_addr=cfg["model_sub_addr"],
+        server_type=cfg.get("server_type", "zmq"),
+        **addr_overrides,
     )
     # Observe model fan-out: timestamp every SUB receipt (before the swap
     # work) keyed by version.

@@ -9,7 +9,7 @@ chip (the root bench.py is the recorded headline).
 
 import numpy as np
 
-from common import emit, quick, setup_platform, time_fn
+from common import emit, quick, setup_platform, time_chained
 
 setup_platform()
 
@@ -46,14 +46,11 @@ def bench_algo(name, make_state_update, batch):
     state, update = make_state_update()
     jitted = jax.jit(update)
     device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
-
-    def step():
-        nonlocal state
-        state, metrics = jitted(state, device_batch)
-        jax.block_until_ready(metrics)
-
-    t = time_fn(step, warmup=3, iters=10 if quick() else 30)
-    emit("learner_update", {"algorithm": name}, 1.0 / t["mean_s"], "updates/s")
+    dt = time_chained(lambda s: jitted(s, device_batch), state,
+                      iters=10 if quick() else 30)
+    emit("learner_update",
+         {"algorithm": name, "platform": jax.default_backend()},
+         1.0 / dt, "updates/s")
 
 
 def main():

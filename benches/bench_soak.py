@@ -17,7 +17,14 @@ commits the result to benches/results/soak64.json.
 Note the bench host has ONE core: agents run as threads inside a few
 processes (socket topology per agent is unchanged — own DEALER/PUSH/SUB),
 and absolute env-steps/s is a single-core number; the SLOs (zero drops,
-fan-out latency, zero crashed agents) are the portable result.
+zero crashed agents, full drain) are the portable result. Fan-out RECEIPT
+counts here are NOT a transport measurement on this host: the worker
+processes' model-listener threads share one core's GIL with 8-16
+jax-busy actor loops, so receipt glue can starve for seconds regardless
+of backend (zmq showed 9.6 s p95; native windows can record zero
+receipts while the C++ layer delivered every frame — verified by C-side
+counters). The transport-isolated fan-out number lives in
+bench_transport_scale.py, where native wins ~1.5x.
 """
 
 from __future__ import annotations
@@ -41,15 +48,27 @@ setup_platform()
 def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
-             traj_per_epoch: int = 64, algorithm: str = "REINFORCE") -> dict:
+             traj_per_epoch: int = 64, algorithm: str = "REINFORCE",
+             transport: str = "zmq") -> dict:
     from relayrl_tpu.runtime.server import TrainingServer
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
-    addrs = {
-        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
-        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
-        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
-    }
+    if transport == "native":
+        port = free_port()
+        addrs = {"server_type": "native", "bind_addr": f"127.0.0.1:{port}"}
+        worker_addrs = {"server_type": "native",
+                        "server_addr": f"127.0.0.1:{port}"}
+    else:
+        addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        worker_addrs = {
+            "agent_listener_addr": addrs["agent_listener_addr"],
+            "trajectory_addr": addrs["trajectory_addr"],
+            "model_sub_addr": addrs["model_pub_addr"],
+        }
     # IMPALA is the async-fleet north star (BASELINE.md "256 IMPALA
     # actors"): staleness-corrected, so a big fleet on old versions is the
     # intended regime, not an edge case.
@@ -86,10 +105,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             "duration_s": duration_s, "episode_len": episode_len,
             "obs_dim": obs_dim, "scratch": scratch,
             "handshake_timeout_s": 180.0,
-            "result_path": result_path, **{
-                k: addrs[k] for k in ("agent_listener_addr", "trajectory_addr")
-            },
-            "model_sub_addr": addrs["model_pub_addr"],
+            "result_path": result_path, **worker_addrs,
         }
         procs.append(subprocess.Popen(
             [sys.executable,
@@ -121,7 +137,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     latencies = [t - pub_times[v]
                  for a in agents for v, t in a["receipts"] if v in pub_times]
     result = {
-        "bench": "soak_multi_actor_zmq",
+        "bench": f"soak_multi_actor_{transport}",
         "config": {"actors": n_actors, "algorithm": algorithm,
                    "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
@@ -226,38 +242,54 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
     }
 
 
+def _finish(result: dict, outfile: str) -> None:
+    """Shared SLO asserts + optional committed write for a soak result."""
+    print(json.dumps(result))
+    assert result["server_stats"]["dropped"] == 0, "ingest dropped trajectories"
+    assert result["agents_completed"] == result["config"]["actors"]
+    if "--write" in sys.argv:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", outfile)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            f.write(json.dumps(result) + "\n")
+
+
 def main():
     quick = "--quick" in sys.argv
     bench_cwd()
+    transport = "native" if "--native" in sys.argv else "zmq"
+    if transport == "native":
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if not native_available():
+            print("native .so unavailable; build with make -C native",
+                  file=sys.stderr)
+            return
     if "--impala256" in sys.argv:
         # BASELINE.md north-star fleet shape: 256 async actors feeding one
         # IMPALA learner. 16 agents/proc keeps the process count sane on
         # the one-core bench host; spawn+handshake dominate wall time.
         result = run_soak(n_actors=256, agents_per_proc=16,
-                          duration_s=30.0, algorithm="IMPALA")
-        print(json.dumps(result))
-        assert result["server_stats"]["dropped"] == 0
-        assert result["agents_completed"] == 256
-        if "--write" in sys.argv:
-            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "results", "soak256_impala.json")
-            with open(out, "w") as f:
-                f.write(json.dumps(result) + "\n")
+                          duration_s=30.0, algorithm="IMPALA",
+                          transport=transport)
+        suffix = "_native" if transport == "native" else ""
+        _finish(result, f"soak256_impala{suffix}.json")
         return
     result = run_soak(n_actors=16 if quick else 64,
-                      duration_s=8.0 if quick else 30.0)
+                      duration_s=8.0 if quick else 30.0,
+                      transport=transport)
+    if transport == "native":
+        _finish(result, "soak64_native.json")
+        return
     blast = run_ingest_blast(n_traj=500 if quick else 2000)
-    for r in (result, blast):
-        print(json.dumps(r))
-    assert result["server_stats"]["dropped"] == 0, "ingest dropped trajectories"
-    assert result["agents_completed"] == result["config"]["actors"]
+    _finish(result, "soak64.json")
+    print(json.dumps(blast))
     assert blast["server_stats"]["dropped"] == 0 and blast["drained"]
     if "--write" in sys.argv:
         out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "results", "soak64.json")
-        os.makedirs(os.path.dirname(out), exist_ok=True)
-        with open(out, "w") as f:
-            f.write(json.dumps(result) + "\n")
+        with open(out, "a") as f:
             f.write(json.dumps(blast) + "\n")
 
 

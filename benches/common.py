@@ -100,3 +100,35 @@ def time_fn(fn, warmup: int = 3, iters: int = 20) -> dict:
         "mean_s": statistics.fmean(samples),
         "p99_s": ordered[p99_idx],
     }
+
+
+def time_chained(step_fn, state, iters: int, warmup: int = 3,
+                 readback=None) -> float:
+    """Seconds per iteration of a CHAINED jitted step, fenced by one host
+    readback.
+
+    ``step_fn(state) -> (state, observable)``: each call's input depends on
+    the previous output, so the device must execute them sequentially.
+    ``readback(observable) -> float`` (default: first metric leaf) forces
+    completion of the WHOLE chain with a single host transfer —
+    block_until_ready does not fence on tunneled accelerator platforms
+    (verified in the repo-root bench.py, which documents the idiom), and a
+    per-iteration fence would bill every call a tunnel round-trip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _fence(obs):
+        if readback is not None:
+            return readback(obs)
+        return float(jnp.asarray(jax.tree.leaves(obs)[0]))
+
+    obs = None
+    for _ in range(warmup):
+        state, obs = step_fn(state)
+    _fence(obs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, obs = step_fn(state)
+    _fence(obs)
+    return (time.perf_counter() - t0) / iters

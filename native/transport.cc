@@ -267,10 +267,18 @@ class Server {
   bool handle_read(Conn& c) {
     c.last_activity = std::chrono::steady_clock::now();
     char tmp[65536];
-    while (true) {
-      ssize_t r = recv(c.fd, tmp, sizeof(tmp), 0);
+    // Per-wakeup read budget: a sender that outpaces the parse loop must
+    // not pin this loop (starving every other connection and broadcast
+    // processing) nor grow rbuf toward the 1 GiB frame cap on perfectly
+    // valid queued frames. epoll is level-triggered, so leftover socket
+    // data re-fires immediately on the next iteration.
+    size_t budget = 1 << 20;
+    while (budget > 0) {
+      ssize_t r = recv(c.fd, tmp,
+                       std::min(sizeof(tmp), budget), 0);
       if (r > 0) {
         c.rbuf.insert(c.rbuf.end(), tmp, tmp + r);
+        budget -= static_cast<size_t>(r);
         if (c.rbuf.size() > kMaxFrame + kHeader) return false;
       } else if (r == 0) {
         return false;  // peer closed

@@ -1,0 +1,98 @@
+"""Property-based fuzzing of the wire codecs (hypothesis).
+
+The codecs are the trust boundary between actor fleets and the learner
+(SURVEY.md §2.1 — the reference round-trips safetensors/pickle with no
+tests at all); these properties assert lossless round-trips over the full
+dtype × shape space plus arbitrary aux payloads, not just the handful of
+shapes the unit tests pin.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.tensor import decode_tensor, encode_tensor
+from relayrl_tpu.types.trajectory import deserialize_actions, serialize_actions
+
+# The reference's 7 DTypes (action.rs:92-191) as numpy equivalents.
+DTYPES = ["uint8", "int16", "int32", "int64", "float32", "float64", "bool"]
+
+shapes = st.lists(st.integers(0, 7), min_size=0, max_size=3).map(tuple)
+
+
+def _array(draw, dtype, shape):
+    rng = np.random.default_rng(draw(st.integers(0, 2**32 - 1)))
+    if dtype == "bool":
+        return rng.random(shape) < 0.5
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        info = np.iinfo(dtype)
+        return rng.integers(info.min, info.max, shape, dtype=dtype,
+                            endpoint=True)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+@st.composite
+def arrays(draw):
+    return _array(draw, draw(st.sampled_from(DTYPES)), draw(shapes))
+
+
+@settings(max_examples=60, deadline=None)
+@given(arrays())
+def test_tensor_roundtrip_lossless(arr):
+    out = decode_tensor(encode_tensor(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+aux_scalars = st.one_of(
+    st.integers(-2**40, 2**40),
+    st.floats(allow_nan=False, allow_infinity=False, width=32),
+    st.booleans(),
+    st.text(max_size=20),
+)
+
+
+@st.composite
+def records(draw):
+    obs_dim = draw(st.integers(1, 6))
+    data = {f"k{i}": draw(aux_scalars)
+            for i in range(draw(st.integers(0, 3)))}
+    data["logp_a"] = np.float32(draw(st.floats(-30, 0)))
+    return ActionRecord(
+        obs=_array(draw, draw(st.sampled_from(["float32", "float64"])),
+                   (obs_dim,)),
+        act=np.int64(draw(st.integers(0, 17))),
+        mask=None if draw(st.booleans())
+        else np.ones(obs_dim, np.float32),
+        rew=float(draw(st.floats(-1e6, 1e6, allow_nan=False))),
+        data=data,
+        done=draw(st.booleans()),
+        truncated=draw(st.booleans()),
+    )
+
+
+@settings(max_examples=40, deadline=None)
+@given(records())
+def test_action_roundtrip(rec):
+    out = ActionRecord.from_bytes(rec.to_bytes())
+    np.testing.assert_array_equal(out.get_obs(), rec.get_obs())
+    assert int(out.get_act()) == int(rec.get_act())
+    assert out.get_done() == rec.get_done()
+    assert out.truncated == rec.truncated
+    assert abs(out.get_rew() - rec.get_rew()) < 1e-6
+    for k, v in rec.data.items():
+        got = out.data[k]
+        if isinstance(v, (np.floating, float)):
+            assert abs(float(got) - float(v)) < 1e-5
+        else:
+            assert (np.asarray(got) == np.asarray(v)).all()
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.lists(records(), min_size=1, max_size=5))
+def test_trajectory_roundtrip(recs):
+    out = deserialize_actions(serialize_actions(recs))
+    assert len(out) == len(recs)
+    for a, b in zip(out, recs):
+        assert int(a.get_act()) == int(b.get_act())
+        assert a.get_done() == b.get_done()
