@@ -242,17 +242,25 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
     }
 
 
-def _finish(result: dict, outfile: str) -> None:
-    """Shared SLO asserts + optional committed write for a soak result."""
+def _finish(result: dict, outfile: str | None) -> None:
+    """Shared SLO asserts + optional committed write for a soak result.
+    Pass ``outfile=None`` to defer writing (callers with multiple result
+    lines must assert EVERYTHING first, then write — a failed later assert
+    must not leave a truncated committed artifact)."""
     print(json.dumps(result))
     assert result["server_stats"]["dropped"] == 0, "ingest dropped trajectories"
     assert result["agents_completed"] == result["config"]["actors"]
-    if "--write" in sys.argv:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "results", outfile)
-        os.makedirs(os.path.dirname(out), exist_ok=True)
-        with open(out, "w") as f:
-            f.write(json.dumps(result) + "\n")
+    if outfile is not None and "--write" in sys.argv:
+        _write_results(outfile, [result])
+
+
+def _write_results(outfile: str, lines: list[dict]) -> None:
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results", outfile)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
 
 
 def main():
@@ -283,14 +291,11 @@ def main():
         _finish(result, "soak64_native.json")
         return
     blast = run_ingest_blast(n_traj=500 if quick else 2000)
-    _finish(result, "soak64.json")
+    _finish(result, None)
     print(json.dumps(blast))
     assert blast["server_stats"]["dropped"] == 0 and blast["drained"]
     if "--write" in sys.argv:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "results", "soak64.json")
-        with open(out, "a") as f:
-            f.write(json.dumps(blast) + "\n")
+        _write_results("soak64.json", [result, blast])
 
 
 if __name__ == "__main__":

@@ -20,10 +20,14 @@ import argparse
 import json
 import os
 import socket
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if os.environ.get("RELAYRL_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    from relayrl_tpu.utils.hostpin import pin_cpu
+
+    pin_cpu()
 
 
 def free_port() -> int:

@@ -11,12 +11,15 @@ shardings without a pod), and unchanged on real chips:
 from __future__ import annotations
 
 import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if os.environ.get("RELAYRL_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8").strip()
+    # Shared pin: sets XLA_FLAGS for the 8-device host platform BEFORE the
+    # jax import below can latch them, then forces the CPU backend.
+    from relayrl_tpu.utils.hostpin import pin_cpu
+
+    pin_cpu(virtual_devices=8)
 
 import jax
 import jax.numpy as jnp

@@ -14,10 +14,19 @@ structure); pass ``--env ALE/Pong-v5`` on a machine with
 from __future__ import annotations
 
 import argparse
-import os
 
+import os
+import sys
+
+# Importable as a script from anywhere; CPU by default (RELAYRL_TPU=1
+# targets the real chip) via the shared pin (see utils/hostpin.py for why
+# the env var alone is not enough).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if os.environ.get("RELAYRL_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"  # CPU by default; RELAYRL_TPU=1 for the chip
+    from relayrl_tpu.utils.hostpin import pin_cpu
+
+    pin_cpu()
+
 
 
 def main():

@@ -39,7 +39,9 @@ def free_port() -> int:
 
 def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
                episodes: int, max_steps: int, queue):
-    os.environ["JAX_PLATFORMS"] = "cpu"  # actors are CPU hosts
+    from relayrl_tpu.utils.hostpin import pin_cpu
+
+    pin_cpu()  # actors are CPU hosts
     from relayrl_tpu.envs import make
     from relayrl_tpu.runtime.agent import Agent, run_gym_loop
 
@@ -67,7 +69,9 @@ def main():
     args = ap.parse_args()
 
     if os.environ.get("RELAYRL_TPU") != "1":
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        from relayrl_tpu.utils.hostpin import pin_cpu
+
+        pin_cpu()
 
     from relayrl_tpu.runtime.server import TrainingServer
 

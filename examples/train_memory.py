@@ -25,16 +25,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _pin_platform():
-    # Env var AND live config: images whose sitecustomize imports jax at
-    # interpreter startup snapshot JAX_PLATFORMS before this runs.
     if os.environ.get("RELAYRL_TPU") != "1":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+        from relayrl_tpu.utils.hostpin import pin_cpu
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except RuntimeError:
-            pass
+        pin_cpu()
 
 
 def main():

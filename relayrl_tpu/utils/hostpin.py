@@ -1,0 +1,40 @@
+"""Process-level CPU pinning for actor hosts, benches, and examples.
+
+Pinning JAX to CPU via the ``JAX_PLATFORMS`` env var alone is NOT reliable
+on images whose sitecustomize imports jax at interpreter startup (the
+config snapshots the env before user code runs); the live
+``jax.config.update`` is the lever that works, valid until the backend
+initializes. This is the single shared implementation — examples, benches,
+and multi-process workers all call it instead of hand-rolling the block.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu(virtual_devices: int | None = None) -> None:
+    """Force this process onto the CPU JAX backend.
+
+    ``virtual_devices`` additionally requests an N-device host platform
+    (``--xla_force_host_platform_device_count``) for testing sharded code
+    without hardware; it must run before jax creates its backend AND
+    before anything latches XLA_FLAGS, so the env mutation happens ahead
+    of the jax import below.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        # Backend already initialized: the env vars were either respected
+        # (fine) or it's too late to change platform — nothing to do.
+        pass

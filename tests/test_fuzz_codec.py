@@ -55,15 +55,21 @@ aux_scalars = st.one_of(
 @st.composite
 def records(draw):
     obs_dim = draw(st.integers(1, 6))
+    act_dim = draw(st.integers(1, 5))
     data = {f"k{i}": draw(aux_scalars)
             for i in range(draw(st.integers(0, 3)))}
     data["logp_a"] = np.float32(draw(st.floats(-30, 0)))
+    # Optional action mask sized act_dim, random 0/1 pattern (not all-ones
+    # — a constant mask would hide value corruption).
+    mask = None
+    if draw(st.booleans()):
+        rng = np.random.default_rng(draw(st.integers(0, 2**16)))
+        mask = (rng.random(act_dim) < 0.7).astype(np.float32)
     return ActionRecord(
         obs=_array(draw, draw(st.sampled_from(["float32", "float64"])),
                    (obs_dim,)),
         act=np.int64(draw(st.integers(0, 17))),
-        mask=None if draw(st.booleans())
-        else np.ones(obs_dim, np.float32),
+        mask=mask,
         rew=float(draw(st.floats(-1e6, 1e6, allow_nan=False))),
         data=data,
         done=draw(st.booleans()),
@@ -76,6 +82,12 @@ def records(draw):
 def test_action_roundtrip(rec):
     out = ActionRecord.from_bytes(rec.to_bytes())
     np.testing.assert_array_equal(out.get_obs(), rec.get_obs())
+    if rec.mask is None:
+        assert out.get_mask() is None
+    else:
+        got_mask = out.get_mask()
+        assert got_mask.dtype == rec.mask.dtype
+        np.testing.assert_array_equal(got_mask, rec.mask)
     assert int(out.get_act()) == int(rec.get_act())
     assert out.get_done() == rec.get_done()
     assert out.truncated == rec.truncated
