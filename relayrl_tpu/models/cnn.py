@@ -35,6 +35,26 @@ from relayrl_tpu.models.mlp import (
 NATURE_CONV = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
 
 
+def validate_conv_spec(obs_shape, conv_spec) -> None:
+    """Fail fast when a conv stack collapses the feature map to nothing
+    (VALID padding): with the Nature trunk anything under ~36 px dies at
+    the third layer, and the eventual failure is an opaque
+    ZeroDivisionError inside the initializer. Raises with per-layer sizes
+    so the user can shrink the spec or grow the frame."""
+    h, w = int(obs_shape[0]), int(obs_shape[1])
+    sizes = [(h, w)]
+    for feat, kern, stride in conv_spec:
+        h = (h - int(kern)) // int(stride) + 1
+        w = (w - int(kern)) // int(stride) + 1
+        sizes.append((h, w))
+        if h <= 0 or w <= 0:
+            raise ValueError(
+                f"conv_spec {tuple(map(tuple, conv_spec))} collapses a "
+                f"{obs_shape[0]}x{obs_shape[1]} frame to {h}x{w} (layer "
+                f"sizes {sizes}); use a larger frame (Nature trunk needs "
+                f">= 36 px) or a shallower conv_spec")
+
+
 class ConvTrunk(nn.Module):
     obs_shape: Sequence[int]  # (H, W, C)
     conv_spec: Sequence[Sequence[int]] = NATURE_CONV
@@ -105,6 +125,7 @@ def build_cnn_discrete(arch: Mapping[str, Any]) -> Policy:
     obs_shape = tuple(int(d) for d in arch["obs_shape"])
     if len(obs_shape) != 3:
         raise ValueError(f"cnn_discrete needs obs_shape (H, W, C), got {obs_shape}")
+    validate_conv_spec(obs_shape, arch.get("conv_spec", NATURE_CONV))
     obs_dim = int(jnp.prod(jnp.array(obs_shape)))
     arch = dict(arch)
     arch.setdefault("obs_dim", obs_dim)

@@ -101,6 +101,9 @@ def conv_trunk_kwargs(arch: Mapping[str, Any]) -> dict:
     obs_shape = arch.get("obs_shape")
     if obs_shape is None:
         return {}
+    from relayrl_tpu.models.cnn import NATURE_CONV, validate_conv_spec
+
+    validate_conv_spec(obs_shape, arch.get("conv_spec") or NATURE_CONV)
     return {
         "obs_shape": tuple(int(d) for d in obs_shape),
         "conv_spec": tuple(tuple(int(x) for x in row)

@@ -17,6 +17,7 @@ Wire layout (all little-endian):
 from __future__ import annotations
 
 import dataclasses
+import math
 import struct
 
 import numpy as np
@@ -27,6 +28,27 @@ _MAGIC = 0x5254
 _VERSION = 1
 _HEADER = struct.Struct("<HBBB")  # magic, version, dtype, ndim
 _MAX_NDIM = 16
+# Decode is the server ingest hot path (~2 tensors per ActionRecord at
+# fleet rate) — resolve dtype tags through a flat dict instead of the
+# enum constructor + mapping lookup, and count elements with math.prod
+# (np.prod on a small tuple costs a ufunc reduction per tensor). Tags
+# that cannot resolve on this interpreter (bfloat16 without ml_dtypes —
+# dtypes.py degrades gracefully there) are simply absent and fail at
+# decode time like before, not at import time.
+
+
+def _np_by_tag() -> dict:
+    out = {}
+    for tag in DType:
+        try:
+            out[int(tag)] = to_numpy_dtype(tag)
+        except ValueError:
+            continue
+    return out
+
+
+_NP_BY_TAG = _np_by_tag()
+_PREPACKED_DIMS = [struct.Struct(f"<{n}I") for n in range(_MAX_NDIM + 1)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,9 +101,11 @@ def decode_tensor(buf: bytes | memoryview) -> np.ndarray:
     dims_end = _HEADER.size + 4 * ndim
     if len(view) < dims_end:
         raise ValueError("truncated tensor frame: missing dims")
-    shape = struct.unpack_from(f"<{ndim}I", view, _HEADER.size)
-    np_dtype = to_numpy_dtype(DType(tag))
-    expected = int(np.prod(shape, dtype=np.int64)) * np_dtype.itemsize if ndim else np_dtype.itemsize
+    shape = _PREPACKED_DIMS[ndim].unpack_from(view, _HEADER.size)
+    np_dtype = _NP_BY_TAG.get(tag)
+    if np_dtype is None:
+        raise ValueError(f"unsupported wire dtype tag: {tag!r}")
+    expected = math.prod(shape) * np_dtype.itemsize
     payload = view[dims_end:]
     if len(payload) != expected:
         raise ValueError(

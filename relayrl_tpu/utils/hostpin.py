@@ -24,12 +24,17 @@ def pin_cpu(virtual_devices: int | None = None) -> None:
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     if virtual_devices:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags +
-                f" --xla_force_host_platform_device_count={virtual_devices}"
-            ).strip()
+        # Strip any pre-existing count and append ours: trailing flags win,
+        # but relying on that is fragile and a stale smaller count from the
+        # ambient environment must never shrink the requested mesh.
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags.strip() +
+            f" --xla_force_host_platform_device_count={virtual_devices}"
+        ).strip()
     import jax
 
     try:
