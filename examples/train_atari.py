@@ -39,11 +39,14 @@ def main():
     ap.add_argument("--frame-size", type=int, default=84)
     ap.add_argument("--updates", type=int, default=30)
     ap.add_argument("--target", type=float, default=None)
-    ap.add_argument("--lr", type=float, default=1e-3,
-                    help="policy lr (pixel PPO wants ~1e-3; the MLP "
-                         "default 3e-4 is slow at these sample counts)")
+    ap.add_argument("--lr", type=float, default=None,
+                    help="override the learning rate (unset: PPO uses 1e-3 "
+                         "— pixel PPO is slow at the MLP default 3e-4 — "
+                         "and every other algorithm keeps its own default)")
     ap.add_argument("--seed-salt", type=int, default=None,
                     help="pin the pid seed fold-in for reproducible runs")
+    ap.add_argument("--frame-skip", type=int, default=4)
+    ap.add_argument("--frame-stack", type=int, default=4)
     ap.add_argument("--shaped", action="store_true",
                     help="synthetic env only: add potential-based distance "
                          "shaping (dense reward — learnable in tens of "
@@ -53,12 +56,19 @@ def main():
     from relayrl_tpu.envs import make_atari
     from relayrl_tpu.runtime.local_runner import LocalRunner
 
-    env_kwargs = {"shaped": True} if (args.shaped and
-                                      args.env == "synthetic") else {}
-    env = make_atari(args.env, frame_size=args.frame_size, **env_kwargs)
+    if args.shaped and args.env != "synthetic":
+        ap.error("--shaped only applies to the synthetic env")
+    env_kwargs = {"shaped": True} if args.shaped else {}
+    env = make_atari(args.env, frame_size=args.frame_size,
+                     frame_skip=args.frame_skip,
+                     frame_stack=args.frame_stack, **env_kwargs)
     h, w, c = env.obs_shape
-    hp = {"obs_shape": [h, w, c], "traj_per_epoch": 8,
-          "pi_lr": args.lr, "lr": args.lr}
+    hp = {"obs_shape": [h, w, c], "traj_per_epoch": 8}
+    if args.lr is not None:
+        hp["pi_lr"] = args.lr
+        hp["lr"] = args.lr
+    elif args.algo == "PPO":
+        hp["pi_lr"] = 1e-3  # pixel PPO default; see --lr help
     if args.seed_salt is not None:
         hp["seed_salt"] = args.seed_salt
     if args.algo in ("PPO", "IMPALA"):

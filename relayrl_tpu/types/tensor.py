@@ -82,7 +82,7 @@ def encode_tensor(array) -> bytes:
     if arr.ndim > _MAX_NDIM:
         raise ValueError(f"tensor rank {arr.ndim} exceeds wire max {_MAX_NDIM}")
     header = _HEADER.pack(_MAGIC, _VERSION, int(tag), arr.ndim)
-    dims = struct.pack(f"<{arr.ndim}I", *arr.shape)
+    dims = _PREPACKED_DIMS[arr.ndim].pack(*arr.shape)
     return header + dims + arr.tobytes()
 
 
@@ -127,5 +127,5 @@ def spec_of(buf: bytes | memoryview) -> TensorSpec:
         raise ValueError(f"tensor rank {ndim} exceeds wire max {_MAX_NDIM}")
     if len(view) < _HEADER.size + 4 * ndim:
         raise ValueError("truncated tensor frame: missing dims")
-    shape = struct.unpack_from(f"<{ndim}I", view, _HEADER.size)
+    shape = _PREPACKED_DIMS[ndim].unpack_from(view, _HEADER.size)
     return TensorSpec(shape=tuple(shape), dtype=DType(tag))
