@@ -64,6 +64,10 @@ def main():
         if args.target is not None and avg >= args.target:
             print(f"[local] target {args.target} reached", flush=True)
             break
+    # Deterministic probe of the final policy (nothing reaches the learner).
+    eval_result = runner.evaluate(episodes=10)
+    print(f"[local] greedy eval over 10 episodes: "
+          f"avg_return={eval_result['avg_return']:.1f}", flush=True)
 
 
 if __name__ == "__main__":

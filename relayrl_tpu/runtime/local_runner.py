@@ -111,11 +111,25 @@ class LocalRunner:
             "returns": returns,
         }
 
+    def evaluate(self, episodes: int = 10, max_steps: int = 1000) -> dict:
+        """Greedy evaluation between training episodes: probes the CURRENT
+        policy deterministically without recording anything to the
+        trajectory (nothing reaches the learner buffer). Refuses to run
+        mid-episode (run_episode always closes its episode, so calling
+        between episodes is always safe)."""
+        from relayrl_tpu.runtime.agent import greedy_episodes
+
+        returns = greedy_episodes(self.actor, self.env, episodes, max_steps)
+        return {
+            "episodes": episodes,
+            "avg_return": float(np.mean(returns)),
+            "returns": returns,
+        }
+
     def _to_env_action(self, act: np.ndarray):
-        arr = np.asarray(act)
-        if arr.ndim == 0:
-            return int(arr) if np.issubdtype(arr.dtype, np.integer) else float(arr)
-        return arr
+        from relayrl_tpu.runtime.agent import coerce_env_action
+
+        return coerce_env_action(act)
 
 
 def reward_threshold_reached(result: Mapping[str, Any], threshold: float) -> bool:

@@ -241,11 +241,22 @@ class PolicyActor:
                                            self._window)
         self._cache_version = self.version
 
+    def reset_episode(self) -> None:
+        """Reset per-episode serving state (history window + KV cache)
+        WITHOUT touching the trajectory — the episode boundary for eval
+        loops, where nothing must be shipped to the learner
+        (flag_last_action both resets and sends)."""
+        with self._lock:
+            if self._window is not None:
+                self._window[:] = 0.0
+                self._window_len = 0
+            self._cache = None
+
     def deterministic_action(self, obs, mask=None):
         """Greedy action. For sequence policies this ADVANCES the history
         window (greedy eval episodes need context too); call
-        flag_last_action at episode end to reset it, as in the sampling
-        loop."""
+        flag_last_action (sampling loops) or reset_episode (eval loops)
+        at episode end to reset it."""
         obs_arr = np.asarray(obs, np.float32)
         mask_arr = None if mask is None else np.asarray(mask, np.float32)
         with self._lock:

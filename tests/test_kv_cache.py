@@ -147,3 +147,51 @@ def test_step_cached_batched():
                                          c1, obs[b], 0)
         np.testing.assert_allclose(float(aux1["v"]), float(aux["v"][b]),
                                    atol=1e-5)
+
+
+class TestEvalHarness:
+    def test_eval_does_not_ship_trajectories(self):
+        # Greedy eval must neither append to the trajectory nor fire
+        # on_send — the policy is probed, not trained.
+        sent = []
+        policy, params = _policy_params()
+        actor = PolicyActor(ModelBundle(arch=ARCH, params=params, version=1),
+                            seed=0, max_traj_length=100,
+                            on_send=sent.append)
+        for _ in range(5):
+            actor.deterministic_action(np.zeros(6, np.float32))
+        actor.reset_episode()
+        assert sent == []
+        assert len(actor.trajectory.get_actions()) == 0
+        assert actor._window_len == 0 and actor._cache is None
+        # and a subsequent sampling episode works from clean state
+        rec = actor.request_for_action(np.zeros(6, np.float32))
+        assert rec is not None and actor._window_len == 1
+
+    def test_local_runner_evaluate(self, tmp_cwd):
+        from relayrl_tpu.envs import RecallEnv
+        from relayrl_tpu.runtime.local_runner import LocalRunner
+
+        runner = LocalRunner(
+            RecallEnv(horizon=4), "REINFORCE", env_dir=str(tmp_cwd), seed=0,
+            seed_salt=3, with_vf_baseline=True, traj_per_epoch=4,
+            bucket_lengths=(8,),
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        result = runner.evaluate(episodes=3, max_steps=8)
+        assert result["episodes"] == 3
+        assert len(result["returns"]) == 3
+        # eval fed nothing into the learner
+        assert runner.updates == 0
+        assert len(runner.actor.trajectory.get_actions()) == 0
+
+    def test_eval_refuses_mid_episode(self):
+        from relayrl_tpu.runtime.agent import greedy_episodes
+
+        policy, params = _policy_params()
+        actor = PolicyActor(ModelBundle(arch=ARCH, params=params, version=1),
+                            seed=0, max_traj_length=100)
+        actor.request_for_action(np.zeros(6, np.float32))  # episode open
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="mid-episode"):
+            greedy_episodes(actor, None, episodes=1)
