@@ -166,7 +166,7 @@ DEFAULT_CONFIG: dict = {
     "learner": {
         "batch_trajectories": 8,
         "bucket_lengths": [64, 256, 1000],
-        "mesh": {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1},
+        "mesh": {"dp": -1, "fsdp": 1, "ep": 1, "tp": 1, "sp": 1, "pp": 1},
         # compute dtype for policy trunks: float32 on CPU actors/tests;
         # set "bfloat16" on TPU learners to feed the MXU (bench configs do).
         "precision": "float32",
