@@ -1,8 +1,9 @@
 """Smoke tests: bench scripts emit well-formed JSON lines in --quick mode.
 
-Only the cheap benches run here (codec); the socket/learner benches are
-exercised manually and by the driver — this guards the harness contract
-(one JSON object per line with bench/config/value/unit keys).
+Codec, learner, inference, and the --quick fleet soak all run (CPU, a
+couple of minutes total); the full-scale socket benches and the chip
+benches stay manual/driver-run. This guards the harness contract (JSON
+lines with bench/config/value/unit-shaped records and the soak SLOs).
 """
 
 import json
@@ -13,18 +14,50 @@ from pathlib import Path
 BENCH_DIR = Path(__file__).resolve().parent.parent / "benches"
 
 
-def test_bench_codec_quick_emits_json(tmp_path):
+def _run_bench(script: str, cwd, *args, timeout: int = 420):
+    """Run a bench --quick in an isolated cwd (config auto-create writes
+    there) and return its parsed JSON lines."""
     out = subprocess.run(
-        [sys.executable, str(BENCH_DIR / "bench_codec.py"), "--quick"],
-        capture_output=True, text=True, timeout=240,
-        cwd=tmp_path,
+        [sys.executable, str(BENCH_DIR / script), "--quick", *args],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=cwd,
         env={"PYTHONPATH": f"{BENCH_DIR.parent}:{BENCH_DIR}",
-             "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+             "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "HOME": "/tmp"},
     )
     assert out.returncode == 0, out.stderr[-2000:]
-    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    lines = [json.loads(l) for l in out.stdout.splitlines()
+             if l.startswith("{")]
+    assert lines, out.stdout[-500:]
+    return lines
+
+
+def test_bench_codec_quick_emits_json(tmp_path):
+    lines = _run_bench("bench_codec.py", tmp_path, timeout=240)
     assert len(lines) >= 7 * 3 + 2  # dtypes x sizes + trajectory rows
-    for line in lines:
-        rec = json.loads(line)
+    for rec in lines:
         assert set(rec) == {"bench", "config", "value", "unit"}
         assert rec["value"] > 0
+
+
+def test_bench_learner_quick_emits_json(tmp_path):
+    lines = _run_bench("bench_learner.py", tmp_path)
+    algos = {r["config"]["algorithm"] for r in lines}
+    assert {"REINFORCE", "IMPALA", "DQN", "SAC"} <= algos
+    assert all(r["value"] > 0 for r in lines)
+
+
+def test_bench_inference_quick_emits_json(tmp_path):
+    lines = _run_bench("bench_inference.py", tmp_path)
+    assert any(r["bench"] == "agent_inference" for r in lines)
+    assert any(r["bench"] == "seq_serving_per_step" for r in lines)
+
+
+def test_bench_soak_quick_slos(tmp_path):
+    # The full fleet loop in --quick shape: SLOs (0 dropped, all agents
+    # complete, drained blast) are asserted inside the script itself.
+    lines = _run_bench("bench_soak.py", tmp_path, timeout=600)
+    soak = next(r for r in lines if r["bench"].startswith("soak_multi"))
+    assert soak["server_stats"]["dropped"] == 0
+    blast = next(r for r in lines if r["bench"] == "ingest_blast_zmq")
+    assert blast["drained"]
