@@ -75,6 +75,14 @@ def _chip_peak_flops(device_kind: str) -> float | None:
             return peak
     return None
 
+def best_of(trials: int, timed_once) -> float:
+    """Max rate over ``trials`` runs of ``timed_once() -> rate``. Host and
+    tunnel noise only ever slow a trial down (measured ~25% spread between
+    identical runs), so the fastest trial is the truest capability — used
+    SYMMETRICALLY for the jax and torch sides."""
+    return max(timed_once() for _ in range(trials))
+
+
 # Bench shape: 64 trajectories × 256 steps (the north-star configs feed a
 # v4-8 learner from 64 actors; one epoch batch per update).
 B, T, OBS, ACT = 64, 256, 128, 18
@@ -177,12 +185,15 @@ def bench_jax(warmup: int = WARMUP, iters: int = ITERS,
     # dispatching ~7 TFLOP of chained matmuls (identical to no-fence
     # dispatch time), i.e. it does NOT fence there; a host readback of a
     # value depending on the whole donated-state chain cannot return early.
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = update(state, batch)
-    float(metrics["LossPi"])  # forces all ITERS sequential updates
-    dt = time.perf_counter() - t0
-    ups = iters / dt
+    def one_trial():
+        nonlocal state, metrics
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = update(state, batch)
+        float(metrics["LossPi"])  # forces all ITERS sequential updates
+        return iters / (time.perf_counter() - t0)
+
+    ups = best_of(3, one_trial)
 
     mfu = None
     peak = _chip_peak_flops(jax.devices()[0].device_kind)
@@ -233,10 +244,14 @@ def bench_torch_reference() -> float:
 
     epoch()  # warmup
     iters = 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        epoch()
-    return iters / (time.perf_counter() - t0)
+
+    def one_trial():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            epoch()
+        return iters / (time.perf_counter() - t0)
+
+    return best_of(3, one_trial)
 
 
 def main():
