@@ -5,10 +5,12 @@ No counterpart exists in the reference — its only models are 2x128 MLPs
 and SURVEY.md §5.7 records long-context support as absent. This family is
 the TPU-first addition: a causal transformer over the trajectory time axis,
 so the policy conditions on history instead of a single observation, with
-three attention backends selected by arch config:
+four attention backends selected by arch config:
 
 * ``"dense"``     — plain softmax attention (small T, correctness anchor)
 * ``"blockwise"`` — online-softmax scan over KV blocks (long T, one device)
+* ``"flash"``     — fused Pallas TPU kernels (ops/flash.py; resolves to
+                    blockwise off-TPU)
 * ``"ring"``      — ring attention over the mesh ``sp`` axis
                     (:mod:`relayrl_tpu.parallel.ring`); requires an ambient
                     mesh (``parallel.context.use_mesh``) at trace time and
