@@ -232,3 +232,41 @@ def test_server_restart(tmp_cwd):
             agent.disable_agent()
     finally:
         server.disable_server()
+
+
+@pytest.mark.parametrize("algo,hp", [
+    ("DQN", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
+             "hidden_sizes": [16]}),
+    ("IMPALA", {"traj_per_epoch": 2, "hidden_sizes": [16]}),
+])
+def test_offpolicy_and_async_families_over_sockets(tmp_cwd, algo, hp):
+    """The DQN (replay/warmup/target-net) and IMPALA (staleness-corrected)
+    server paths over real zmq sockets — the on-policy loop above exercises
+    only the epoch-buffer family."""
+    server_addrs = _zmq_addrs()
+    agent_addrs = _agent_addrs(server_addrs)
+    server = TrainingServer(
+        algo, obs_dim=4, act_dim=2, server_type="zmq",
+        env_dir=str(tmp_cwd), hyperparams=hp, **server_addrs)
+    try:
+        agent = Agent(server_type="zmq", handshake_timeout_s=20,
+                      seed=0, **agent_addrs)
+        try:
+            env = _RandomEnv()
+            deadline = time.monotonic() + 60
+            while (server.stats["updates"] < 1
+                   and time.monotonic() < deadline):
+                run_gym_loop(agent, env, episodes=2, max_steps=10)
+                time.sleep(0.02)
+            assert server.stats["updates"] >= 1, (
+                f"{algo} learner never updated; stats={server.stats}")
+            assert server.stats["dropped"] == 0
+
+            deadline = time.monotonic() + 30
+            while agent.model_version < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert agent.model_version >= 1, f"{algo} hot-swap never happened"
+        finally:
+            agent.disable_agent()
+    finally:
+        server.disable_server()
