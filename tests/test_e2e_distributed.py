@@ -238,11 +238,15 @@ def test_server_restart(tmp_cwd):
     ("DQN", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
              "hidden_sizes": [16]}),
     ("IMPALA", {"traj_per_epoch": 2, "hidden_sizes": [16]}),
+    # Continuous actions over the wire: the squashed-Gaussian actor emits
+    # float vectors instead of scalar ints (a different codec/actor path).
+    ("SAC", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
+             "hidden_sizes": [16], "discrete": False, "act_limit": 1.0}),
 ])
 def test_offpolicy_and_async_families_over_sockets(tmp_cwd, algo, hp):
-    """The DQN (replay/warmup/target-net) and IMPALA (staleness-corrected)
-    server paths over real zmq sockets — the on-policy loop above exercises
-    only the epoch-buffer family."""
+    """The DQN (replay/warmup/target-net), IMPALA (staleness-corrected),
+    and SAC (continuous-action) server paths over real zmq sockets — the
+    on-policy loop above exercises only the discrete epoch-buffer family."""
     server_addrs = _zmq_addrs()
     agent_addrs = _agent_addrs(server_addrs)
     server = TrainingServer(
