@@ -238,15 +238,24 @@ def test_server_restart(tmp_cwd):
     ("DQN", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
              "hidden_sizes": [16]}),
     ("IMPALA", {"traj_per_epoch": 2, "hidden_sizes": [16]}),
-    # Continuous actions over the wire: the squashed-Gaussian actor emits
-    # float vectors instead of scalar ints (a different codec/actor path).
+    ("C51", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
+             "hidden_sizes": [16], "n_atoms": 11}),
+    # Continuous actions over the wire: deterministic (DDPG/TD3) and
+    # squashed-Gaussian (SAC) actors emit float vectors instead of scalar
+    # ints (a different codec/actor path).
     ("SAC", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
+             "hidden_sizes": [16], "discrete": False, "act_limit": 1.0}),
+    ("DDPG", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
+              "hidden_sizes": [16], "discrete": False, "act_limit": 1.0}),
+    ("TD3", {"update_after": 8, "batch_size": 8, "updates_per_step": 0.25,
              "hidden_sizes": [16], "discrete": False, "act_limit": 1.0}),
 ])
 def test_offpolicy_and_async_families_over_sockets(tmp_cwd, algo, hp):
-    """The DQN (replay/warmup/target-net), IMPALA (staleness-corrected),
-    and SAC (continuous-action) server paths over real zmq sockets — the
-    on-policy loop above exercises only the discrete epoch-buffer family."""
+    """Every non-on-policy algorithm in the registry runs the full
+    distributed loop over real zmq sockets (REINFORCE/PPO are covered by
+    the tests above): replay/warmup/target-net (DQN), distributional
+    (C51), staleness-corrected async (IMPALA), and the three continuous
+    actors (SAC/DDPG/TD3 — float action vectors on the wire)."""
     server_addrs = _zmq_addrs()
     agent_addrs = _agent_addrs(server_addrs)
     server = TrainingServer(
