@@ -108,3 +108,56 @@ def test_trajectory_roundtrip(recs):
     for a, b in zip(out, recs):
         assert int(a.get_act()) == int(b.get_act())
         assert a.get_done() == b.get_done()
+
+
+param_leaves = st.one_of(
+    st.tuples(st.sampled_from(["float32", "bfloat16"]),
+              st.lists(st.integers(1, 5), min_size=1, max_size=3)),
+)
+
+
+@st.composite
+def param_trees(draw):
+    """Nested flax-style param dicts with random leaf shapes/dtypes."""
+    import numpy as _np
+
+    def leaf():
+        dtype, shape = draw(param_leaves)
+        rng = _np.random.default_rng(draw(st.integers(0, 2**16)))
+        arr = rng.standard_normal(tuple(shape)).astype("float32")
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            arr = arr.astype(ml_dtypes.bfloat16)
+        return arr
+
+    n_modules = draw(st.integers(1, 3))
+    return {"params": {
+        f"layer_{i}": {"kernel": leaf(), "bias": leaf()}
+        for i in range(n_modules)
+    }}
+
+
+@settings(max_examples=25, deadline=None)
+@given(param_trees(), st.integers(0, 2**31 - 1))
+def test_model_bundle_roundtrip(params, version):
+    """The model-distribution codec (the hot-swap currency) must be
+    lossless over arbitrary param trees, dtypes incl. bfloat16, and
+    versions — the other wire trust boundary next to the action codec."""
+    import jax
+    import numpy as np
+
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    arch = {"kind": "mlp_discrete", "obs_dim": 3, "act_dim": 2}
+    bundle = ModelBundle(arch=arch, params=params, version=version)
+    out = ModelBundle.from_bytes(bundle.to_bytes())
+    assert out.version == version
+    assert out.arch == arch
+    flat_a = jax.tree.leaves(params)
+    flat_b = jax.tree.leaves(out.params)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(np.asarray(a, dtype=np.float32),
+                                      np.asarray(b, dtype=np.float32))
