@@ -195,3 +195,29 @@ class TestEvalHarness:
 
         with _pytest.raises(RuntimeError, match="mid-episode"):
             greedy_episodes(actor, None, episodes=1)
+
+
+def test_rapid_swap_churn_keeps_cached_parity():
+    """Many hot-swaps interleaved with cached steps (the fleet steady
+    state: a fresh bundle every few env steps) must keep the cached path
+    bit-matched with the window path throughout."""
+    policy, params0 = _policy_params()
+    bundles = [ModelBundle(arch=ARCH,
+                           params=_policy_params(seed=s)[1], version=s)
+               for s in range(2, 7)]
+    cached = _actor(seed=13)
+    control = _actor(seed=13, use_kv_cache=False)
+    rng = np.random.default_rng(6)
+    swap_iter = iter(bundles)
+    for t in range(10):
+        obs = rng.standard_normal(6).astype(np.float32)
+        r1 = cached.request_for_action(obs)
+        r2 = control.request_for_action(obs)
+        assert int(np.asarray(r1.act)) == int(np.asarray(r2.act)), t
+        np.testing.assert_allclose(np.asarray(r1.data["v"]),
+                                   np.asarray(r2.data["v"]), atol=1e-4)
+        if t % 2 == 1:  # swap every other step, mid-episode
+            b = next(swap_iter)
+            assert cached.maybe_swap(b) and control.maybe_swap(b)
+    cached.flag_last_action(reward=0.0)
+    control.flag_last_action(reward=0.0)
