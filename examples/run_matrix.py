@@ -10,8 +10,9 @@ leaves each cell's EpochLogger progress.txt behind as the artifact.
 
     python examples/run_matrix.py --updates 3 --out matrix_artifacts
 
-Cells: {REINFORCE (with + without baseline), PPO} x {zmq, grpc} on
-CartPole-v1 (gymnasium when installed, built-in dynamics otherwise).
+Cells: {REINFORCE (with + without baseline), PPO, IMPALA} across
+{zmq, grpc, native} on CartPole-v1 (gymnasium when installed, built-in
+dynamics otherwise).
 """
 
 from __future__ import annotations
@@ -44,6 +45,8 @@ CELLS = [
     ("REINFORCE", {"with_vf_baseline": True}, "native"),
     ("PPO", {}, "zmq"),
     ("PPO", {}, "grpc"),
+    # The async staleness-corrected family over the default transport.
+    ("IMPALA", {}, "zmq"),
 ]
 
 
