@@ -84,7 +84,19 @@ class ConfigLoader:
         self.algorithm_name = algorithm_name
         if self.config_path is not None and Path(self.config_path).is_file():
             with open(self.config_path, "r") as f:
-                self._raw = json.load(f)
+                loaded = json.load(f)
+                # A non-object root (null / list / scalar — valid JSON,
+                # malformed config) must degrade to defaults like every
+                # other malformed section, not crash the first getter.
+                if isinstance(loaded, dict):
+                    self._raw = loaded
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        f"config root is {type(loaded).__name__}, not an "
+                        "object; using built-in defaults")
+                    self._raw = default_config()
         else:
             self._raw = default_config()
         if algorithm_name is not None and algorithm_name.upper() not in SUPPORTED_ALGORITHMS:
@@ -99,11 +111,19 @@ class ConfigLoader:
             )
 
     # -- getters (ref: config_loader.rs:344-555) --
+    def _section(self, key: str) -> Mapping:
+        """A top-level config section, or {} when absent OR malformed
+        (null / list / scalar): every getter must degrade to defaults, not
+        crash the server on a hand-edited file (the reference's getters
+        all fall back — config_loader.rs:344-381)."""
+        value = self._raw.get(key)
+        return value if isinstance(value, Mapping) else {}
+
     def get_algorithm_params(self, algorithm_name: str | None = None) -> dict[str, Any]:
         name = algorithm_name or self.algorithm_name
         if name is None:
             return {}
-        algos = self._raw.get("algorithms", {})
+        algos = self._section("algorithms")
         # case-insensitive lookup, defaults merged under user overrides
         defaults = DEFAULT_CONFIG["algorithms"]
         base = {}
@@ -111,14 +131,13 @@ class ConfigLoader:
             if k.upper() == name.upper():
                 base = copy.deepcopy(v)  # nested lists must not alias defaults
         for k, v in algos.items():
-            if k.upper() == name.upper():
+            if str(k).upper() == name.upper() and isinstance(v, Mapping):
                 base.update(v)
         return base
 
     def _endpoint(self, key: str) -> Endpoint:
         fallback = _FALLBACK_ENDPOINTS[key]
-        server = self._raw.get("server", {})
-        entry = server.get(key)
+        entry = self._section("server").get(key)
         if not isinstance(entry, Mapping):
             return fallback
         return Endpoint.from_dict(entry, fallback)
@@ -134,32 +153,40 @@ class ConfigLoader:
 
     def get_tb_params(self) -> dict[str, Any]:
         params = dict(DEFAULT_CONFIG["training_tensorboard"])
-        params.update(self._raw.get("training_tensorboard", {}))
+        params.update(self._section("training_tensorboard"))
         params.pop("_comment1", None)
         params.pop("_comment2", None)
         return params
 
     def get_client_model_path(self) -> str:
         return str(
-            self._raw.get("model_paths", {}).get("client_model", "client_model.rlx")
+            self._section("model_paths").get("client_model", "client_model.rlx")
         )
 
     def get_server_model_path(self) -> str:
         return str(
-            self._raw.get("model_paths", {}).get("server_model", "server_model.rlx")
+            self._section("model_paths").get("server_model", "server_model.rlx")
         )
 
     def get_max_traj_length(self) -> int:
-        return int(self._raw.get("max_traj_length", 1000))
+        try:
+            value = int(self._raw.get("max_traj_length", 1000))
+        except (TypeError, ValueError):
+            return 1000
+        return value if value >= 1 else 1000
 
     def get_grpc_idle_timeout_s(self) -> float:
         raw = self._raw.get("grpc_idle_timeout_s", self._raw.get("grpc_idle_timeout", 30.0))
-        return float(raw)
+        try:
+            value = float(raw)
+        except (TypeError, ValueError):
+            return 30.0
+        return value if value > 0 else 30.0
 
     def get_learner_params(self) -> dict[str, Any]:
         params = {k: (dict(v) if isinstance(v, dict) else v)
                   for k, v in DEFAULT_CONFIG["learner"].items()}
-        params.update(self._raw.get("learner", {}))
+        params.update(self._section("learner"))
         return params
 
     def raw(self) -> dict:

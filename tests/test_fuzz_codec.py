@@ -161,3 +161,53 @@ def test_model_bundle_roundtrip(params, version):
         assert np.asarray(a).dtype == np.asarray(b).dtype
         np.testing.assert_array_equal(np.asarray(a, dtype=np.float32),
                                       np.asarray(b, dtype=np.float32))
+
+
+json_scalars = st.one_of(st.none(), st.booleans(), st.integers(-10, 10),
+                         st.floats(-1e3, 1e3, allow_nan=False),
+                         st.text(max_size=8))
+json_values = st.recursive(
+    json_scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=3),
+        st.dictionaries(st.text(max_size=6), children, max_size=3)),
+    max_leaves=12)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.one_of(
+    # section-level junk under the keys the loader actually reads
+    st.dictionaries(
+        st.sampled_from(["algorithms", "server", "training_tensorboard",
+                         "model_paths", "learner", "distributed",
+                         "max_traj_length", "grpc_idle_timeout_s", "junk"]),
+        json_values, max_size=6),
+    # root-level junk: valid JSON that is not an object at all
+    json_values))
+def test_config_loader_survives_arbitrary_config(cfg):
+    """Every getter must return a usable value (reference semantics: each
+    getter falls back to hardcoded defaults — config_loader.rs:344-381 —
+    rather than crashing the server on a malformed file), for ANY
+    JSON-shaped config content."""
+    import json as _json
+    import tempfile
+    import warnings as _warnings
+
+    from relayrl_tpu.config import ConfigLoader
+
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/relayrl_config.json"
+        with open(path, "w") as f:
+            _json.dump(cfg, f)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")  # root/section fallback warns
+            loader = ConfigLoader("REINFORCE", path)
+        assert isinstance(loader.get_algorithm_params(), dict)
+        assert isinstance(loader.get_learner_params(), dict)
+        for ep in (loader.get_train_server(), loader.get_traj_server(),
+                   loader.get_agent_listener()):
+            assert isinstance(ep.address, str) and ":" in ep.address
+        assert loader.get_max_traj_length() >= 1
+        assert loader.get_grpc_idle_timeout_s() > 0
+        assert isinstance(loader.get_client_model_path(), str)
+        assert isinstance(loader.get_tb_params(), dict)
