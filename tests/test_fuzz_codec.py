@@ -211,3 +211,39 @@ def test_config_loader_survives_arbitrary_config(cfg):
         assert loader.get_grpc_idle_timeout_s() > 0
         assert isinstance(loader.get_client_model_path(), str)
         assert isinstance(loader.get_tb_params(), dict)
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.text(min_size=0, max_size=40), st.binary(min_size=0, max_size=500))
+def test_envelope_roundtrip_any_identity(identity, payload):
+    """The transport envelope must carry any agent identity (unicode,
+    empty, long) and any payload bytes losslessly."""
+    from relayrl_tpu.transport.base import (
+        pack_trajectory_envelope,
+        unpack_trajectory_envelope,
+    )
+
+    aid, out = unpack_trajectory_envelope(
+        pack_trajectory_envelope(identity, payload))
+    assert aid == identity
+    assert out == payload
+
+
+@settings(max_examples=25, deadline=None)
+@given(records(), st.booleans(), st.booleans())
+def test_marker_record_roundtrip(rec, truncated, with_final_obs):
+    """flag_last_action markers (obs=None, act=None, done=True) — and
+    truncation markers carrying a final_obs for bootstrap — are real wire
+    traffic and must round-trip exactly."""
+    marker = ActionRecord(
+        obs=rec.obs if with_final_obs else None,
+        act=None, mask=None, rew=rec.rew, data=None,
+        done=True, truncated=truncated)
+    out = ActionRecord.from_bytes(marker.to_bytes())
+    assert out.get_act() is None
+    assert out.get_done() is True
+    assert out.truncated == truncated
+    if with_final_obs:
+        np.testing.assert_array_equal(out.get_obs(), marker.get_obs())
+    else:
+        assert out.get_obs() is None
