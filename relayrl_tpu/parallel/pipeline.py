@@ -75,7 +75,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
             f"layer stack of {leaves[0].shape[0]} layers is not divisible "
             f"by the pp mesh axis ({n_stages} stages); pick n_layers as a "
             f"multiple of pp (offending leaf shapes: {bad[:3]})")
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     daxes = data_axes(mesh)
     bspec = daxes if daxes else None
@@ -117,5 +117,5 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
         per_device, mesh=mesh,
         in_specs=(param_specs, x_spec),
         out_specs=x_spec,
-        check_rep=False,
+        check_vma=False,
     )(stage_params, x)

@@ -96,10 +96,15 @@ class PolicyActor:
                 and self._window is not None):
             # prefill is required, not optional: cache rebuild (hot-swap,
             # greedy-path interleave) calls it with t > 0.
-            self._cached_fn = jax.jit(self.policy.step_cached,
-                                      donate_argnums=(2,))
-            self._prefill_fn = jax.jit(self.policy.prefill_cache,
-                                       donate_argnums=(1,))
+            # Donation is honored on TPU/GPU; CPU actor hosts would emit a
+            # "donated buffers were not usable" warning on every step.
+            donate = jax.default_backend() != "cpu"
+            self._cached_fn = jax.jit(
+                self.policy.step_cached,
+                donate_argnums=(2,) if donate else ())
+            self._prefill_fn = jax.jit(
+                self.policy.prefill_cache,
+                donate_argnums=(1,) if donate else ())
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)

@@ -17,6 +17,7 @@ from relayrl_tpu.transport.base import (
     ServerTransport,
     unpack_trajectory_envelope,
 )
+from relayrl_tpu.transport.probe import parse_host_port as _parse_host_port
 
 _EV_TRAJECTORY = 1
 _EV_REGISTER = 2
@@ -71,12 +72,6 @@ def _load(lib_path: str) -> ctypes.CDLL:
 
 def _buf(data: bytes):
     return (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else None
-
-
-def _parse_host_port(addr: str) -> tuple[str, int]:
-    addr = addr.split("//")[-1]
-    host, _, port = addr.rpartition(":")
-    return host or "127.0.0.1", int(port)
 
 
 class NativeServerTransportImpl(ServerTransport):
