@@ -130,13 +130,24 @@ class OffPolicyAlgorithm(AlgorithmBase):
         return ("LossQ",)
 
     # -- reference contract --
-    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
-        if not actions or all(a.act is None for a in actions):
+    def receive_trajectory(self, actions) -> bool:
+        """Accepts ``Sequence[ActionRecord]`` (Python decode) or a
+        :class:`~relayrl_tpu.types.columnar.DecodedTrajectory` (native
+        columnar decode — marker rewards already folded, so the reward
+        totals agree across paths)."""
+        from relayrl_tpu.types.columnar import DecodedTrajectory
+
+        if isinstance(actions, DecodedTrajectory):
+            if actions.n_steps == 0:
+                return False
+            rew_total = actions.total_reward
+        elif not actions or all(a.act is None for a in actions):
             # Empty or marker-only (a capacity flush can strand the
             # terminal marker in its own send) — no steps to store, and
             # logging it would record a phantom zero-length episode.
             return False
-        rew_total = float(sum(a.rew for a in actions))
+        else:
+            rew_total = float(sum(a.rew for a in actions))
         stored = self.buffer.add_episode(actions)
         self._ep_returns.append(rew_total)
         self._ep_lengths.append(stored)

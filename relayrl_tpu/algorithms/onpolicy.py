@@ -95,8 +95,16 @@ class OnPolicyAlgorithm(AlgorithmBase):
         return ("LossPi",)
 
     # -- reference contract --
-    def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:
-        if not actions or all(a.act is None for a in actions):
+    def receive_trajectory(self, actions) -> bool:
+        """Accepts ``Sequence[ActionRecord]`` (Python decode) or a
+        :class:`~relayrl_tpu.types.columnar.DecodedTrajectory` (native
+        columnar decode — markers pre-folded)."""
+        from relayrl_tpu.types.columnar import DecodedTrajectory
+
+        if isinstance(actions, DecodedTrajectory):
+            if actions.n_steps == 0:
+                return False
+        elif not actions or all(a.act is None for a in actions):
             # Marker-only trajectories (stranded by a capacity flush)
             # carry no steps; padding would raise on the empty fold.
             return False

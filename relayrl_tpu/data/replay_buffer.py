@@ -16,12 +16,14 @@ from typing import Sequence
 from relayrl_tpu.data.batching import (
     PaddedTrajectory,
     TrajectoryBatch,
+    pad_decoded,
     pad_trajectory,
     pick_bucket,
     repad_trajectory,
     stack_trajectories,
 )
 from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.columnar import DecodedTrajectory
 
 DEFAULT_BUCKETS = (64, 256, 1000)
 
@@ -63,12 +65,23 @@ class EpochBuffer:
     def ready(self) -> bool:
         return len(self._pending) >= self.traj_per_epoch
 
-    def add_episode(self, actions: Sequence[ActionRecord]) -> bool:
-        """Pad + buffer one episode; True when a batch is ready to drain."""
+    def add_episode(
+        self, actions: Sequence[ActionRecord] | DecodedTrajectory
+    ) -> bool:
+        """Pad + buffer one episode; True when a batch is ready to drain.
+
+        Accepts either the ActionRecord list (Python decode path) or a
+        :class:`DecodedTrajectory` from the native columnar decoder —
+        ``len()`` of both is the raw record count, so bucketing is
+        identical across paths."""
         bucket = pick_bucket(len(actions), self.buckets)
-        padded = pad_trajectory(
-            actions, bucket, self.obs_dim, self.act_dim, self.discrete
-        )
+        if isinstance(actions, DecodedTrajectory):
+            padded = pad_decoded(
+                actions, bucket, self.obs_dim, self.act_dim, self.discrete)
+        else:
+            padded = pad_trajectory(
+                actions, bucket, self.obs_dim, self.act_dim, self.discrete
+            )
         self._pending.append(padded)
         self.episode_returns.append(float(padded.rew.sum()))
         self.episode_lengths.append(padded.length)

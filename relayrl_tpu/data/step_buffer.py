@@ -68,9 +68,79 @@ class StepReplayBuffer:
         self.size = min(self.size + 1, self.capacity)
         self.total_steps += 1
 
+    def _put_many(self, obs, act, rew, obs2, done, mask2) -> int:
+        """Vectorized ring insert (columnar fast path)."""
+        k = len(rew)
+        if k == 0:
+            return 0
+        idx = (self.ptr + np.arange(k)) % self.capacity
+        self.obs[idx] = obs
+        self.obs2[idx] = obs2
+        if self.discrete:
+            self.act[idx] = act.reshape(k, -1)[:, 0]
+        else:
+            self.act[idx] = act.reshape(k, -1)[:, : self.act_dim]
+        self.mask2[idx] = mask2
+        self.rew[idx] = rew
+        self.done[idx] = done
+        self.ptr = int((self.ptr + k) % self.capacity)
+        self.size = int(min(self.size + k, self.capacity))
+        self.total_steps += k
+        return k
+
+    def add_decoded(self, dt) -> int:
+        """Columnar fast path of :meth:`add_episode` for a
+        :class:`relayrl_tpu.types.columnar.DecodedTrajectory` (markers
+        already folded by the native decoder). Same transition semantics
+        as the ActionRecord loop below; parity enforced by
+        tests/test_native_codec.py."""
+        cols = dt.columns
+        T = dt.n_steps
+        if T == 0 or "o" not in cols or "a" not in cols:
+            return 0
+        obs = cols["o"].reshape(T, -1)[:, : self.obs_dim].astype(
+            np.float32, copy=False)
+        act = cols["a"]
+        rew = cols["r"].astype(np.float32, copy=False)
+        done_last = bool(cols["t"][T - 1])
+        trunc_last = dt.marker_truncated or bool(cols["x"][T - 1])
+
+        obs2 = np.zeros((T, self.obs_dim), np.float32)
+        if T > 1:
+            obs2[: T - 1] = obs[1:]
+        mask2 = np.ones((T, self.act_dim), np.float32)
+        if "m" in cols:
+            m = cols["m"].reshape(T, -1)[:, : self.act_dim].astype(
+                np.float32, copy=False)
+            if T > 1:
+                mask2[: T - 1] = m[1:]
+        done = np.zeros((T,), np.float32)
+
+        n = T
+        if trunc_last or not done_last:
+            # Time-limit ending: bootstrap through the boundary (done=0)
+            # using the marker's successor obs — or drop the last
+            # transition when no successor was shipped.
+            if dt.final_obs is None:
+                n = T - 1
+            else:
+                obs2[T - 1] = np.asarray(dt.final_obs,
+                                         np.float32).reshape(-1)[: self.obs_dim]
+                if dt.final_mask is not None:
+                    mask2[T - 1] = np.asarray(
+                        dt.final_mask, np.float32).reshape(-1)[: self.act_dim]
+        else:
+            done[T - 1] = 1.0
+        return self._put_many(obs[:n], act[:n], rew[:n], obs2[:n], done[:n],
+                              mask2[:n])
+
     def add_episode(self, actions: Sequence[ActionRecord]) -> int:
         """Unroll one trajectory into transitions; returns how many stored."""
         from relayrl_tpu.data.batching import fold_trailing_markers
+        from relayrl_tpu.types.columnar import DecodedTrajectory
+
+        if isinstance(actions, DecodedTrajectory):
+            return self.add_decoded(actions)
 
         # A truncation marker may carry the post-step observation — the
         # bootstrap successor for the final transition — and its action

@@ -7,9 +7,14 @@ Capability parity with the reference's server stack
 the central re-design from SURVEY.md §7.4 item 1: the reference funnels every
 trajectory through a lock-step JSON-over-stdin subprocess
 (python_algorithm_request.rs:199-267); here the learner is **in-process** —
-ingest happens on transport threads into a queue, a single learner thread
-drains it into the jitted XLA update, and model publication overlaps the next
-ingest. No subprocess, no stdio bottleneck, no 50 ms polls.
+ingest happens on transport threads into a queue, a staging thread decodes
+(natively, off-GIL, via native/codec.cc when the library is built — the
+reference keeps its decode native too, training_zmq.rs:994-1011), and a
+single learner thread drains ready batches into the jitted XLA update while
+the next trajectories decode in parallel. The native transport goes one
+step further and delivers pre-decoded columnar batches straight to the
+decoded queue (rl_server_poll_batch). No subprocess, no stdio bottleneck,
+no 50 ms polls, no per-step Python on the ingest path.
 
 Ctor parity with the PyO3 surface (src/bindings/python/network/server/
 o3_training_server.rs:78-151): ``TrainingServer(algorithm_name, obs_dim,
@@ -29,6 +34,7 @@ from typing import Any, Mapping
 from relayrl_tpu.algorithms import build_algorithm, registered_algorithms
 from relayrl_tpu.config import ConfigLoader
 from relayrl_tpu.transport import make_server_transport
+from relayrl_tpu.types.columnar import DecodedTrajectory
 from relayrl_tpu.types.trajectory import deserialize_actions
 
 
@@ -111,7 +117,11 @@ class TrainingServer:
         self.agent_ids: list[str] = []
         self._registry_lock = threading.Lock()
 
+        # Raw payloads from transport threads; a staging thread decodes
+        # them (native codec when built) into _decoded, which the learner
+        # thread drains — decode overlaps the device step.
         self._ingest: queue.Queue[tuple[str, bytes]] = queue.Queue(maxsize=100_000)
+        self._decoded: queue.Queue = queue.Queue(maxsize=100_000)
         self._bundle_lock = threading.Lock()
         self._bundle_bytes: bytes = self.algorithm.bundle().to_bytes()
         self._bundle_version: int = self.algorithm.version
@@ -119,13 +129,22 @@ class TrainingServer:
         self.transport = make_server_transport(server_type, self.config,
                                                **addr_overrides)
         self.transport.on_trajectory = self._on_trajectory
+        self.transport.on_trajectory_decoded = self._on_trajectory_decoded
         self.transport.get_model = self._get_model
         self.transport.on_register = self._on_register
 
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
+        self._staging_thread: threading.Thread | None = None
         self.active = False
         self.stats = {"trajectories": 0, "updates": 0, "dropped": 0}
+        # Per-thread time ledger (seconds): where the ingest pipeline
+        # actually spends its time — the profile evidence that the learner
+        # thread waits on the device, not on msgpack (SURVEY §7.4-1).
+        #   decode_s      staging thread inside decode
+        #   learn_s       learner thread inside receive_trajectory/update
+        #   learner_idle_s learner thread blocked on an empty queue
+        self.timings = {"decode_s": 0.0, "learn_s": 0.0, "learner_idle_s": 0.0}
 
         self._tb = None
         if tensorboard:
@@ -144,6 +163,14 @@ class TrainingServer:
         except queue.Full:
             self.stats["dropped"] += 1
 
+    def _on_trajectory_decoded(self, batch) -> None:
+        """Pre-decoded columnar trajectory batch from the native drain —
+        skips the staging thread entirely (one queue entry per drain)."""
+        try:
+            self._decoded.put_nowait(batch)
+        except queue.Full:
+            self.stats["dropped"] += len(batch)
+
     def _get_model(self) -> tuple[int, bytes]:
         with self._bundle_lock:
             return self._bundle_version, self._bundle_bytes
@@ -153,27 +180,85 @@ class TrainingServer:
             if agent_id not in self.agent_ids:
                 self.agent_ids.append(agent_id)
 
-    # -- learner loop --
-    def _learner_loop(self) -> None:
+    # -- staging: raw payload -> decoded trajectory (overlaps learner) --
+    def _staging_loop(self) -> None:
+        from relayrl_tpu.types.columnar import RawTrajectory
+
+        decoder = None
+        try:
+            from relayrl_tpu.types.columnar import NativeDecoder
+
+            decoder = NativeDecoder()
+        except Exception:
+            pass  # native codec unavailable: pure-Python decode
         while not self._stop.is_set():
             try:
                 agent_id, payload = self._ingest.get(timeout=0.1)
             except queue.Empty:
                 continue
+            item = None
+            t0 = time.monotonic()
             try:
-                self._process_one(payload)
-            finally:
-                self._ingest.task_done()
+                if decoder is not None:
+                    # off-GIL msgpack -> columns; falls back to the Python
+                    # decoder only for payloads the columnar schema can't
+                    # represent
+                    item = decoder.decode(payload, agent_id=agent_id)
+                    if isinstance(item, RawTrajectory):
+                        raw = item.payload
+                        if item.is_envelope:
+                            from relayrl_tpu.transport.base import (
+                                unpack_trajectory_envelope,
+                            )
 
-    def _process_one(self, payload: bytes) -> None:
-        try:
-            actions = deserialize_actions(payload)
-        except Exception:
-            self.stats["dropped"] += 1
-            return
+                            _, raw = unpack_trajectory_envelope(raw)
+                        item = deserialize_actions(raw)
+                else:
+                    item = deserialize_actions(payload)
+            except Exception:
+                self.stats["dropped"] += 1
+            self.timings["decode_s"] += time.monotonic() - t0
+            if item is not None:
+                try:
+                    self._decoded.put_nowait(item)
+                except queue.Full:
+                    self.stats["dropped"] += 1
+            # task_done only after the decoded item is enqueued, so
+            # drain()'s two-queue emptiness check never races the handoff
+            self._ingest.task_done()
+
+    # -- learner loop --
+    def _learner_loop(self) -> None:
+        while not self._stop.is_set():
+            t_wait = time.monotonic()
+            try:
+                item = self._decoded.get(timeout=0.1)
+            except queue.Empty:
+                self.timings["learner_idle_s"] += time.monotonic() - t_wait
+                continue
+            self.timings["learner_idle_s"] += time.monotonic() - t_wait
+            t0 = time.monotonic()
+            try:
+                # A native drain batch is a list of DecodedTrajectory; a
+                # Python-decoded single trajectory is a list of
+                # ActionRecord (and a staged columnar one is a bare
+                # DecodedTrajectory) — disambiguate on the element type.
+                if (isinstance(item, list) and item
+                        and isinstance(item[0], DecodedTrajectory)):
+                    for one in item:
+                        self._process_one(one)
+                else:
+                    self._process_one(item)
+            finally:
+                self.timings["learn_s"] += time.monotonic() - t0
+                self._decoded.task_done()
+
+    def _process_one(self, item) -> None:
+        """``item``: DecodedTrajectory (columnar fast path) or
+        list[ActionRecord] (Python decode)."""
         self.stats["trajectories"] += 1
         try:
-            updated = self.algorithm.receive_trajectory(actions)
+            updated = self.algorithm.receive_trajectory(item)
         except Exception as e:  # never kill the loop on one bad batch
             print(f"[TrainingServer] learner error: {e!r}", flush=True)
             return
@@ -191,15 +276,17 @@ class TrainingServer:
                           flush=True)
 
     def drain(self, timeout: float = 60.0) -> bool:
-        """Block until every trajectory already in the ingest queue has been
-        processed (trained + published). True if drained within timeout.
+        """Block until every trajectory already in the ingest pipeline
+        (raw + decoded queues) has been processed (trained + published).
+        True if drained within timeout.
 
         Note this covers trajectories the server has *received*; bytes still
         in transit in socket buffers are invisible here, so to observe an
         exact update count poll ``stats['updates']`` first, then drain."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self._ingest.unfinished_tasks == 0:
+            if (self._ingest.unfinished_tasks == 0
+                    and self._decoded.unfinished_tasks == 0):
                 return True
             time.sleep(0.05)
         return False
@@ -240,6 +327,9 @@ class TrainingServer:
             return
         self._stop.clear()
         self.transport.start()
+        self._staging_thread = threading.Thread(
+            target=self._staging_loop, name="ingest-staging", daemon=True)
+        self._staging_thread.start()
         self._learner_thread = threading.Thread(
             target=self._learner_loop, name="learner", daemon=True)
         self._learner_thread.start()
@@ -251,6 +341,9 @@ class TrainingServer:
         self._stop.set()
         # Join the learner BEFORE stopping the transport: a trajectory being
         # processed right now may still publish, which needs a live socket.
+        if self._staging_thread is not None:
+            self._staging_thread.join(timeout=30)
+            self._staging_thread = None
         if self._learner_thread is not None:
             self._learner_thread.join(timeout=30)
             self._learner_thread = None
@@ -273,6 +366,7 @@ class TrainingServer:
             self.transport = make_server_transport(
                 self.server_type, self.config, **self._addr_overrides)
             self.transport.on_trajectory = self._on_trajectory
+            self.transport.on_trajectory_decoded = self._on_trajectory_decoded
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
         self.enable_server()

@@ -66,6 +66,11 @@ class ServerTransport(abc.ABC):
         self.on_trajectory: Callable[[str, bytes], None] = lambda *_: None
         self.get_model: Callable[[], tuple[int, bytes]] = lambda: (0, b"")
         self.on_register: Callable[[str], None] = lambda *_: None
+        # Optional fast path: transports whose native core decodes
+        # trajectories into columnar form (native batch drain) deliver
+        # DecodedTrajectory objects here when the embedder sets it; raw
+        # payload bytes always fall back to ``on_trajectory``.
+        self.on_trajectory_decoded = None
 
     @abc.abstractmethod
     def start(self) -> None: ...

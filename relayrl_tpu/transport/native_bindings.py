@@ -67,6 +67,20 @@ def _load(lib_path: str) -> ctypes.CDLL:
     lib.rl_sub_poll.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), u8p,
         ctypes.c_size_t]
+    lib.rl_server_poll_batch.restype = ctypes.c_long
+    lib.rl_server_poll_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.rl_sub_start_async.restype = ctypes.c_int
+    lib.rl_sub_start_async.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rl_sub_next.restype = ctypes.c_long
+    lib.rl_sub_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int64), u8p, ctypes.c_size_t]
+    lib.rl_sub_receipts.restype = ctypes.c_long
+    lib.rl_sub_receipts.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_long]
     return lib
 
 
@@ -88,6 +102,7 @@ class NativeServerTransportImpl(ServerTransport):
         self._idle_timeout_ms = int(idle_timeout_s * 1000)
         self._poller: threading.Thread | None = None
         self._stop = threading.Event()
+        self.drain_parse_failures = 0  # lost decoded batches (observable)
 
     @property
     def port(self) -> int:
@@ -129,6 +144,71 @@ class NativeServerTransportImpl(ServerTransport):
                                       len(bundle_bytes))
 
     def _poll_loop(self) -> None:
+        # Two modes, picked at start() by whether the embedder wants the
+        # columnar fast path:
+        #  * batch drain (TrainingServer): rl_server_poll_batch decodes
+        #    whole batches of trajectory envelopes in C++ (GIL released)
+        #    and this thread just parses RLD1 headers — one Python
+        #    callback per trajectory carrying ready numpy columns.
+        #  * legacy per-event: raw envelope bytes through on_trajectory,
+        #    byte-compatible for embedders without a decoded handler.
+        if self.on_trajectory_decoded is not None:
+            self._poll_loop_batch()
+        else:
+            self._poll_loop_raw()
+
+    def _poll_loop_batch(self) -> None:
+        from relayrl_tpu.types.columnar import (
+            DecodedTrajectory,
+            Registration,
+            RawTrajectory,
+            parse_drain,
+        )
+
+        cap = 1 << 20
+        buf = (ctypes.c_uint8 * cap)()
+        n_items = ctypes.c_int(0)
+        while not self._stop.is_set():
+            n = self._lib.rl_server_poll_batch(
+                self._handle, 100, 256, buf, cap, ctypes.byref(n_items))
+            if n < 0:
+                continue
+            if n_items.value == 0:  # first blob alone exceeds cap: grow
+                cap = max(int(n) * 2, cap * 2)
+                buf = (ctypes.c_uint8 * cap)()
+                continue
+            try:
+                items = parse_drain(ctypes.string_at(buf, int(n)))
+            except Exception as e:
+                # A C++/Python RLD1 layout disagreement loses the whole
+                # already-dequeued batch — make that observable, never
+                # silent (and never crash ingest).
+                self.drain_parse_failures += 1
+                print(f"[NativeTransport] drain buffer unparseable "
+                      f"({e!r}) — a decoded batch was LOST "
+                      f"(#{self.drain_parse_failures})", flush=True)
+                continue
+            # One decoded-batch callback per drain (not per trajectory):
+            # at fleet rate the per-item queue handoff was measurable.
+            batch = []
+            for item in items:
+                if isinstance(item, DecodedTrajectory):
+                    batch.append(item)
+                elif isinstance(item, RawTrajectory):
+                    agent_id, payload = item.agent_id, item.payload
+                    if item.is_envelope:
+                        try:
+                            agent_id, payload = unpack_trajectory_envelope(
+                                payload)
+                        except Exception:
+                            pass  # truly malformed; Python decode will drop
+                    self.on_trajectory(agent_id, payload)
+                elif isinstance(item, Registration):
+                    self.on_register(item.agent_id)
+            if batch:
+                self.on_trajectory_decoded(batch)
+
+    def _poll_loop_raw(self) -> None:
         # One long-lived buffer, grown on demand: allocating a fresh
         # ctypes array per event zeroes the whole capacity each time and
         # dominated the ingest path (~5x at 64-actor scale).
@@ -237,31 +317,46 @@ class NativeAgentTransportImpl(AgentTransport):
         if not self._sub:
             raise RuntimeError("native subscribe connection failed")
         self._heartbeat_s = heartbeat_s
+        # Async mode: a C++ reader thread owns the socket — it parses and
+        # CLOCK_MONOTONIC-timestamps every ModelPush the moment it arrives
+        # (GIL-free; the receipt ledger is the soak benches' fan-out
+        # evidence), owns the sub-channel keepalive, and reconnects. The
+        # Python thread below only drains the decoded queue.
+        self._lib.rl_sub_start_async(self._sub, int(heartbeat_s * 1000))
         self._stop.clear()
         self._listener = threading.Thread(target=self._sub_loop,
                                           name="native-model-sub", daemon=True)
         self._listener.start()
 
+    def drain_receipts(self, max_n: int = 65536) -> list[tuple[int, int]]:
+        """Drain the C++ receipt ledger: ``[(version, rx_mono_ns), ...]``,
+        stamped at frame parse in the native reader thread — comparable
+        against ``time.monotonic_ns()`` of any process on this host."""
+        if self._sub is None:
+            return []
+        vers = (ctypes.c_uint64 * max_n)()
+        ts = (ctypes.c_int64 * max_n)()
+        n = self._lib.rl_sub_receipts(self._sub, vers, ts, max_n)
+        return [(int(vers[i]), int(ts[i])) for i in range(int(n))]
+
     def _sub_loop(self) -> None:
         cap = 1 << 20
         buf = (ctypes.c_uint8 * cap)()  # reused; fresh alloc zeroes 1 MiB/poll
         version = ctypes.c_uint64(0)
+        rx_ns = ctypes.c_int64(0)
         last_beat = time.monotonic()
         while not self._stop.is_set():
-            n = self._lib.rl_sub_poll(self._sub, 200, ctypes.byref(version),
-                                      buf, cap)
-            # Heartbeats between sub polls: the control-channel ping
-            # detects a dead server (and redials C++-side) even when the
-            # agent is neither stepping nor receiving models — op-locked
-            # against concurrent trajectory sends; the sub-channel ping is
-            # the send-only keepalive that stops server idle-reaping from
-            # dropping a one-way subscriber.
+            n = self._lib.rl_sub_next(self._sub, 200, ctypes.byref(version),
+                                      ctypes.byref(rx_ns), buf, cap)
+            # Control-channel ping still detects a dead server (and redials
+            # C++-side) even when the agent is neither stepping nor
+            # receiving models; the sub channel's keepalive now lives in
+            # the C++ async reader.
             if (self._heartbeat_s > 0
                     and time.monotonic() - last_beat >= self._heartbeat_s):
                 last_beat = time.monotonic()
                 if self._ctrl:
                     self._lib.rl_client_ping(self._ctrl, 1000)
-                self._lib.rl_sub_ping(self._sub)
             if n < 0:
                 continue
             if n > cap:

@@ -1,0 +1,256 @@
+"""Columnar decoded trajectories (the native ingest fast path).
+
+The reference's server decodes every trajectory inside its native loop
+(reference: relayrl_framework/src/network/server/training_zmq.rs:994-1011
+pickle-decodes Vec<RelayRLAction> in Rust). This framework's equivalent is
+``native/codec.cc``: it parses the msgpack wire trajectory off-GIL and
+emits one contiguous ``[T, ...]`` buffer per field ("RLD1" blobs). This
+module is the Python half — blob parsing into :class:`DecodedTrajectory`
+(a handful of ``np.frombuffer`` views, no per-step objects) plus the
+ctypes wrapper around ``rl_decode`` so the ZMQ/gRPC ingest path reuses the
+native decoder even though their sockets live in Python.
+
+Terminal markers are already folded by the native decoder (same semantics
+as :func:`relayrl_tpu.data.batching.fold_trailing_markers`; parity is
+enforced by tests/test_native_codec.py), so ``n_steps`` counts real steps
+and ``final_obs``/``final_mask``/``marker_truncated`` carry what the
+markers contributed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import struct
+import threading
+
+import numpy as np
+
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.dtypes import DType, to_numpy_dtype
+from relayrl_tpu.types.tensor import decode_tensor
+
+_BLOB_MAGIC = 0x31444C52  # "RLD1"
+KIND_COLUMNAR = 0
+KIND_RAW = 1
+KIND_REGISTER = 2
+KIND_RAW_ENVELOPE = 3
+
+
+@dataclasses.dataclass
+class DecodedTrajectory:
+    """One wire trajectory as columns (markers folded)."""
+
+    agent_id: str
+    n_steps: int
+    n_records: int  # pre-fold record count — bucketing parity with the
+    #                 ActionRecord path (pick_bucket sees raw record count)
+    marker_truncated: bool
+    columns: dict[str, np.ndarray]  # "o","a","m","r","t","u","x" (present ones)
+    aux: dict[str, np.ndarray]      # per-step aux columns ("v","logp_a",...)
+    final_obs: np.ndarray | None = None
+    final_mask: np.ndarray | None = None
+
+    def __len__(self) -> int:
+        return self.n_records
+
+    @property
+    def total_reward(self) -> float:
+        r = self.columns.get("r")
+        return float(r.sum()) if r is not None else 0.0
+
+    def to_action_records(self) -> list[ActionRecord]:
+        """Reconstruct per-step records (compat path for consumers without
+        a columnar fast path). Marker contributions that survive folding
+        (bootstrap obs/mask, truncation flag) are re-attached as one
+        synthetic trailing marker so downstream re-folding reproduces the
+        same result."""
+        cols, aux = self.columns, self.aux
+        records = []
+        for t in range(self.n_steps):
+            data = {k: v[t] for k, v in aux.items()} or None
+            records.append(ActionRecord(
+                obs=cols["o"][t] if "o" in cols else None,
+                act=cols["a"][t] if "a" in cols else None,
+                mask=cols["m"][t] if "m" in cols else None,
+                rew=float(cols["r"][t]),
+                data=data,
+                done=bool(cols["t"][t]),
+                reward_updated=bool(cols["u"][t]),
+                truncated=bool(cols["x"][t]),
+            ))
+        if (self.final_obs is not None or self.final_mask is not None
+                or self.marker_truncated):
+            records.append(ActionRecord(
+                obs=self.final_obs, act=None, mask=self.final_mask,
+                rew=0.0, done=False, truncated=self.marker_truncated))
+        return records
+
+
+@dataclasses.dataclass
+class RawTrajectory:
+    """Fallback: the native decoder couldn't columnarize this payload;
+    carry the original bytes for the Python decoder. ``is_envelope`` marks
+    payloads that are still wrapped in the transport envelope (the
+    envelope itself failed to parse natively, or the decoder threw) —
+    consumers must ``unpack_trajectory_envelope`` first."""
+
+    agent_id: str
+    payload: bytes
+    is_envelope: bool = False
+
+
+@dataclasses.dataclass
+class Registration:
+    agent_id: str
+
+
+_HDR = struct.Struct("<IBI")          # magic, kind, id_len
+_COL_FIXED = struct.Struct("<BB")     # dtype, ndim (after name)
+_META = struct.Struct("<IIBH")        # n_steps, n_records, flags, n_cols
+
+
+def parse_blob(view: memoryview, off: int = 0):
+    """Parse one RLD1 blob at ``off``; returns ``(item, next_off)``."""
+    magic, kind, id_len = _HDR.unpack_from(view, off)
+    if magic != _BLOB_MAGIC:
+        raise ValueError(f"bad RLD1 magic {magic:#x}")
+    off += _HDR.size
+    agent_id = bytes(view[off:off + id_len]).decode(errors="replace")
+    off += id_len
+    if kind == KIND_REGISTER:
+        return Registration(agent_id), off
+    if kind in (KIND_RAW, KIND_RAW_ENVELOPE):
+        (n,) = struct.unpack_from("<Q", view, off)
+        off += 8
+        payload = bytes(view[off:off + n])
+        return RawTrajectory(agent_id, payload,
+                             is_envelope=(kind == KIND_RAW_ENVELOPE)), off + n
+    n_steps, n_records, flags, n_cols = _META.unpack_from(view, off)
+    off += _META.size
+    descs = []
+    for _ in range(n_cols):
+        name_len = view[off]
+        off += 1
+        name = bytes(view[off:off + name_len]).decode()
+        off += name_len
+        dtype_tag, ndim = _COL_FIXED.unpack_from(view, off)
+        off += _COL_FIXED.size
+        dims = struct.unpack_from(f"<{ndim}I", view, off)
+        off += 4 * ndim
+        col_off, nbytes = struct.unpack_from("<QQ", view, off)
+        off += 16
+        descs.append((name, dtype_tag, dims, col_off, nbytes))
+    (data_len,) = struct.unpack_from("<Q", view, off)
+    off += 8
+    data = view[off:off + data_len]
+    off += data_len
+    columns: dict[str, np.ndarray] = {}
+    aux: dict[str, np.ndarray] = {}
+    for name, dtype_tag, dims, col_off, nbytes in descs:
+        np_dtype = to_numpy_dtype(DType(dtype_tag))
+        arr = np.frombuffer(data[col_off:col_off + nbytes],
+                            dtype=np_dtype).reshape(dims)
+        if name.startswith("d:"):
+            aux[name[2:]] = arr
+        else:
+            columns[name] = arr
+    final_obs = final_mask = None
+    if flags & 2:
+        (n,) = struct.unpack_from("<I", view, off)
+        off += 4
+        final_obs = decode_tensor(view[off:off + n])
+        off += n
+    if flags & 4:
+        (n,) = struct.unpack_from("<I", view, off)
+        off += 4
+        final_mask = decode_tensor(view[off:off + n])
+        off += n
+    return DecodedTrajectory(
+        agent_id=agent_id, n_steps=n_steps, n_records=n_records,
+        marker_truncated=bool(flags & 1), columns=columns, aux=aux,
+        final_obs=final_obs, final_mask=final_mask), off
+
+
+def parse_drain(buf: memoryview | bytes) -> list:
+    """Parse a batch-drain buffer: u64-length-prefixed RLD1 blobs."""
+    view = memoryview(buf)
+    items = []
+    off = 0
+    while off < len(view):
+        (blob_len,) = struct.unpack_from("<Q", view, off)
+        off += 8
+        item, end = parse_blob(view, off)
+        if end - off != blob_len:
+            raise ValueError(
+                f"blob framing mismatch: prefix {blob_len}, parsed {end - off}")
+        items.append(item)
+        off = end
+    return items
+
+
+# -- ctypes wrapper over rl_decode (shared with the zmq/grpc ingest path) --
+
+_codec_lock = threading.Lock()
+_codec_lib = None
+_codec_checked = False
+
+
+def _load_codec():
+    global _codec_lib, _codec_checked
+    with _codec_lock:
+        if _codec_checked:
+            return _codec_lib
+        _codec_checked = True
+        from relayrl_tpu.transport.native_backend import _find_library
+
+        path = _find_library()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.rl_decode.restype = ctypes.c_long
+            lib.rl_decode.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t]
+        except (OSError, AttributeError):
+            return None
+        _codec_lib = lib
+        return _codec_lib
+
+
+def native_codec_available() -> bool:
+    return _load_codec() is not None
+
+
+class NativeDecoder:
+    """Per-thread reusable decode buffer around ``rl_decode``.
+
+    The ctypes call releases the GIL for the whole msgpack parse + column
+    build, so a staging thread decodes while the learner thread runs the
+    device step (SURVEY.md §7.4 item 1's ingest ∥ compute overlap).
+    """
+
+    def __init__(self, initial_cap: int = 1 << 20):
+        self._lib = _load_codec()
+        if self._lib is None:
+            raise RuntimeError("native codec library unavailable")
+        self._cap = initial_cap
+        self._buf = ctypes.create_string_buffer(self._cap)
+
+    def decode(self, payload: bytes, agent_id: str = "?",
+               has_envelope: bool = False):
+        """Payload (or envelope) bytes -> DecodedTrajectory | RawTrajectory."""
+        while True:
+            n = self._lib.rl_decode(payload, len(payload),
+                                    agent_id.encode(), int(has_envelope),
+                                    self._buf, self._cap)
+            if n < 0:
+                return RawTrajectory(agent_id, payload)
+            if n <= self._cap:
+                # Slice-copy out of the reusable buffer: the parsed columns
+                # are zero-copy views and must not alias the next decode.
+                item, _ = parse_blob(memoryview(self._buf[:n]))
+                return item
+            self._cap = int(n) * 2
+            self._buf = ctypes.create_string_buffer(self._cap)
