@@ -50,6 +50,12 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
     # end); for zmq/grpc the stamp is taken in the SUB/poll thread the
     # moment recv returns.
     receipts: list[tuple[int, int]] = []
+    # Subscription timestamp: pub/sub (all three backends) only delivers
+    # to subscribers PRESENT at publish time, and fleet bring-up is
+    # staggered for minutes on the 1-core host — the bench counts a
+    # (publish, agent) pair as expected only if this agent subscribed
+    # before the publish.
+    sub_ts = time.monotonic_ns()
     native_ledger = hasattr(agent.transport, "drain_receipts")
     if not native_ledger:
         orig_on_model = agent.transport.on_model
@@ -103,9 +109,12 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         if len(receipts) != last:
             last = len(receipts)
             quiet_since = time.time()
-        elif (time.time() - start >= 3.0
+        elif (last > 0 and time.time() - start >= 3.0
               and time.time() - quiet_since >= 2.0):
-            break  # >=3s elapsed and no new receipts for 2s: drained
+            break  # drained: some receipts seen, then 2s of quiet
+        # zero receipts: wait the FULL grace — on a 256-thread 1-core
+        # fleet the SUB threads can be starved for many seconds by
+        # sibling processes still compiling/stepping
         time.sleep(0.2)
     out[agent_idx] = {
         "identity": ident,
@@ -113,6 +122,7 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         "episodes": episodes,
         "final_version": agent.model_version,
         "receipts": receipts,
+        "sub_ts": sub_ts,
         "crashed": crashed,
     }
     agent.disable_agent()

@@ -86,6 +86,8 @@ class OnPolicyAlgorithm(AlgorithmBase):
         self.epoch = 0
         self._last_metrics: dict[str, float] = {}
         self.server_model_path = loader.get_server_model_path()
+        self._mesh = None    # set by enable_multihost
+        self._place = None   # mesh-aware batch placement
 
     # -- subclass contract --
     def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
@@ -99,27 +101,71 @@ class OnPolicyAlgorithm(AlgorithmBase):
         """Accepts ``Sequence[ActionRecord]`` (Python decode) or a
         :class:`~relayrl_tpu.types.columnar.DecodedTrajectory` (native
         columnar decode — markers pre-folded)."""
+        batch = self.accumulate(actions)
+        if batch is None:
+            return False
+        self.train_on_batch(batch)
+        self.log_epoch()
+        return True
+
+    def accumulate(self, item):
+        """Buffer one trajectory WITHOUT training; returns the drained
+        epoch batch dict when the buffer fills, else None. This is the
+        single owner of the empty/marker-only validation;
+        :meth:`receive_trajectory` is accumulate + train + log, and the
+        multi-host server calls accumulate alone on the coordinator (the
+        training step is collective — :meth:`train_on_batch` runs on
+        every process with the broadcast batch)."""
         from relayrl_tpu.types.columnar import DecodedTrajectory
 
-        if isinstance(actions, DecodedTrajectory):
-            if actions.n_steps == 0:
-                return False
-        elif not actions or all(a.act is None for a in actions):
+        if isinstance(item, DecodedTrajectory):
+            if item.n_steps == 0:
+                return None
+        elif not item or all(a.act is None for a in item):
             # Marker-only trajectories (stranded by a capacity flush)
             # carry no steps; padding would raise on the empty fold.
-            return False
-        if self.buffer.add_episode(actions):
-            self.train_model()
-            self.log_epoch()
-            return True
-        return False
+            return None
+        if self.buffer.add_episode(item):
+            return self.buffer.drain().as_dict()
+        return None
 
-    def train_model(self) -> Mapping[str, float]:
-        batch = self.buffer.drain()
-        device_batch = {k: jnp.asarray(v) for k, v in batch.as_dict().items()}
+    def train_on_batch(self, host_batch: Mapping[str, Any]) -> Mapping[str, float]:
+        """One jitted update on an assembled batch dict (host or device
+        arrays). Multi-host: every process must call this with the same
+        batch (see the server's broadcast loop)."""
+        if self._place is not None:
+            device_batch = self._place(dict(host_batch))
+        else:
+            device_batch = {k: jnp.asarray(v) for k, v in host_batch.items()}
         self.state, metrics = self._update(self.state, device_batch)
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         return self._last_metrics
+
+    def train_model(self) -> Mapping[str, float]:
+        return self.train_on_batch(self.buffer.drain().as_dict())
+
+    def enable_multihost(self, mesh) -> None:
+        """Re-compile the update over a (possibly multi-process) mesh and
+        place the state on it. Call once, on every process, right after
+        construction (identical seeds give identical initial state; see
+        TrainingServer's seed_salt handling)."""
+        from relayrl_tpu.parallel import (
+            make_sharded_update,
+            place_batch,
+            place_state,
+        )
+
+        self._mesh = mesh
+        self._update = make_sharded_update(self._update, mesh, self.state)
+        self.state = place_state(self.state, mesh)
+        self._place = lambda b: place_batch(b, mesh)
+        # One jitted params gather, reused by every bundle() call (a fresh
+        # lambda per call would retrace + recompile the all-gather each
+        # publish).
+        from relayrl_tpu.parallel.sharding import replicated
+
+        self._gather_params = jax.jit(lambda p: p,
+                                      out_shardings=replicated(mesh))
 
     def log_epoch(self) -> None:
         rets, lens = self.buffer.pop_episode_stats()
@@ -136,13 +182,30 @@ class OnPolicyAlgorithm(AlgorithmBase):
         self.bundle().save(path or self.server_model_path)
 
     def bundle(self) -> ModelBundle:
-        host_params = jax.device_get(self.state.params)
+        """Serialize the current policy for actors.
+
+        Multi-host: params may be sharded across processes; an all-gather
+        (re-shard to replicated) assembles the full copy — which makes
+        this a COLLECTIVE when ``jax.process_count() > 1``: every process
+        must call it at the same point (the server's broadcast loop does).
+        """
+        params = self.state.params
+        if self._mesh is not None and jax.process_count() > 1:
+            params = self._gather_params(params)
+            host_params = jax.tree_util.tree_map(
+                lambda x: np.asarray(x.addressable_data(0)), params)
+        else:
+            host_params = jax.device_get(params)
         return ModelBundle(version=self.version, arch=self.arch,
                            params=host_params)
 
     @property
     def version(self) -> int:
-        return int(self.state.step)
+        step = self.state.step
+        try:
+            return int(step)
+        except Exception:  # multi-host replicated array: read a local shard
+            return int(np.asarray(step.addressable_data(0)))
 
     # convenience for in-process actors/tests
     def act(self, obs, mask=None):

@@ -59,6 +59,27 @@ class TrajectoryBatch:
     def as_dict(self) -> dict[str, np.ndarray]:
         return dataclasses.asdict(self)
 
+    @classmethod
+    def zeros(cls, batch_size: int, horizon: int, obs_dim: int, act_dim: int,
+              discrete: bool = True) -> dict[str, np.ndarray]:
+        """Zero batch dict with this schema's exact keys/dtypes/shapes —
+        the single owner used by the multi-host broadcast protocol, where
+        non-coordinator processes must hold a pytree-identical template
+        before ``broadcast_one_to_all`` fills it."""
+        b, t = int(batch_size), int(horizon)
+        act = (np.zeros((b, t), np.int32) if discrete
+               else np.zeros((b, t, act_dim), np.float32))
+        return {
+            "obs": np.zeros((b, t, obs_dim), np.float32),
+            "act": act,
+            "act_mask": np.zeros((b, t, act_dim), np.float32),
+            "rew": np.zeros((b, t), np.float32),
+            "val": np.zeros((b, t), np.float32),
+            "logp": np.zeros((b, t), np.float32),
+            "valid": np.zeros((b, t), np.float32),
+            "last_val": np.zeros((b,), np.float32),
+        }
+
 
 def fold_trailing_markers(
     actions: Sequence[ActionRecord],

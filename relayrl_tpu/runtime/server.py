@@ -80,6 +80,10 @@ class TrainingServer:
                   (kv.split("=", 1) for kv in hyperparams)}
         else:
             hp = dict(hyperparams or {})
+        if self.distributed_info["multi_host"]:
+            # SPMD demands bit-identical initial state on every process;
+            # the default seed_salt (the pid) would fork the inits.
+            hp.setdefault("seed_salt", 0)
 
         self.algorithm = build_algorithm(
             algorithm_name,
@@ -92,6 +96,26 @@ class TrainingServer:
         )
 
         learner_cfg = self.config.get_learner_params()
+        if self.distributed_info["multi_host"]:
+            # The learner step becomes SPMD over the global (all-host)
+            # mesh: coordinator-side socket ingest assembles batches, the
+            # broadcast loop ships them, every process steps in lockstep
+            # (SURVEY.md §7.4 item 5's asymmetric-ingest design).
+            if resume:
+                raise NotImplementedError(
+                    "resume=True is not supported with a multi-host "
+                    "learner yet — restart fresh or restore on one host")
+            if not hasattr(self.algorithm, "enable_multihost"):
+                raise NotImplementedError(
+                    f"{algorithm_name} has no multi-host support "
+                    "(enable_multihost); use an on-policy algorithm")
+            from relayrl_tpu.parallel import make_mesh
+
+            self._mh_mesh = make_mesh(learner_cfg.get("mesh") or {"dp": -1})
+            self.algorithm.enable_multihost(self._mh_mesh)
+            print(f"[TrainingServer] multi-host mesh "
+                  f"{dict(self._mh_mesh.shape)} over "
+                  f"{len(self._mh_mesh.devices.flat)} devices", flush=True)
         # One resolution for save AND resume — a falsy configured value
         # disables checkpointing entirely, anything else is used by both
         # paths (a split default here would resume from a dir never written).
@@ -126,16 +150,24 @@ class TrainingServer:
         self._bundle_bytes: bytes = self.algorithm.bundle().to_bytes()
         self._bundle_version: int = self.algorithm.version
 
-        self.transport = make_server_transport(server_type, self.config,
-                                               **addr_overrides)
-        self.transport.on_trajectory = self._on_trajectory
-        self.transport.on_trajectory_decoded = self._on_trajectory_decoded
-        self.transport.get_model = self._get_model
-        self.transport.on_register = self._on_register
+        # Non-coordinator processes run learner steps only — the actor
+        # plane (sockets) binds on the coordinator host alone.
+        from relayrl_tpu.parallel.distributed import is_coordinator
+
+        self.transport = None
+        if is_coordinator():
+            self.transport = make_server_transport(server_type, self.config,
+                                                   **addr_overrides)
+            self.transport.on_trajectory = self._on_trajectory
+            self.transport.on_trajectory_decoded = self._on_trajectory_decoded
+            self.transport.get_model = self._get_model
+            self.transport.on_register = self._on_register
 
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
         self._staging_thread: threading.Thread | None = None
+        self._mh_ready: list = []   # assembled-but-untrained epoch batches
+        self._mh_busy = False       # a broadcast step is in flight
         self.active = False
         self.stats = {"trajectories": 0, "updates": 0, "dropped": 0}
         # Per-thread time ledger (seconds): where the ingest pipeline
@@ -227,6 +259,131 @@ class TrainingServer:
             # drain()'s two-queue emptiness check never races the handoff
             self._ingest.task_done()
 
+    # -- multi-host learner loop (SPMD broadcast protocol) --
+    # Every process loops in lockstep on a fixed-shape control broadcast:
+    # IDLE ticks keep non-coordinators synchronized while the coordinator
+    # accumulates trajectories; STEP carries the batch shape, then the
+    # batch itself, then all processes run the sharded update + the
+    # collective bundle all-gather; STOP tears everyone down together.
+    _MH_IDLE, _MH_STEP, _MH_STOP = 0, 1, 2
+
+    def _mh_accumulate(self, item) -> dict | None:
+        """Coordinator: feed one decoded queue entry into the algorithm
+        buffer; returns a ready epoch batch dict (at most one per call —
+        extras queue in _mh_ready)."""
+        items = (item if (isinstance(item, list) and item
+                          and isinstance(item[0], DecodedTrajectory))
+                 else [item])
+        for one in items:
+            self.stats["trajectories"] += 1
+            try:
+                got = self.algorithm.accumulate(one)
+            except Exception as e:
+                print(f"[TrainingServer] accumulate error: {e!r}", flush=True)
+                continue
+            if got is not None:
+                self._mh_ready.append(got)
+        return self._mh_ready.pop(0) if self._mh_ready else None
+
+    def _mh_zero_batch(self, b: int, t: int) -> dict:
+        from relayrl_tpu.data.batching import TrajectoryBatch
+
+        a = self.algorithm
+        return TrajectoryBatch.zeros(b, t, a.obs_dim, a.act_dim, a.discrete)
+
+    def _learner_loop_multihost(self) -> None:
+        import numpy as np
+
+        from relayrl_tpu.parallel.distributed import (
+            broadcast_from_coordinator,
+            is_coordinator,
+        )
+
+        coord = is_coordinator()
+        while True:
+            batch = None
+            if coord:
+                # STOP preempts any ingest backlog: disable_server must
+                # terminate the fleet within one in-flight step, not
+                # after draining hundreds of queued trajectories.
+                if not self._stop.is_set():
+                    if self._mh_ready:
+                        batch = self._mh_ready.pop(0)
+                    tick_deadline = time.monotonic() + 0.2
+                    while batch is None and time.monotonic() < tick_deadline:
+                        try:
+                            item = self._decoded.get(timeout=0.05)
+                        except queue.Empty:
+                            continue
+                        try:
+                            batch = self._mh_accumulate(item)
+                        finally:
+                            self._decoded.task_done()
+                code = (self._MH_STOP if self._stop.is_set()
+                        else self._MH_STEP if batch is not None
+                        else self._MH_IDLE)
+                desc = np.array(
+                    [code,
+                     batch["obs"].shape[0] if batch is not None else 0,
+                     batch["obs"].shape[1] if batch is not None else 0],
+                    np.int64)
+            else:
+                desc = np.zeros(3, np.int64)
+            desc = broadcast_from_coordinator(desc)
+            code = int(desc[0])
+            if code == self._MH_STOP:
+                break
+            if code == self._MH_IDLE:
+                continue
+            if not coord:
+                batch = self._mh_zero_batch(int(desc[1]), int(desc[2]))
+            self._mh_busy = True
+            batch = broadcast_from_coordinator(batch)
+            try:
+                self.algorithm.train_on_batch(batch)
+            except Exception as e:
+                print(f"[TrainingServer] multi-host update error: {e!r}",
+                      flush=True)
+                self._mh_busy = False
+                continue  # symmetric on all ranks: same data, same failure
+            bundle = self.algorithm.bundle()  # collective all-gather
+            if coord:
+                self.stats["updates"] += 1
+                try:
+                    self.algorithm.log_epoch()
+                except Exception as e:
+                    print(f"[TrainingServer] log error: {e!r}", flush=True)
+                raw = bundle.to_bytes()
+                with self._bundle_lock:
+                    self._bundle_bytes = raw
+                    self._bundle_version = bundle.version
+                try:
+                    self.transport.publish_model(bundle.version, raw)
+                except Exception as e:
+                    print(f"[TrainingServer] publish error: {e!r}", flush=True)
+                self._write_model_artifact(raw, bundle.version)
+                if self._tb is not None:
+                    try:
+                        self._tb.poll()
+                    except Exception as e:
+                        print(f"[TrainingServer] tensorboard error: {e!r}",
+                              flush=True)
+            # Full-state checkpoint is COLLECTIVE on a multi-host mesh
+            # (orbax needs every process to contribute its shards to the
+            # shared checkpoint_dir); the due-check derives from the
+            # replicated version, so all ranks agree without extra
+            # coordination.
+            if (self._checkpoint_dir
+                    and bundle.version % self._checkpoint_every == 0):
+                try:
+                    from relayrl_tpu.checkpoint import checkpoint_algorithm
+
+                    checkpoint_algorithm(self.algorithm, self._checkpoint_dir)
+                except Exception as e:
+                    print(f"[TrainingServer] checkpoint failed: {e!r}",
+                          flush=True)
+            self._mh_busy = False
+
     # -- learner loop --
     def _learner_loop(self) -> None:
         while not self._stop.is_set():
@@ -286,10 +443,30 @@ class TrainingServer:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if (self._ingest.unfinished_tasks == 0
-                    and self._decoded.unfinished_tasks == 0):
+                    and self._decoded.unfinished_tasks == 0
+                    # multi-host: assembled-but-untrained epoch batches and
+                    # the broadcast step in flight also count as pending
+                    and not self._mh_ready
+                    and not self._mh_busy):
                 return True
             time.sleep(0.05)
         return False
+
+    def _write_model_artifact(self, raw: bytes, version: int) -> None:
+        """Periodic on-disk model bytes (ref: server reads the .pt file to
+        serve agents, training_zmq.rs:905-919; for us handshakes are
+        served from memory and the file is a resume/debug aid). Reuses the
+        serialized bytes, throttled by learner.checkpoint_every_epochs."""
+        if version % self._checkpoint_every != 0:
+            return
+        try:
+            path = self.algorithm.server_model_path
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, path)
+        except OSError:
+            pass
 
     def _publish(self) -> None:
         bundle = self.algorithm.bundle()
@@ -298,40 +475,34 @@ class TrainingServer:
             self._bundle_bytes = raw
             self._bundle_version = bundle.version
         self.transport.publish_model(bundle.version, raw)
-        # Periodic on-disk artifact (ref: server reads the .pt file to serve
-        # agents, training_zmq.rs:905-919; for us handshakes are served from
-        # memory and the file is a resume/debug aid). Reuses the serialized
-        # bytes and is throttled by learner.checkpoint_every_epochs.
-        if bundle.version % self._checkpoint_every == 0:
+        self._write_model_artifact(raw, bundle.version)
+        if self._checkpoint_dir and bundle.version % self._checkpoint_every == 0:
+            # Full-state checkpoint (params + optimizer + RNG + epoch);
+            # async orbax save — the learner loop is not blocked.
             try:
-                path = self.algorithm.server_model_path
-                tmp = f"{path}.tmp"
-                with open(tmp, "wb") as f:
-                    f.write(raw)
-                os.replace(tmp, path)
-            except OSError:
-                pass
-            if self._checkpoint_dir:
-                # Full-state checkpoint (params + optimizer + RNG + epoch);
-                # async orbax save — the learner loop is not blocked.
-                try:
-                    from relayrl_tpu.checkpoint import checkpoint_algorithm
+                from relayrl_tpu.checkpoint import checkpoint_algorithm
 
-                    checkpoint_algorithm(self.algorithm, self._checkpoint_dir)
-                except Exception as e:
-                    print(f"[TrainingServer] checkpoint failed: {e!r}", flush=True)
+                checkpoint_algorithm(self.algorithm, self._checkpoint_dir)
+            except Exception as e:
+                print(f"[TrainingServer] checkpoint failed: {e!r}", flush=True)
 
     # -- lifecycle (ref: training_zmq.rs:322-465 / o3_training_server.rs:153-272) --
     def enable_server(self) -> None:
         if self.active:
             return
         self._stop.clear()
-        self.transport.start()
-        self._staging_thread = threading.Thread(
-            target=self._staging_loop, name="ingest-staging", daemon=True)
-        self._staging_thread.start()
+        multi_host = self.distributed_info["multi_host"]
+        if self.transport is not None:
+            self.transport.start()
+            self._staging_thread = threading.Thread(
+                target=self._staging_loop, name="ingest-staging", daemon=True)
+            self._staging_thread.start()
+        self._mh_ready = []
+        self._mh_busy = False
         self._learner_thread = threading.Thread(
-            target=self._learner_loop, name="learner", daemon=True)
+            target=(self._learner_loop_multihost if multi_host
+                    else self._learner_loop),
+            name="learner", daemon=True)
         self._learner_thread.start()
         self.active = True
 
@@ -341,13 +512,22 @@ class TrainingServer:
         self._stop.set()
         # Join the learner BEFORE stopping the transport: a trajectory being
         # processed right now may still publish, which needs a live socket.
+        # (Multi-host: the coordinator's learner thread broadcasts STOP on
+        # its way out, releasing every non-coordinator's loop — shut the
+        # fleet down together or coordinator-last.)
         if self._staging_thread is not None:
             self._staging_thread.join(timeout=30)
             self._staging_thread = None
         if self._learner_thread is not None:
-            self._learner_thread.join(timeout=30)
+            # Multi-host: the thread may be mid-collective (a step can
+            # include a fresh XLA compile) — give it long enough to reach
+            # the STOP broadcast; killing the transport under a live
+            # publish would be worse than waiting.
+            self._learner_thread.join(
+                timeout=600 if self.distributed_info["multi_host"] else 30)
             self._learner_thread = None
-        self.transport.stop()
+        if self.transport is not None:
+            self.transport.stop()
         # Drain any in-flight async orbax save — the most recent checkpoint
         # is exactly the one a subsequent resume needs.
         mgr = getattr(self.algorithm, "_ckpt_mgr", None)
@@ -360,8 +540,13 @@ class TrainingServer:
         self.active = False
 
     def restart_server(self, **addr_overrides) -> None:
+        from relayrl_tpu.parallel.distributed import is_coordinator
+
         self.disable_server()
-        if addr_overrides:
+        if addr_overrides and is_coordinator():
+            # Non-coordinators never own a transport (the actor plane
+            # binds on the coordinator only) — a symmetric restart call
+            # across the fleet must not create one.
             self._addr_overrides.update(addr_overrides)
             self.transport = make_server_transport(
                 self.server_type, self.config, **self._addr_overrides)

@@ -1,0 +1,149 @@
+"""Worker for the live-fleet → 2-process multi-host TrainingServer test.
+
+Each of two OS processes builds a real :class:`TrainingServer` over a
+shared ``jax.distributed`` coordinator (4 virtual CPU devices each → an
+8-device global dp mesh). The coordinator (rank 0) also runs two real ZMQ
+:class:`Agent` threads driving a two-armed bandit; trajectories flow over
+real sockets into the coordinator's ingest, and every epoch batch is
+broadcast so BOTH processes execute the sharded update in lockstep —
+SURVEY.md §7.4 item 5's asymmetric-ingest design, end-to-end (VERDICT r2
+missing #3).
+
+Success criteria printed as ``MHSERVER_OK rank=<r> version=<v> p1=<prob>``:
+* both ranks reach the same model version (allgather-checked),
+* the published policy has learned the bandit (rank 0 samples it).
+
+Usage: _multihost_server_worker.py <rank> <coord_port> <listener_port>
+       <traj_port> <pub_port> <scratch_dir>
+"""
+
+import os
+import sys
+import threading
+import time
+
+rank = int(sys.argv[1])
+coord_port = sys.argv[2]
+listener_port, traj_port, pub_port = sys.argv[3:6]
+scratch = sys.argv[6]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=4 "
+    + os.environ.get("XLA_FLAGS", ""))
+os.environ["RELAYRL_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+os.environ["RELAYRL_NUM_PROCESSES"] = "2"
+os.environ["RELAYRL_PROCESS_ID"] = str(rank)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from relayrl_tpu.runtime.server import TrainingServer  # noqa: E402
+
+TARGET_UPDATES = 30
+
+server = TrainingServer(
+    "REINFORCE", obs_dim=3, act_dim=2, env_dir=scratch,
+    server_type="zmq",
+    hyperparams={"traj_per_epoch": 8, "hidden_sizes": [16], "seed": 3,
+                 "with_vf_baseline": True, "pi_lr": 0.005,
+                 "train_vf_iters": 3},
+    agent_listener_addr=f"tcp://127.0.0.1:{listener_port}",
+    trajectory_addr=f"tcp://127.0.0.1:{traj_port}",
+    model_pub_addr=f"tcp://127.0.0.1:{pub_port}",
+)
+assert server.distributed_info == {"multi_host": True, "process_id": rank,
+                                   "num_processes": 2}, server.distributed_info
+assert (server.transport is not None) == (rank == 0)
+assert jax.device_count() == 8
+
+
+class _BanditEnv:
+    """Two-armed bandit: action 1 pays 1.0, action 0 pays 0.0."""
+
+    def __init__(self, obs_dim=3, horizon=4):
+        self.obs = np.zeros(obs_dim, np.float32)
+        self.horizon = horizon
+        self._t = 0
+
+    def reset(self, seed=None):
+        self._t = 0
+        return self.obs, {}
+
+    def step(self, action):
+        self._t += 1
+        rew = 1.0 if int(np.asarray(action).reshape(-1)[0]) == 1 else 0.0
+        return self.obs, rew, self._t >= self.horizon, False, {}
+
+
+if rank == 0:
+    from relayrl_tpu.runtime.agent import Agent, run_gym_loop
+
+    stop_actors = threading.Event()
+
+    def actor(seed):
+        agent = Agent(
+            server_type="zmq", handshake_timeout_s=60, seed=seed,
+            model_path=os.path.join(scratch, f"client_{seed}.msgpack"),
+            agent_listener_addr=f"tcp://127.0.0.1:{listener_port}",
+            trajectory_addr=f"tcp://127.0.0.1:{traj_port}",
+            model_sub_addr=f"tcp://127.0.0.1:{pub_port}")
+        env = _BanditEnv()
+        while not stop_actors.is_set():
+            run_gym_loop(agent, env, episodes=2, max_steps=8)
+            time.sleep(0.01)
+        agent.disable_agent()
+
+    actors = [threading.Thread(target=actor, args=(s,), daemon=True)
+              for s in (11, 12)]
+    for t in actors:
+        t.start()
+    deadline = time.time() + 180
+    while server.stats["updates"] < TARGET_UPDATES and time.time() < deadline:
+        time.sleep(0.2)
+    stop_actors.set()
+    for t in actors:
+        t.join(timeout=30)
+    assert server.stats["updates"] >= TARGET_UPDATES, server.stats
+    assert server.stats["dropped"] == 0, server.stats
+
+    # The published policy must have learned the bandit: rebuild it from
+    # the exact bytes agents receive and sample the preferred arm.
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    with server._bundle_lock:
+        bundle = ModelBundle.from_bytes(server._bundle_bytes)
+    policy = build_policy(bundle.arch)
+    rng = jax.random.PRNGKey(0)
+    obs = np.zeros(3, np.float32)
+    ones = 0
+    for i in range(200):
+        rng, sub = jax.random.split(rng)
+        act, _ = policy.step(bundle.params, sub, obs, None)
+        ones += int(np.asarray(act).reshape(-1)[0] == 1)
+    p1 = ones / 200.0
+    assert p1 >= 0.7, f"policy did not learn the bandit: p(arm1)={p1}"
+    server.disable_server()  # broadcasts STOP, releasing rank 1
+else:
+    p1 = -1.0
+    # Non-coordinator: the learner thread steps on every broadcast; wait
+    # for the coordinator's STOP to end it. Never give up early — exiting
+    # this process while rank 0 is mid-collective deadlocks the fleet.
+    server._learner_thread.join(timeout=420)
+    assert not server._learner_thread.is_alive(), "rank 1 never saw STOP"
+    server.disable_server()
+
+# Both ranks ended on the same model version (SPMD lockstep).
+from jax.experimental import multihost_utils  # noqa: E402
+
+versions = multihost_utils.process_allgather(
+    np.int64(server.algorithm.version))
+assert versions.shape[0] == 2 and versions[0] == versions[1], versions
+assert int(versions[0]) >= TARGET_UPDATES
+
+print(f"MHSERVER_OK rank={rank} version={int(versions[0])} p1={p1:.2f}",
+      flush=True)
