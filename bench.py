@@ -202,6 +202,68 @@ def bench_jax(warmup: int = WARMUP, iters: int = ITERS,
     return ups, mfu
 
 
+def bench_transformer(warmup: int = 2, iters: int = 8) -> dict | None:
+    """Secondary headline: the flagship transformer-flash family through
+    the IMPALA update (VERDICT r2 #4 — the chip evidence must cover the
+    non-MLP families). Returns {updates_per_sec, mfu} or None on failure
+    (the MLP headline must never be blocked by this)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from relayrl_tpu.algorithms.impala import ImpalaState, make_impala_update
+    from relayrl_tpu.models import build_policy
+
+    t_B, t_T, t_d, t_L = 8, 1024, 256, 4
+    arch = {"kind": "transformer_discrete", "obs_dim": 64, "act_dim": 18,
+            "d_model": t_d, "n_layers": t_L, "n_heads": 8,
+            "max_seq_len": t_T, "has_critic": True, "attention": "flash",
+            "attention_block": 256, "precision": "bfloat16"}
+    policy = build_policy(arch)
+    params = policy.init_params(jax.random.PRNGKey(0))
+    tx = optax.chain(optax.clip_by_global_norm(40.0), optax.adam(3e-4))
+    state = ImpalaState(params=params, opt_state=tx.init(params),
+                        rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+    update = jax.jit(
+        make_impala_update(policy, 3e-4, 0.99, 0.5, 0.01, 1.0, 1.0, 40.0))
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": jnp.asarray(rng.standard_normal((t_B, t_T, 64)).astype(np.float32)),
+        "act": jnp.asarray(rng.integers(0, 18, (t_B, t_T)).astype(np.int32)),
+        "act_mask": jnp.ones((t_B, t_T, 18), jnp.float32),
+        "rew": jnp.asarray(rng.standard_normal((t_B, t_T)).astype(np.float32)),
+        "val": jnp.zeros((t_B, t_T), jnp.float32),
+        "logp": jnp.full((t_B, t_T), -1.0, jnp.float32),
+        "valid": jnp.ones((t_B, t_T), jnp.float32),
+        "last_val": jnp.zeros((t_B,), jnp.float32),
+    }
+    for _ in range(warmup):
+        state, metrics = update(state, batch)
+    float(jax.tree_util.tree_leaves(metrics)[0])  # host fence (see bench_jax)
+
+    def one_trial():
+        nonlocal state, metrics
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = update(state, batch)
+        float(jax.tree_util.tree_leaves(metrics)[0])
+        return iters / (time.perf_counter() - t0)
+
+    ups = best_of(2, one_trial)
+    # analytic fwd FLOPs (see benches/bench_learner.transformer_fwd_flops);
+    # IMPALA's fused fwd+bwd ~= 3x fwd
+    tokens = t_B * t_T
+    per_layer = 8 * t_d * t_d + 16 * t_d * t_d + 2 * t_d * t_T
+    fwd = tokens * (t_L * per_layer + 2 * 64 * t_d + 2 * t_d * 19)
+    out = {"updates_per_sec": round(ups, 2),
+           "B": t_B, "T": t_T, "d_model": t_d, "n_layers": t_L}
+    peak = _chip_peak_flops(jax.devices()[0].device_kind)
+    if peak:
+        out["mfu"] = round(3 * fwd * ups / peak, 4)
+    return out
+
+
 def bench_torch_reference() -> float:
     """Reference-shaped learner epoch in torch on CPU: one pg step +
     VF_ITERS value steps over the same flattened step set."""
@@ -277,6 +339,14 @@ def main():
         result["degraded"] = True
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
+    if not degraded:
+        try:
+            t = bench_transformer()
+            if t is not None:
+                result["transformer_flash"] = t
+        except Exception as exc:  # never block the headline
+            print(f"bench: transformer secondary failed ({exc!r})",
+                  file=sys.stderr, flush=True)
     print(json.dumps(result))
 
 

@@ -123,12 +123,20 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         "final_version": agent.model_version,
         "receipts": receipts,
         "sub_ts": sub_ts,
+        # Departure stamp: a publish after this agent stopped listening
+        # cannot be received, so the bench excludes such pairs from
+        # `expected` (fleet teardown is as staggered as bring-up).
+        "unsub_ts": time.monotonic_ns(),
         "crashed": crashed,
     }
     agent.disable_agent()
 
 
 def main():
+    import faulthandler
+
+    faulthandler.enable()  # SIGABRT from the churn bench's stuck-worker
+    #                        diagnostic dumps every thread's traceback
     cfg = json.loads(sys.argv[1])
     os.environ["JAX_PLATFORMS"] = "cpu"
 

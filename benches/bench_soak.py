@@ -157,15 +157,16 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     total_episodes = sum(a["episodes"] for a in agents)
     pub_times = dict(publishes)
     # Expected receipts: pub/sub only delivers to subscribers present at
-    # publish time (true of all three backends), and agent bring-up is
-    # staggered for minutes at 256 actors on this host — count a
-    # (publish, agent) pair only when the agent subscribed >=0.5s before
-    # the publish (the margin covers SUB-subscription propagation). The
-    # SAME predicate filters the receipts, so the rate can't exceed 1.
+    # publish time (true of all three backends), and fleet bring-up AND
+    # teardown are staggered for minutes at 256 actors on this host —
+    # count a (publish, agent) pair only when the agent subscribed >=0.5s
+    # before the publish (margin covers SUB propagation) and was still
+    # listening when it fired. The SAME predicate filters the receipts,
+    # so the rate can't exceed 1.
     margin_ns = int(0.5e9)
 
     def _counts(agent, pub_ns):
-        return agent["sub_ts"] + margin_ns < pub_ns
+        return agent["sub_ts"] + margin_ns < pub_ns < agent["unsub_ts"]
 
     latencies = [(t_ns - pub_times[v]) / 1e9
                  for a in agents for v, t_ns in a["receipts"]
@@ -329,6 +330,154 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
     }
 
 
+def run_churn(n_actors: int = 16, agents_per_proc: int = 4,
+              duration_s: float = 45.0, episode_len: int = 25,
+              obs_dim: int = 8, act_dim: int = 4) -> dict:
+    """Elastic-fleet churn (beyond the reference — its registry is an
+    append-only Vec, training_server_wrapper.rs:159-163): kill -9 half the
+    worker processes mid-run, then add replacements. SLOs: the native
+    server reaps the dead agents from the registry (kernel-closed control
+    connections emit unregister events), training continues uninterrupted
+    through the churn, and every replacement handshakes and registers."""
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    scratch = tempfile.mkdtemp(prefix="relayrl_churn_")
+    port = free_port()
+    server = TrainingServer(
+        "IMPALA", obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
+        hyperparams={"traj_per_epoch": 16, "hidden_sizes": [32, 32]},
+        server_type="native", bind_addr=f"127.0.0.1:{port}")
+    # Partitioned (not crashed) peers go silent without a TCP close; the
+    # idle reaper covers them. Crashes are reaped instantly via the
+    # kernel-closed connection. 60s: comfortably above the agent-side
+    # fetch->register gap (policy jit) on an oversubscribed host, while
+    # still reaping partitions well inside a long soak.
+    server.transport._idle_timeout_ms = 60_000
+    server.transport._lib.rl_server_set_idle_timeout(
+        server.transport._handle, 60_000)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn(worker_id: int, dur: float):
+        cfg = {
+            "worker_id": worker_id, "agents_per_proc": agents_per_proc,
+            "duration_s": dur, "episode_len": episode_len,
+            "obs_dim": obs_dim, "scratch": scratch,
+            "handshake_timeout_s": 120.0, "receipt_grace_s": 2.0,
+            "server_type": "native", "server_addr": f"127.0.0.1:{port}",
+            "result_path": os.path.join(scratch, f"worker_{worker_id}.json"),
+        }
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(_HERE, "_soak_worker.py"), json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+    n_procs = n_actors // agents_per_proc
+    procs = [spawn(w, duration_s) for w in range(n_procs)]
+    timeline = []
+    # Cumulative registrations survive normal agent exits (which also
+    # unregister), so the replacement assert can't race fleet teardown.
+    reg_total = [0]
+    orig_register = server._on_register
+
+    def counting_register(agent_id):
+        reg_total[0] += 1
+        orig_register(agent_id)
+
+    server.transport.on_register = counting_register
+
+    def registry_size():
+        with server._registry_lock:
+            return len(server.agent_ids)
+
+    # Phase 1: wait until the whole fleet registered.
+    deadline = time.time() + 240
+    while registry_size() < n_actors and time.time() < deadline:
+        time.sleep(0.25)
+    reg_full = registry_size()
+    timeline.append({"t": "fleet_up", "registry": reg_full})
+
+    # Phase 2: kill -9 half the fleet — only once training is underway,
+    # so the artifact shows updates BEFORE and AFTER the churn.
+    deadline = time.time() + 120
+    while server.stats["updates"] < 3 and time.time() < deadline:
+        time.sleep(0.25)
+    updates_at_kill = server.stats["updates"]
+    victims = procs[: n_procs // 2]
+    for p in victims:
+        p.kill()  # SIGKILL: no cleanup, kernel closes the sockets
+    deadline = time.time() + 60
+    expect_after_kill = n_actors - len(victims) * agents_per_proc
+    while registry_size() > expect_after_kill and time.time() < deadline:
+        time.sleep(0.25)
+    reg_after_kill = registry_size()
+    timeline.append({"t": "after_kill", "registry": reg_after_kill})
+
+    # Phase 3: replacements join mid-run.
+    n_repl = len(victims) * agents_per_proc
+    replacements = [spawn(100 + w, duration_s / 3) for w in range(len(victims))]
+    deadline = time.time() + 240
+    while reg_total[0] < n_actors + n_repl and time.time() < deadline:
+        for p in replacements:
+            if p.poll() is not None and p.returncode != 0:
+                out, _ = p.communicate()
+                raise RuntimeError(
+                    f"replacement worker died rc={p.returncode}:\n{out[-3000:]}")
+        time.sleep(0.25)
+    if reg_total[0] < n_actors + n_repl:
+        # Diagnose before failing: what are the replacements doing?
+        import signal
+
+        for p in replacements:
+            try:
+                p.send_signal(signal.SIGABRT)  # faulthandler-style traceback
+                out, _ = p.communicate(timeout=10)
+                print(f"[churn] stuck replacement output:\n{out[-3000:]}",
+                      flush=True)
+            except Exception as e:
+                p.kill()
+                print(f"[churn] replacement kill ({e!r})", flush=True)
+    reg_after_join = registry_size()
+    timeline.append({"t": "after_join", "registry": reg_after_join,
+                     "registrations_total": reg_total[0]})
+
+    for p in procs[n_procs // 2:] + replacements:
+        try:
+            p.communicate(timeout=duration_s + 420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    server.drain(timeout=60)
+    updates_final = server.stats["updates"]
+    result = {
+        "bench": "churn_native",
+        "config": {"actors": n_actors, "killed": len(victims) * agents_per_proc,
+                   "replacements": len(victims) * agents_per_proc,
+                   "duration_s": duration_s, "host_cores": os.cpu_count()},
+        "registry_timeline": timeline,
+        "registry_full": reg_full,
+        "registry_after_kill": reg_after_kill,
+        "registry_after_join": reg_after_join,
+        "registrations_total": reg_total[0],
+        "updates_at_kill": updates_at_kill,
+        "updates_final": updates_final,
+        "server_stats": dict(server.stats),
+    }
+    server.disable_server()
+    print(json.dumps(result))
+    assert reg_full == n_actors, "fleet never fully registered"
+    assert reg_after_kill == expect_after_kill, (
+        f"registry not reaped: {reg_after_kill} != {expect_after_kill}")
+    assert reg_total[0] >= n_actors + n_repl, "replacements never registered"
+    assert updates_final > updates_at_kill, (
+        "training did not continue through the churn")
+    if "--write" in sys.argv:
+        _write_results("churn_native.json", [result])
+    return result
+
+
 def _finish(result: dict, outfile: str | None) -> None:
     """Shared SLO asserts + optional committed write for a soak result.
     Pass ``outfile=None`` to defer writing (callers with multiple result
@@ -363,6 +512,14 @@ def main():
             print("native .so unavailable; build with make -C native",
                   file=sys.stderr)
             return
+    if "--churn" in sys.argv:
+        if transport != "native":
+            print("churn mode needs the native transport (--native)",
+                  file=sys.stderr)
+            return
+        run_churn(n_actors=8 if quick else 16,
+                  duration_s=20.0 if quick else 45.0)
+        return
     if "--impala256" in sys.argv:
         # BASELINE.md north-star fleet shape: 256 async actors feeding one
         # IMPALA learner. 16 agents/proc keeps the process count sane on

@@ -51,6 +51,14 @@ def main():
                     help="synthetic env only: add potential-based distance "
                          "shaping (dense reward — learnable in tens of "
                          "epochs instead of the sparse catch signal)")
+    ap.add_argument("--raw-size", type=int, default=64,
+                    help="synthetic env only: raw board size (smaller = "
+                         "bigger sprites after downsize = easier perception)")
+    ap.add_argument("--balls", type=int, default=4,
+                    help="synthetic env only: ball drops per episode")
+    ap.add_argument("--traj-per-epoch", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="env_dir for logs/progress.txt (default: cwd)")
     args = ap.parse_args()
 
     from relayrl_tpu.envs import make_atari
@@ -58,12 +66,18 @@ def main():
 
     if args.shaped and args.env != "synthetic":
         ap.error("--shaped only applies to the synthetic env")
-    env_kwargs = {"shaped": True} if args.shaped else {}
+    env_kwargs = {}
+    if args.env == "synthetic":
+        env_kwargs = {"shaped": args.shaped, "raw_size": args.raw_size,
+                      "balls": args.balls}
     env = make_atari(args.env, frame_size=args.frame_size,
                      frame_skip=args.frame_skip,
                      frame_stack=args.frame_stack, **env_kwargs)
     h, w, c = env.obs_shape
-    hp = {"obs_shape": [h, w, c], "traj_per_epoch": 8}
+    hp = {"obs_shape": [h, w, c], "traj_per_epoch": args.traj_per_epoch}
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        hp["env_dir"] = args.out
     if args.lr is not None:
         hp["pi_lr"] = args.lr
         hp["lr"] = args.lr

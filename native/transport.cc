@@ -81,6 +81,7 @@ std::vector<uint8_t> encode_frame(uint8_t type, const uint8_t* data,
 struct Conn {
   int fd = -1;
   bool subscriber = false;
+  std::string agent_id;  // set by kFrameModelSet; enables unregister-on-drop
   std::vector<uint8_t> rbuf;
   std::deque<std::vector<uint8_t>> wqueue;
   size_t woff = 0;  // offset into wqueue.front()
@@ -89,7 +90,7 @@ struct Conn {
 };
 
 struct Event {
-  int type;  // 1 = trajectory, 2 = register
+  int type;  // 1 = trajectory, 2 = register, 3 = unregister
   std::vector<uint8_t> payload;
 };
 
@@ -226,9 +227,10 @@ class Server {
                                            e.payload.size(), &blob);
         }
       } else {
-        // Registration: RLD1 header, kind 2, id = payload.
+        // Registration (kind 2) / unregistration (kind 4): RLD1 header,
+        // id = payload.
         uint32_t magic = 0x31444C52;
-        uint8_t kind = 2;
+        uint8_t kind = e.type == 2 ? 2 : 4;
         uint32_t id_len = static_cast<uint32_t>(e.payload.size());
         blob.resize(9 + id_len);
         memcpy(blob.data(), &magic, 4);
@@ -354,6 +356,17 @@ class Server {
   }
 
   void drop(int fd) {
+    auto it = conns_.find(fd);
+    if (it != conns_.end() && !it->second.agent_id.empty()) {
+      // Elastic-fleet reaping: a registered agent whose control
+      // connection died (crash, kill -9, partition past the idle
+      // timeout) is reported so the embedding server can drop it from
+      // the registry — the reference's registry is append-only
+      // (training_server_wrapper.rs:159-163); this goes beyond it.
+      push_event(3,
+                 reinterpret_cast<const uint8_t*>(it->second.agent_id.data()),
+                 it->second.agent_id.size());
+    }
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
     conns_.erase(fd);
@@ -437,9 +450,17 @@ class Server {
         }
         return send_frame(c, kFrameModel, body.data(), body.size());
       }
-      case kFrameModelSet:
+      case kFrameModelSet: {
+        c.agent_id.assign(reinterpret_cast<const char*>(payload), len);
+        // Re-registration (a reconnected agent replaying its id): clear
+        // the stale conn's claim so its eventual drop doesn't emit an
+        // unregister for the now-live agent.
+        for (auto& [other_fd, other] : conns_)
+          if (other_fd != c.fd && other.agent_id == c.agent_id)
+            other.agent_id.clear();
         push_event(2, payload, len);
         return send_frame(c, kFrameIdLogged, nullptr, 0);
+      }
       case kFrameSubscribe:
         c.subscriber = true;
         return true;
@@ -580,8 +601,21 @@ class Client {
     if (subscribed_) {
       if (!send_frame(kFrameSubscribe, nullptr, 0)) return false;
     }
+    if (!registered_id_.empty()) {
+      // Replay the registration exactly like the Subscribe frame: a
+      // transient disconnect must not leave a live, self-healed agent
+      // unregistered (the server's drop() of the old conn emits an
+      // unregister). The IdLogged reply is discarded by the next
+      // want-filtered recv.
+      if (!send_frame(kFrameModelSet,
+                      reinterpret_cast<const uint8_t*>(registered_id_.data()),
+                      registered_id_.size()))
+        return false;
+    }
     return true;
   }
+
+  void mark_registered(const char* id) { registered_id_ = id; }
 
   // Serializes whole operations (send+recv+reconnect sequences) across
   // the threads sharing this client. Recursive: ops call send_frame /
@@ -795,6 +829,7 @@ class Client {
   uint16_t port_ = 0;
   int timeout_ms_ = 5000;
   bool subscribed_ = false;
+  std::string registered_id_;  // replayed on reconnect
   bool timed_out_ = false;
 
   std::thread reader_;
@@ -890,11 +925,24 @@ int rl_client_register(void* h, const char* id, int timeout_ms) {
   auto* c = static_cast<Client*>(h);
   std::lock_guard<std::recursive_mutex> g(c->op_mu_);
   c->set_timeout(timeout_ms);
-  if (!c->send_frame(kFrameModelSet, reinterpret_cast<const uint8_t*>(id),
-                     strlen(id)))
-    return -1;
+  const uint8_t* idb = reinterpret_cast<const uint8_t*>(id);
   Frame f;
-  return c->recv_frame(kFrameIdLogged, &f) ? 0 : -1;
+  if (c->send_frame(kFrameModelSet, idb, strlen(id)) &&
+      c->recv_frame(kFrameIdLogged, &f)) {
+    c->mark_registered(id);
+    return 0;
+  }
+  // The control conn can die between handshake and registration — the
+  // embedder may spend seconds building its policy in between (model jit),
+  // long enough for a server idle-reap or a restart. One redial + retry,
+  // like rl_client_send_traj.
+  if (c->timed_out() || !c->reconnect()) return -1;
+  if (c->send_frame(kFrameModelSet, idb, strlen(id)) &&
+      c->recv_frame(kFrameIdLogged, &f)) {
+    c->mark_registered(id);
+    return 0;
+  }
+  return -1;
 }
 
 int rl_client_send_traj(void* h, const uint8_t* data, size_t len) {

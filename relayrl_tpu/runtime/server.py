@@ -162,6 +162,7 @@ class TrainingServer:
             self.transport.on_trajectory_decoded = self._on_trajectory_decoded
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
+            self.transport.on_unregister = self._on_unregister
 
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
@@ -211,6 +212,17 @@ class TrainingServer:
         with self._registry_lock:
             if agent_id not in self.agent_ids:
                 self.agent_ids.append(agent_id)
+
+    def _on_unregister(self, agent_id: str) -> None:
+        """Elastic-fleet reaping (the reference's registry is append-only,
+        training_server_wrapper.rs:159-163): a dead agent's id leaves the
+        registry so long-lived fleets under churn don't accumulate
+        ghosts."""
+        with self._registry_lock:
+            try:
+                self.agent_ids.remove(agent_id)
+            except ValueError:
+                pass
 
     # -- staging: raw payload -> decoded trajectory (overlaps learner) --
     def _staging_loop(self) -> None:
@@ -554,6 +566,7 @@ class TrainingServer:
             self.transport.on_trajectory_decoded = self._on_trajectory_decoded
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
+            self.transport.on_unregister = self._on_unregister
         self.enable_server()
 
     def __enter__(self):

@@ -66,6 +66,10 @@ class ServerTransport(abc.ABC):
         self.on_trajectory: Callable[[str, bytes], None] = lambda *_: None
         self.get_model: Callable[[], tuple[int, bytes]] = lambda: (0, b"")
         self.on_register: Callable[[str], None] = lambda *_: None
+        # Elastic fleets: fired when a registered agent's connection dies
+        # (native transport's crash/idle detection; other backends may
+        # never call it).
+        self.on_unregister: Callable[[str], None] = lambda *_: None
         # Optional fast path: transports whose native core decodes
         # trajectories into columnar form (native batch drain) deliver
         # DecodedTrajectory objects here when the embedder sets it; raw

@@ -21,6 +21,7 @@ from relayrl_tpu.transport.probe import parse_host_port as _parse_host_port
 
 _EV_TRAJECTORY = 1
 _EV_REGISTER = 2
+_EV_UNREGISTER = 3
 
 
 def _load(lib_path: str) -> ctypes.CDLL:
@@ -162,6 +163,7 @@ class NativeServerTransportImpl(ServerTransport):
             DecodedTrajectory,
             Registration,
             RawTrajectory,
+            Unregistration,
             parse_drain,
         )
 
@@ -205,6 +207,8 @@ class NativeServerTransportImpl(ServerTransport):
                     self.on_trajectory(agent_id, payload)
                 elif isinstance(item, Registration):
                     self.on_register(item.agent_id)
+                elif isinstance(item, Unregistration):
+                    self.on_unregister(item.agent_id)
             if batch:
                 self.on_trajectory_decoded(batch)
 
@@ -233,6 +237,8 @@ class NativeServerTransportImpl(ServerTransport):
                 self.on_trajectory(agent_id, traj)
             elif ev_type.value == _EV_REGISTER:
                 self.on_register(payload.decode(errors="replace"))
+            elif ev_type.value == _EV_UNREGISTER:
+                self.on_unregister(payload.decode(errors="replace"))
 
 
 class NativeAgentTransportImpl(AgentTransport):

@@ -35,6 +35,7 @@ KIND_COLUMNAR = 0
 KIND_RAW = 1
 KIND_REGISTER = 2
 KIND_RAW_ENVELOPE = 3
+KIND_UNREGISTER = 4
 
 
 @dataclasses.dataclass
@@ -105,6 +106,14 @@ class Registration:
     agent_id: str
 
 
+@dataclasses.dataclass
+class Unregistration:
+    """A registered agent's control connection died (crash / kill -9 /
+    idle-reap): elastic-fleet registry maintenance."""
+
+    agent_id: str
+
+
 _HDR = struct.Struct("<IBI")          # magic, kind, id_len
 _COL_FIXED = struct.Struct("<BB")     # dtype, ndim (after name)
 _META = struct.Struct("<IIBH")        # n_steps, n_records, flags, n_cols
@@ -120,6 +129,8 @@ def parse_blob(view: memoryview, off: int = 0):
     off += id_len
     if kind == KIND_REGISTER:
         return Registration(agent_id), off
+    if kind == KIND_UNREGISTER:
+        return Unregistration(agent_id), off
     if kind in (KIND_RAW, KIND_RAW_ENVELOPE):
         (n,) = struct.unpack_from("<Q", view, off)
         off += 8

@@ -326,6 +326,82 @@ class TestNativeReconnect:
             server.stop()
 
 
+class TestElasticRegistry:
+    """Unregister-on-death (beyond the reference: its registry is an
+    append-only Vec, training_server_wrapper.rs:159-163). The native
+    server maps each control connection to the agent id it registered and
+    reports the id when the connection dies, so fleets under churn reap
+    ghosts."""
+
+    @pytest.fixture(autouse=True)
+    def _require_lib(self):
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C native)")
+
+    def test_unregister_fires_when_connection_dies(self, cfg):
+        port = free_port()
+        server = make_server_transport("native", cfg,
+                                       bind_addr=f"127.0.0.1:{port}")
+        regs, unregs = [], []
+        server.on_register = regs.append
+        server.on_unregister = unregs.append
+        server.start()
+        try:
+            agent = make_agent_transport("native", cfg,
+                                         server_addr=f"127.0.0.1:{port}")
+            agent.fetch_model(timeout_s=10)
+            assert agent.register("agent-A", timeout_s=10)
+            deadline = time.monotonic() + 5
+            while "agent-A" not in regs and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert regs == ["agent-A"]
+
+            agent.close()  # kernel closes the control conn (same path as
+            #                a crash/kill -9: read returns 0 server-side)
+            deadline = time.monotonic() + 10
+            while "agent-A" not in unregs and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert unregs == ["agent-A"]
+        finally:
+            server.stop()
+
+    def test_reconnect_replays_registration(self, cfg):
+        # A transient disconnect self-heals via the C++ redial; the
+        # registration must be replayed so the (restarted) server's
+        # registry still contains the live agent.
+        port = free_port()
+        server = make_server_transport("native", cfg,
+                                       bind_addr=f"127.0.0.1:{port}")
+        server.start()
+        agent = make_agent_transport("native", cfg,
+                                     server_addr=f"127.0.0.1:{port}")
+        try:
+            agent.fetch_model(timeout_s=10)
+            assert agent.register("agent-R", timeout_s=10)
+            server.stop()
+
+            server2 = make_server_transport("native", cfg,
+                                            bind_addr=f"127.0.0.1:{port}")
+            regs2 = []
+            server2.on_register = regs2.append
+            server2.start()
+            try:
+                deadline = time.monotonic() + 10
+                while "agent-R" not in regs2 and time.monotonic() < deadline:
+                    try:
+                        agent.send_trajectory(b"t")  # forces redial+replay
+                    except RuntimeError:
+                        pass
+                    time.sleep(0.1)
+                assert "agent-R" in regs2, "registration not replayed"
+            finally:
+                server2.stop()
+        finally:
+            agent.close()
+
+
 class TestGrpcTransport:
     def test_full_roundtrip(self, cfg):
         port = free_port()
