@@ -57,6 +57,10 @@ def main():
     ap.add_argument("--balls", type=int, default=4,
                     help="synthetic env only: ball drops per episode")
     ap.add_argument("--traj-per-epoch", type=int, default=8)
+    ap.add_argument("--ent-coef", type=float, default=None,
+                    help="entropy bonus (PPO/IMPALA): pixel policies "
+                         "collapse to a blind deterministic policy without "
+                         "one — 0.01 is a good start")
     ap.add_argument("--out", default=None,
                     help="env_dir for logs/progress.txt (default: cwd)")
     args = ap.parse_args()
@@ -85,6 +89,8 @@ def main():
         hp["pi_lr"] = 1e-3  # pixel PPO default; see --lr help
     if args.seed_salt is not None:
         hp["seed_salt"] = args.seed_salt
+    if args.ent_coef is not None:
+        hp["ent_coef"] = args.ent_coef
     if args.algo in ("PPO", "IMPALA"):
         hp["model_kind"] = "cnn_discrete"  # DQN/C51 switch on obs_shape alone
     runner = LocalRunner(env, algorithm_name=args.algo, **hp)

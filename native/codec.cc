@@ -779,6 +779,86 @@ void decode_payload_to_blob(const char* agent_id, const uint8_t* data,
   decode_trajectory_to_blob(agent_id, strlen(agent_id), data, len, out);
 }
 
+// ---- tiny msgpack helpers for the native gRPC plane (grpc_server.cc) ----
+// The gRPC wire bodies are msgpack (the Python backend defined the
+// contract — relayrl_tpu/transport/grpc_backend.py): ClientPoll request
+// {"id": str, "ver": int, "first": bool}; responses are built here so the
+// two native servers share one encoder.
+
+bool parse_client_poll(const uint8_t* data, size_t len, std::string* id,
+                       int64_t* ver, bool* first) {
+  Cursor c{data, data + len};
+  uint32_t n;
+  if (!read_map_len(c, &n)) return false;
+  *id = "?";
+  *ver = -1;
+  *first = false;
+  for (uint32_t i = 0; i < n; ++i) {
+    StrView key;
+    if (!read_str(c, &key)) return false;
+    Value v;
+    if (!read_value(c, &v)) return false;
+    if (key_is(key, "id") && v.kind == Value::STR) {
+      id->assign(v.s.p, v.s.len);
+    } else if (key_is(key, "ver") && v.kind == Value::INT) {
+      *ver = v.i;
+    } else if (key_is(key, "first")) {
+      *first = (v.kind == Value::BOOL && v.b);
+    }
+  }
+  return true;
+}
+
+namespace {
+void mp_key(std::vector<uint8_t>* out, const char* s) {
+  size_t n = strlen(s);
+  out->push_back(0xa0 | static_cast<uint8_t>(n));  // keys are short
+  out->insert(out->end(), s, s + n);
+}
+
+void mp_uint(std::vector<uint8_t>* out, uint64_t v) {
+  if (v < 128) {
+    out->push_back(static_cast<uint8_t>(v));
+  } else {
+    out->push_back(0xcf);
+    for (int i = 7; i >= 0; --i)
+      out->push_back(static_cast<uint8_t>(v >> (8 * i)));
+  }
+}
+}  // namespace
+
+// {"code": 1, "ver": version, "model": <bin>}
+void build_poll_model_response(uint64_t version, const uint8_t* model,
+                               size_t model_len, std::vector<uint8_t>* out) {
+  out->push_back(0x83);  // fixmap 3
+  mp_key(out, "code");
+  out->push_back(0x01);
+  mp_key(out, "ver");
+  mp_uint(out, version);
+  mp_key(out, "model");
+  out->push_back(0xc6);  // bin32
+  uint32_t n = static_cast<uint32_t>(model_len);
+  for (int i = 3; i >= 0; --i)
+    out->push_back(static_cast<uint8_t>(n >> (8 * i)));
+  out->insert(out->end(), model, model + model_len);
+}
+
+// {"code": 0, "ver": version} — long-poll timeout
+void build_poll_empty_response(uint64_t version, std::vector<uint8_t>* out) {
+  out->push_back(0x82);
+  mp_key(out, "code");
+  out->push_back(0x00);
+  mp_key(out, "ver");
+  mp_uint(out, version);
+}
+
+// {"code": 1} — SendActions ack
+void build_ack_response(std::vector<uint8_t>* out) {
+  out->push_back(0x81);
+  mp_key(out, "code");
+  out->push_back(0x01);
+}
+
 }  // namespace relayrl
 
 extern "C" {

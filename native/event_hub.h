@@ -1,0 +1,182 @@
+// Shared server-side machinery for the native transports (framed-TCP in
+// transport.cc, gRPC/HTTP-2 in grpc_server.cc): the embedder-facing event
+// queue with native batch decode, and the current-model state. One owner
+// for poll/poll_batch semantics so the two planes cannot drift.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <utility>
+#include <vector>
+
+namespace relayrl {
+
+// codec.cc
+void decode_envelope_to_blob(const uint8_t* data, size_t len,
+                             std::vector<uint8_t>* out);
+void write_raw_envelope_blob(const uint8_t* data, size_t len,
+                             std::vector<uint8_t>* out);
+
+struct HubEvent {
+  int type;  // 1 = trajectory envelope, 2 = register, 3 = unregister
+  std::vector<uint8_t> payload;
+};
+
+class EventHub {
+ public:
+  void push_event(int type, const uint8_t* payload, size_t len) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      HubEvent e;
+      e.type = type;
+      e.payload.assign(payload, payload + len);
+      events_.push_back(std::move(e));
+    }
+    cv_.notify_one();
+  }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  void reset() {  // server restart: polls block again
+    std::lock_guard<std::mutex> g(mu_);
+    shutdown_ = false;
+  }
+
+  // Returns payload size and consumes the event when it fits in cap;
+  // returns required size (without consuming) when cap is too small;
+  // returns -1 on timeout.
+  long poll(int timeout_ms, int* ev_type, uint8_t* buf, size_t cap) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [this] { return !events_.empty() || shutdown_; }))
+      return -1;
+    if (events_.empty()) return -1;
+    HubEvent& e = events_.front();
+    *ev_type = e.type;
+    if (e.payload.size() > cap) return static_cast<long>(e.payload.size());
+    memcpy(buf, e.payload.data(), e.payload.size());
+    long n = static_cast<long>(e.payload.size());
+    events_.pop_front();
+    return n;
+  }
+
+  // Batch drain with native decode: waits for >=1 queued event, drains up
+  // to max_items, decoding each trajectory envelope into a columnar RLD1
+  // blob (codec.cc) OUTSIDE the lock — the embedding Python thread calls
+  // this through ctypes with the GIL released. Output holds u64-length-
+  // prefixed blobs; blobs that don't fit stay pending for the next call.
+  // Returns bytes written (*n_items set), the required size when even the
+  // first blob doesn't fit, or -1 on timeout.
+  long poll_batch(int timeout_ms, int max_items, uint8_t* buf, size_t cap,
+                  int* n_items) {
+    *n_items = 0;
+    std::vector<HubEvent> local;
+    std::deque<std::vector<uint8_t>> blobs;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (pending_blobs_.empty() &&
+          !cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [this] { return !events_.empty() || shutdown_; }))
+        return -1;
+      blobs.swap(pending_blobs_);
+      long budget =
+          static_cast<long>(max_items) - static_cast<long>(blobs.size());
+      while (budget-- > 0 && !events_.empty()) {
+        local.push_back(std::move(events_.front()));
+        events_.pop_front();
+      }
+    }
+    if (local.empty() && blobs.empty()) return -1;
+    for (HubEvent& e : local) {
+      std::vector<uint8_t> blob;
+      if (e.type == 1) {
+        try {
+          decode_envelope_to_blob(e.payload.data(), e.payload.size(), &blob);
+        } catch (...) {
+          // Decoder exception (e.g. bad_alloc on a pathological payload):
+          // hand the raw envelope to Python so its decoder decides — never
+          // unwind through the poll call.
+          blob.clear();
+          write_raw_envelope_blob(e.payload.data(), e.payload.size(), &blob);
+        }
+      } else {
+        // Registration (kind 2) / unregistration (kind 4): RLD1 header,
+        // id = payload.
+        uint32_t magic = 0x31444C52;
+        uint8_t kind = e.type == 2 ? 2 : 4;
+        uint32_t id_len = static_cast<uint32_t>(e.payload.size());
+        blob.resize(9 + id_len);
+        memcpy(blob.data(), &magic, 4);
+        blob[4] = kind;
+        memcpy(blob.data() + 5, &id_len, 4);
+        if (id_len) memcpy(blob.data() + 9, e.payload.data(), id_len);
+      }
+      blobs.push_back(std::move(blob));
+    }
+    size_t used = 0;
+    int packed = 0;
+    while (!blobs.empty()) {
+      std::vector<uint8_t>& b = blobs.front();
+      size_t need = 8 + b.size();
+      if (used + need > cap) break;
+      uint64_t blen = b.size();
+      memcpy(buf + used, &blen, 8);
+      memcpy(buf + used + 8, b.data(), b.size());
+      used += need;
+      ++packed;
+      blobs.pop_front();
+    }
+    long required = 0;
+    if (!blobs.empty()) {
+      required = static_cast<long>(8 + blobs.front().size());
+      std::lock_guard<std::mutex> lk(mu_);
+      while (!blobs.empty()) {
+        pending_blobs_.push_front(std::move(blobs.back()));
+        blobs.pop_back();
+      }
+    }
+    if (packed == 0) return required;  // grow-and-retry signal
+    *n_items = packed;
+    return static_cast<long>(used);
+  }
+
+  // -- current model --
+  void set_model(uint64_t version, const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> g(model_mu_);
+    model_version_ = version;
+    model_.assign(data, data + len);
+  }
+
+  uint64_t model_version() {
+    std::lock_guard<std::mutex> g(model_mu_);
+    return model_version_;
+  }
+
+  std::pair<uint64_t, std::vector<uint8_t>> model_copy() {
+    std::lock_guard<std::mutex> g(model_mu_);
+    return {model_version_, model_};
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<HubEvent> events_;
+  std::deque<std::vector<uint8_t>> pending_blobs_;
+  bool shutdown_ = false;
+
+  std::mutex model_mu_;
+  uint64_t model_version_ = 0;
+  std::vector<uint8_t> model_;
+};
+
+}  // namespace relayrl

@@ -1,0 +1,907 @@
+// Native gRPC server for the two-RPC RelayRL surface.
+//
+// The reference's gRPC plane is native (tonic/prost — relayrl_framework/
+// src/network/server/training_grpc.rs:104-798). This is the C++
+// equivalent: a from-scratch minimal HTTP/2 server (this image ships no
+// grpc++/nghttp2) speaking exactly the gRPC wire protocol the Python
+// grpcio agents already use — service relayrl.RelayRLRoute with unary
+// SendActions (trajectory envelope in, msgpack ack out) and ClientPoll
+// (long-poll: parks the stream until a newer model publishes or the idle
+// timeout lapses; msgpack bodies as defined by
+// relayrl_tpu/transport/grpc_backend.py).
+//
+// HTTP/2 subset (RFC 7540) — deliberately minimal but interoperable with
+// grpc-python's chttp2 client (wire-verified):
+//   * frames: SETTINGS/WINDOW_UPDATE/HEADERS/CONTINUATION/DATA/PING/
+//     RST_STREAM/GOAWAY; PRIORITY ignored
+//   * HPACK (RFC 7541): full static+dynamic tables, all literal forms,
+//     table-size updates. Huffman-coded strings that must be READ
+//     (routing/dynamic-table entries) are rejected with a GOAWAY —
+//     grpc-python sends plain literals (captured: 0x40 literals, no H
+//     bit); a Huffman-only client is out of scope and fails loudly.
+//   * flow control: honors the peer's connection+stream send windows and
+//     SETTINGS_INITIAL_WINDOW_SIZE / MAX_FRAME_SIZE; grants the peer a
+//     large receive window up front.
+//
+// Embedder surface mirrors the framed server (EventHub): trajectory
+// envelopes and first-time registrations queue for rl_grpc_server_poll /
+// _poll_batch (native columnar decode); set_model/broadcast wake parked
+// long-polls.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "event_hub.h"
+
+namespace relayrl {
+// codec.cc msgpack helpers for the gRPC bodies
+bool parse_client_poll(const uint8_t* data, size_t len, std::string* id,
+                       int64_t* ver, bool* first);
+void build_poll_model_response(uint64_t version, const uint8_t* model,
+                               size_t model_len, std::vector<uint8_t>* out);
+void build_poll_empty_response(uint64_t version, std::vector<uint8_t>* out);
+void build_ack_response(std::vector<uint8_t>* out);
+}  // namespace relayrl
+
+namespace {
+
+using clock_t_ = std::chrono::steady_clock;
+
+// ---------------- HPACK ----------------
+
+struct HpackEntry {
+  std::string name, value;
+};
+
+// RFC 7541 Appendix A static table (1-based indices 1..61).
+const HpackEntry kHpackStatic[62] = {
+    {"", ""},
+    {":authority", ""},
+    {":method", "GET"},
+    {":method", "POST"},
+    {":path", "/"},
+    {":path", "/index.html"},
+    {":scheme", "http"},
+    {":scheme", "https"},
+    {":status", "200"},
+    {":status", "204"},
+    {":status", "206"},
+    {":status", "304"},
+    {":status", "400"},
+    {":status", "404"},
+    {":status", "500"},
+    {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"},
+    {"accept-language", ""},
+    {"accept-ranges", ""},
+    {"accept", ""},
+    {"access-control-allow-origin", ""},
+    {"age", ""},
+    {"allow", ""},
+    {"authorization", ""},
+    {"cache-control", ""},
+    {"content-disposition", ""},
+    {"content-encoding", ""},
+    {"content-language", ""},
+    {"content-length", ""},
+    {"content-location", ""},
+    {"content-range", ""},
+    {"content-type", ""},
+    {"cookie", ""},
+    {"date", ""},
+    {"etag", ""},
+    {"expect", ""},
+    {"expires", ""},
+    {"from", ""},
+    {"host", ""},
+    {"if-match", ""},
+    {"if-modified-since", ""},
+    {"if-none-match", ""},
+    {"if-range", ""},
+    {"if-unmodified-since", ""},
+    {"last-modified", ""},
+    {"link", ""},
+    {"location", ""},
+    {"max-forwards", ""},
+    {"proxy-authenticate", ""},
+    {"proxy-authorization", ""},
+    {"range", ""},
+    {"referer", ""},
+    {"refresh", ""},
+    {"retry-after", ""},
+    {"server", ""},
+    {"set-cookie", ""},
+    {"strict-transport-security", ""},
+    {"transfer-encoding", ""},
+    {"user-agent", ""},
+    {"vary", ""},
+    {"via", ""},
+    {"www-authenticate", ""},
+};
+
+class HpackDecoder {
+ public:
+  // Decodes a complete header block. Returns false on malformed input or
+  // a Huffman-coded string (unsupported; see file header).
+  bool decode(const uint8_t* p, size_t len,
+              std::vector<HpackEntry>* out) {
+    const uint8_t* end = p + len;
+    while (p < end) {
+      uint8_t b = *p;
+      if (b & 0x80) {  // indexed header field
+        uint64_t idx;
+        if (!read_int(&p, end, 7, &idx) || idx == 0) return false;
+        HpackEntry e;
+        if (!lookup(idx, &e)) return false;
+        out->push_back(std::move(e));
+      } else if (b & 0x40) {  // literal with incremental indexing
+        uint64_t idx;
+        if (!read_int(&p, end, 6, &idx)) return false;
+        HpackEntry e;
+        if (idx) {
+          if (!lookup(idx, &e)) return false;
+          e.value.clear();
+        } else if (!read_string(&p, end, &e.name)) {
+          return false;
+        }
+        if (!read_string(&p, end, &e.value)) return false;
+        insert(e);
+        out->push_back(std::move(e));
+      } else if (b & 0x20) {  // dynamic table size update
+        uint64_t sz;
+        if (!read_int(&p, end, 5, &sz)) return false;
+        max_size_ = sz;
+        evict();
+      } else {  // literal without indexing (0x00) / never indexed (0x10)
+        uint64_t idx;
+        if (!read_int(&p, end, 4, &idx)) return false;
+        HpackEntry e;
+        if (idx) {
+          if (!lookup(idx, &e)) return false;
+          e.value.clear();
+        } else if (!read_string(&p, end, &e.name)) {
+          return false;
+        }
+        if (!read_string(&p, end, &e.value)) return false;
+        out->push_back(std::move(e));
+      }
+    }
+    return true;
+  }
+
+ private:
+  bool lookup(uint64_t idx, HpackEntry* out) {
+    if (idx >= 1 && idx <= 61) {
+      *out = kHpackStatic[idx];
+      return true;
+    }
+    size_t d = idx - 62;
+    if (d >= dynamic_.size()) return false;
+    *out = dynamic_[d];
+    return true;
+  }
+
+  void insert(const HpackEntry& e) {
+    dyn_bytes_ += e.name.size() + e.value.size() + 32;
+    dynamic_.push_front(e);
+    evict();
+  }
+
+  void evict() {
+    while (dyn_bytes_ > max_size_ && !dynamic_.empty()) {
+      const HpackEntry& old = dynamic_.back();
+      dyn_bytes_ -= old.name.size() + old.value.size() + 32;
+      dynamic_.pop_back();
+    }
+  }
+
+  static bool read_int(const uint8_t** p, const uint8_t* end, int prefix,
+                       uint64_t* out) {
+    if (*p >= end) return false;
+    uint64_t max_prefix = (1u << prefix) - 1;
+    uint64_t v = **p & max_prefix;
+    ++*p;
+    if (v < max_prefix) {
+      *out = v;
+      return true;
+    }
+    int shift = 0;
+    while (*p < end) {
+      uint8_t b = **p;
+      ++*p;
+      v += static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) {
+        *out = v;
+        return true;
+      }
+      shift += 7;
+      if (shift > 56) return false;
+    }
+    return false;
+  }
+
+  static bool read_string(const uint8_t** p, const uint8_t* end,
+                          std::string* out) {
+    if (*p >= end) return false;
+    bool huffman = (**p & 0x80) != 0;
+    uint64_t n;
+    if (!read_int(p, end, 7, &n)) return false;
+    if (static_cast<uint64_t>(end - *p) < n) return false;
+    if (huffman) return false;  // unsupported (see file header)
+    out->assign(reinterpret_cast<const char*>(*p), n);
+    *p += n;
+    return true;
+  }
+
+  std::deque<HpackEntry> dynamic_;
+  size_t dyn_bytes_ = 0;
+  size_t max_size_ = 4096;
+};
+
+// Minimal HPACK encoding for responses: indexed static for :status 200
+// (0x88), literal-without-indexing for everything else — stateless, so no
+// encoder dynamic table to manage.
+void hpack_emit_literal(std::vector<uint8_t>* out, const std::string& name,
+                        const std::string& value) {
+  out->push_back(0x00);  // literal w/o indexing, new name
+  out->push_back(static_cast<uint8_t>(name.size()));  // short, no huffman
+  out->insert(out->end(), name.begin(), name.end());
+  // values can exceed 126 bytes in principle; ours never do
+  out->push_back(static_cast<uint8_t>(value.size()));
+  out->insert(out->end(), value.begin(), value.end());
+}
+
+// ---------------- HTTP/2 plumbing ----------------
+
+constexpr uint8_t kFrameData = 0x0, kFrameHeaders = 0x1, kFramePriority = 0x2,
+                  kFrameRst = 0x3, kFrameSettings = 0x4, kFramePing = 0x6,
+                  kFrameGoaway = 0x7, kFrameWindowUpdate = 0x8,
+                  kFrameContinuation = 0x9;
+constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
+                  kFlagPadded = 0x8, kFlagPriority = 0x20;
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+
+void put_frame_header(std::vector<uint8_t>* out, size_t len, uint8_t type,
+                      uint8_t flags, uint32_t stream) {
+  out->push_back(static_cast<uint8_t>(len >> 16));
+  out->push_back(static_cast<uint8_t>(len >> 8));
+  out->push_back(static_cast<uint8_t>(len));
+  out->push_back(type);
+  out->push_back(flags);
+  out->push_back(static_cast<uint8_t>(stream >> 24));
+  out->push_back(static_cast<uint8_t>(stream >> 16));
+  out->push_back(static_cast<uint8_t>(stream >> 8));
+  out->push_back(static_cast<uint8_t>(stream));
+}
+
+struct Stream {
+  uint32_t id = 0;
+  std::string path;
+  std::vector<uint8_t> body;          // request grpc bytes
+  bool end_stream = false;
+  int64_t send_window = 65535;        // peer-granted, for our DATA
+  std::deque<uint8_t> outq;           // response DATA pending flow control
+  bool trailers_pending = false;      // send trailers once outq drains
+  // long-poll state
+  bool parked = false;
+  int64_t known_ver = -1;
+  clock_t_::time_point park_deadline;
+};
+
+struct GConn {
+  int fd = -1;
+  bool preface_done = false;
+  std::vector<uint8_t> rbuf;
+  std::deque<std::vector<uint8_t>> wq;
+  size_t woff = 0;
+  HpackDecoder hpack;
+  std::map<uint32_t, Stream> streams;
+  int64_t conn_send_window = 65535;
+  uint32_t peer_max_frame = 16384;
+  int64_t peer_initial_window = 65535;
+  // in-flight header block (HEADERS + CONTINUATIONs)
+  std::vector<uint8_t> header_block;
+  uint32_t header_stream = 0;
+  bool header_end_stream = false;
+  bool collecting_headers = false;
+};
+
+bool g_set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+class GrpcServer {
+ public:
+  ~GrpcServer() { stop(); }
+
+  bool create(const char* host, uint16_t port) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    if (listen(listen_fd_, 128) != 0) return false;
+    socklen_t slen = sizeof(addr);
+    if (getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &slen) == 0)
+      port_ = ntohs(addr.sin_port);
+    return g_set_nonblocking(listen_fd_);
+  }
+
+  bool start() {
+    wake_fd_ = eventfd(0, EFD_NONBLOCK);
+    epoll_fd_ = epoll_create1(0);
+    if (wake_fd_ < 0 || epoll_fd_ < 0) return false;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    hub_.reset();
+    running_.store(true);
+    loop_ = std::thread([this] { run(); });
+    return true;
+  }
+
+  void stop() {
+    hub_.shutdown();
+    if (!running_.exchange(false)) {
+      cleanup_fds();
+      return;
+    }
+    wake();
+    if (loop_.joinable()) loop_.join();
+    cleanup_fds();
+  }
+
+  void set_model(uint64_t version, const uint8_t* data, size_t len) {
+    hub_.set_model(version, data, len);
+  }
+
+  void broadcast(uint64_t version, const uint8_t* data, size_t len) {
+    hub_.set_model(version, data, len);
+    model_bumped_.store(true);
+    wake();
+  }
+
+  long poll(int timeout_ms, int* ev_type, uint8_t* buf, size_t cap) {
+    return hub_.poll(timeout_ms, ev_type, buf, cap);
+  }
+
+  long poll_batch(int timeout_ms, int max_items, uint8_t* buf, size_t cap,
+                  int* n_items) {
+    return hub_.poll_batch(timeout_ms, max_items, buf, cap, n_items);
+  }
+
+  void set_idle_timeout(int ms) { idle_timeout_ms_.store(ms); }
+
+  uint16_t port() const { return port_; }
+
+ private:
+  void wake() {
+    if (wake_fd_ >= 0) {
+      uint64_t one = 1;
+      ssize_t r = write(wake_fd_, &one, sizeof(one));
+      (void)r;
+    }
+  }
+
+  void cleanup_fds() {
+    for (auto& [fd, conn] : conns_) close(fd);
+    conns_.clear();
+    if (listen_fd_ >= 0) close(listen_fd_), listen_fd_ = -1;
+    if (wake_fd_ >= 0) close(wake_fd_), wake_fd_ = -1;
+    if (epoll_fd_ >= 0) close(epoll_fd_), epoll_fd_ = -1;
+  }
+
+  void run() {
+    std::vector<epoll_event> evs(64);
+    while (running_.load()) {
+      int n = epoll_wait(epoll_fd_, evs.data(), evs.size(), 100);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      for (int i = 0; i < n; ++i) {
+        int fd = evs[i].data.fd;
+        if (fd == listen_fd_) {
+          accept_new();
+        } else if (fd == wake_fd_) {
+          uint64_t drain;
+          while (read(wake_fd_, &drain, sizeof(drain)) > 0) {
+          }
+        } else {
+          auto it = conns_.find(fd);
+          if (it == conns_.end()) continue;
+          bool ok = true;
+          if (evs[i].events & (EPOLLHUP | EPOLLERR))
+            ok = false;
+          else {
+            if (evs[i].events & EPOLLIN) ok = handle_read(it->second);
+            if (ok && (evs[i].events & EPOLLOUT)) ok = flush(it->second);
+          }
+          if (!ok) drop(fd);
+        }
+      }
+      if (model_bumped_.exchange(false)) wake_parked(false);
+      expire_parked();
+    }
+  }
+
+  void accept_new() {
+    while (true) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      g_set_nonblocking(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      GConn& c = conns_[fd];
+      c.fd = fd;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+      // our SETTINGS (empty: defaults are fine) + a big connection window
+      std::vector<uint8_t> out;
+      put_frame_header(&out, 0, kFrameSettings, 0, 0);
+      put_frame_header(&out, 4, kFrameWindowUpdate, 0, 0);
+      uint32_t grant = (1u << 30) - 65535;
+      out.push_back(grant >> 24);
+      out.push_back(grant >> 16);
+      out.push_back(grant >> 8);
+      out.push_back(grant);
+      queue_bytes(c, std::move(out));
+      flush(c);
+    }
+  }
+
+  void drop(int fd) {
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns_.erase(fd);
+  }
+
+  bool handle_read(GConn& c) {
+    char tmp[65536];
+    size_t budget = 1 << 20;
+    while (budget > 0) {
+      ssize_t r = recv(c.fd, tmp, std::min(sizeof(tmp), budget), 0);
+      if (r > 0) {
+        c.rbuf.insert(c.rbuf.end(), tmp, tmp + r);
+        budget -= static_cast<size_t>(r);
+      } else if (r == 0) {
+        return false;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        return false;
+      }
+    }
+    size_t off = 0;
+    if (!c.preface_done) {
+      if (c.rbuf.size() < kPrefaceLen) return true;
+      if (memcmp(c.rbuf.data(), kPreface, kPrefaceLen) != 0) {
+        fprintf(stderr,
+                "[relayrl-grpc] peer did not send the HTTP/2 preface — "
+                "server_type mismatch, dropping connection\n");
+        return false;
+      }
+      c.preface_done = true;
+      off = kPrefaceLen;
+    }
+    while (c.rbuf.size() - off >= 9) {
+      size_t len = (static_cast<size_t>(c.rbuf[off]) << 16) |
+                   (static_cast<size_t>(c.rbuf[off + 1]) << 8) |
+                   c.rbuf[off + 2];
+      if (len > (1u << 24)) return false;
+      if (c.rbuf.size() - off < 9 + len) break;
+      uint8_t type = c.rbuf[off + 3];
+      uint8_t flags = c.rbuf[off + 4];
+      uint32_t stream = ((static_cast<uint32_t>(c.rbuf[off + 5]) << 24) |
+                         (static_cast<uint32_t>(c.rbuf[off + 6]) << 16) |
+                         (static_cast<uint32_t>(c.rbuf[off + 7]) << 8) |
+                         c.rbuf[off + 8]) &
+                        0x7fffffff;
+      if (!handle_frame(c, type, flags, stream, c.rbuf.data() + off + 9, len))
+        return false;
+      off += 9 + len;
+    }
+    if (off) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
+    return true;
+  }
+
+  bool handle_frame(GConn& c, uint8_t type, uint8_t flags, uint32_t stream,
+                    const uint8_t* p, size_t len) {
+    switch (type) {
+      case kFrameSettings: {
+        if (flags & kFlagAck) return true;
+        for (size_t i = 0; i + 6 <= len; i += 6) {
+          uint16_t id = (p[i] << 8) | p[i + 1];
+          uint32_t val = (static_cast<uint32_t>(p[i + 2]) << 24) |
+                         (static_cast<uint32_t>(p[i + 3]) << 16) |
+                         (static_cast<uint32_t>(p[i + 4]) << 8) | p[i + 5];
+          if (id == 4) {  // INITIAL_WINDOW_SIZE: adjust open streams
+            int64_t delta =
+                static_cast<int64_t>(val) - c.peer_initial_window;
+            c.peer_initial_window = val;
+            for (auto& [sid, s] : c.streams) s.send_window += delta;
+          } else if (id == 5) {
+            c.peer_max_frame = val;
+          }
+        }
+        std::vector<uint8_t> out;
+        put_frame_header(&out, 0, kFrameSettings, kFlagAck, 0);
+        queue_bytes(c, std::move(out));
+        return flush(c);
+      }
+      case kFrameWindowUpdate: {
+        if (len != 4) return false;
+        uint32_t inc = ((static_cast<uint32_t>(p[0]) << 24) |
+                        (static_cast<uint32_t>(p[1]) << 16) |
+                        (static_cast<uint32_t>(p[2]) << 8) | p[3]) &
+                       0x7fffffff;
+        if (stream == 0) {
+          c.conn_send_window += inc;
+        } else {
+          auto it = c.streams.find(stream);
+          if (it != c.streams.end()) it->second.send_window += inc;
+        }
+        return pump_streams(c);
+      }
+      case kFramePing: {
+        if (flags & kFlagAck) return true;
+        std::vector<uint8_t> out;
+        put_frame_header(&out, len, kFramePing, kFlagAck, 0);
+        out.insert(out.end(), p, p + len);
+        queue_bytes(c, std::move(out));
+        return flush(c);
+      }
+      case kFrameHeaders: {
+        size_t pad = 0, skip = 0;
+        if (flags & kFlagPadded) {
+          if (len < 1) return false;
+          pad = p[0];
+          skip = 1;
+        }
+        if (flags & kFlagPriority) skip += 5;
+        if (skip + pad > len) return false;
+        c.header_block.assign(p + skip, p + len - pad);
+        c.header_stream = stream;
+        c.header_end_stream = (flags & kFlagEndStream) != 0;
+        c.collecting_headers = true;
+        if (flags & kFlagEndHeaders) return finish_headers(c);
+        return true;
+      }
+      case kFrameContinuation: {
+        if (!c.collecting_headers || stream != c.header_stream) return false;
+        c.header_block.insert(c.header_block.end(), p, p + len);
+        if (flags & kFlagEndHeaders) return finish_headers(c);
+        return true;
+      }
+      case kFrameData: {
+        size_t pad = 0, skip = 0;
+        if (flags & kFlagPadded) {
+          if (len < 1) return false;
+          pad = p[0];
+          skip = 1;
+        }
+        if (skip + pad > len) return false;
+        auto it = c.streams.find(stream);
+        if (it == c.streams.end()) return true;  // canceled stream
+        Stream& s = it->second;
+        s.body.insert(s.body.end(), p + skip, p + len - pad);
+        if (s.body.size() > (1u << 30)) return false;
+        // replenish the peer's send budget promptly (conn + stream)
+        std::vector<uint8_t> out;
+        uint32_t inc = static_cast<uint32_t>(len);
+        if (inc) {
+          put_frame_header(&out, 4, kFrameWindowUpdate, 0, 0);
+          out.push_back(inc >> 24);
+          out.push_back(inc >> 16);
+          out.push_back(inc >> 8);
+          out.push_back(inc);
+          put_frame_header(&out, 4, kFrameWindowUpdate, 0, stream);
+          out.push_back(inc >> 24);
+          out.push_back(inc >> 16);
+          out.push_back(inc >> 8);
+          out.push_back(inc);
+          queue_bytes(c, std::move(out));
+        }
+        if (flags & kFlagEndStream) return dispatch(c, s);
+        return flush(c);
+      }
+      case kFrameRst: {
+        c.streams.erase(stream);  // canceled long-poll etc.
+        return true;
+      }
+      case kFrameGoaway:
+        return false;  // peer is leaving; close after this read
+      case kFramePriority:
+      default:
+        return true;  // ignore
+    }
+  }
+
+  bool finish_headers(GConn& c) {
+    c.collecting_headers = false;
+    std::vector<HpackEntry> headers;
+    if (!c.hpack.decode(c.header_block.data(), c.header_block.size(),
+                        &headers)) {
+      fprintf(stderr,
+              "[relayrl-grpc] unsupported/malformed HPACK block "
+              "(Huffman-coded client?) — closing connection\n");
+      return false;
+    }
+    Stream& s = c.streams[c.header_stream];
+    s.id = c.header_stream;
+    s.send_window = c.peer_initial_window;
+    for (const HpackEntry& h : headers)
+      if (h.name == ":path") s.path = h.value;
+    if (c.header_end_stream) return dispatch(c, s);
+    return true;
+  }
+
+  bool dispatch(GConn& c, Stream& s) {
+    // grpc framing: u8 compressed | u32 len BE | message
+    const uint8_t* msg = nullptr;
+    size_t msg_len = 0;
+    if (s.body.size() >= 5) {
+      uint32_t n = (static_cast<uint32_t>(s.body[1]) << 24) |
+                   (static_cast<uint32_t>(s.body[2]) << 16) |
+                   (static_cast<uint32_t>(s.body[3]) << 8) | s.body[4];
+      if (s.body[0] == 0 && 5 + static_cast<size_t>(n) <= s.body.size()) {
+        msg = s.body.data() + 5;
+        msg_len = n;
+      }
+    }
+    if (s.path == "/relayrl.RelayRLRoute/SendActions") {
+      if (msg) hub_.push_event(1, msg, msg_len);
+      std::vector<uint8_t> resp;
+      relayrl::build_ack_response(&resp);
+      return respond(c, s, resp);
+    }
+    if (s.path == "/relayrl.RelayRLRoute/ClientPoll") {
+      std::string id;
+      int64_t ver = -1;
+      bool first = false;
+      if (msg) relayrl::parse_client_poll(msg, msg_len, &id, &ver, &first);
+      if (first)
+        hub_.push_event(2, reinterpret_cast<const uint8_t*>(id.data()),
+                        id.size());
+      auto [version, model] = hub_.model_copy();
+      if (first || static_cast<int64_t>(version) > ver) {
+        std::vector<uint8_t> resp;
+        relayrl::build_poll_model_response(version, model.data(),
+                                           model.size(), &resp);
+        return respond(c, s, resp);
+      }
+      // park: answered on the next broadcast or at the idle timeout
+      s.parked = true;
+      s.known_ver = ver;
+      s.park_deadline = clock_t_::now() + std::chrono::milliseconds(
+                                              idle_timeout_ms_.load());
+      s.body.clear();
+      return true;
+    }
+    // unknown method: grpc-status 12 UNIMPLEMENTED via trailers-only
+    std::vector<uint8_t> block;
+    block.push_back(0x88);  // :status 200
+    hpack_emit_literal(&block, "content-type", "application/grpc");
+    hpack_emit_literal(&block, "grpc-status", "12");
+    std::vector<uint8_t> out;
+    put_frame_header(&out, block.size(), kFrameHeaders,
+                     kFlagEndHeaders | kFlagEndStream, s.id);
+    out.insert(out.end(), block.begin(), block.end());
+    queue_bytes(c, std::move(out));
+    c.streams.erase(s.id);
+    return flush(c);
+  }
+
+  // Queue the unary response: HEADERS, DATA (flow-controlled), trailers.
+  bool respond(GConn& c, Stream& s, const std::vector<uint8_t>& grpc_msg) {
+    std::vector<uint8_t> block;
+    block.push_back(0x88);  // :status 200 (static idx 8)
+    hpack_emit_literal(&block, "content-type", "application/grpc");
+    std::vector<uint8_t> out;
+    put_frame_header(&out, block.size(), kFrameHeaders, kFlagEndHeaders, s.id);
+    out.insert(out.end(), block.begin(), block.end());
+    queue_bytes(c, std::move(out));
+    // grpc message framing into the stream's flow-controlled out queue
+    s.outq.push_back(0);
+    uint32_t n = static_cast<uint32_t>(grpc_msg.size());
+    s.outq.push_back(n >> 24);
+    s.outq.push_back(n >> 16);
+    s.outq.push_back(n >> 8);
+    s.outq.push_back(n);
+    s.outq.insert(s.outq.end(), grpc_msg.begin(), grpc_msg.end());
+    s.trailers_pending = true;
+    s.parked = false;
+    s.body.clear();
+    return pump_streams(c);
+  }
+
+  // Move stream outq bytes into DATA frames within flow-control limits;
+  // emit trailers when a stream fully drains.
+  bool pump_streams(GConn& c) {
+    std::vector<uint32_t> done;
+    for (auto& [sid, s] : c.streams) {
+      while (!s.outq.empty() && c.conn_send_window > 0 && s.send_window > 0) {
+        size_t chunk = std::min<size_t>(
+            {s.outq.size(), static_cast<size_t>(c.conn_send_window),
+             static_cast<size_t>(s.send_window),
+             static_cast<size_t>(c.peer_max_frame)});
+        std::vector<uint8_t> out;
+        put_frame_header(&out, chunk, kFrameData, 0, sid);
+        out.insert(out.end(), s.outq.begin(), s.outq.begin() + chunk);
+        s.outq.erase(s.outq.begin(), s.outq.begin() + chunk);
+        c.conn_send_window -= chunk;
+        s.send_window -= chunk;
+        queue_bytes(c, std::move(out));
+      }
+      if (s.outq.empty() && s.trailers_pending) {
+        std::vector<uint8_t> block;
+        hpack_emit_literal(&block, "grpc-status", "0");
+        std::vector<uint8_t> out;
+        put_frame_header(&out, block.size(), kFrameHeaders,
+                         kFlagEndHeaders | kFlagEndStream, sid);
+        out.insert(out.end(), block.begin(), block.end());
+        queue_bytes(c, std::move(out));
+        s.trailers_pending = false;
+        done.push_back(sid);
+      }
+    }
+    for (uint32_t sid : done) c.streams.erase(sid);
+    return flush(c);
+  }
+
+  void wake_parked(bool timed_out_only) {
+    auto now = clock_t_::now();
+    auto [version, model] = hub_.model_copy();
+    for (auto& [fd, c] : conns_) {
+      // Collect first: respond() -> pump_streams() erases finished
+      // streams, which would invalidate a live streams iterator.
+      std::vector<uint32_t> ready;
+      for (auto& [sid, s] : c.streams) {
+        if (!s.parked) continue;
+        bool expired = now >= s.park_deadline;
+        bool newer = static_cast<int64_t>(version) > s.known_ver;
+        if (timed_out_only ? expired : (newer || expired))
+          ready.push_back(sid);
+      }
+      for (uint32_t sid : ready) {
+        auto it = c.streams.find(sid);
+        if (it == c.streams.end()) continue;
+        Stream& s = it->second;
+        bool newer = static_cast<int64_t>(version) > s.known_ver;
+        std::vector<uint8_t> resp;
+        if (newer)
+          relayrl::build_poll_model_response(version, model.data(),
+                                             model.size(), &resp);
+        else
+          relayrl::build_poll_empty_response(version, &resp);
+        respond(c, s, resp);
+      }
+    }
+  }
+
+  void expire_parked() { wake_parked(true); }
+
+  void queue_bytes(GConn& c, std::vector<uint8_t> bytes) {
+    c.wq.push_back(std::move(bytes));
+  }
+
+  bool flush(GConn& c) {
+    while (!c.wq.empty()) {
+      auto& front = c.wq.front();
+      ssize_t r = send(c.fd, front.data() + c.woff, front.size() - c.woff,
+                       MSG_NOSIGNAL);
+      if (r >= 0) {
+        c.woff += r;
+        if (c.woff == front.size()) {
+          c.wq.pop_front();
+          c.woff = 0;
+        }
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.fd = c.fd;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+        return true;
+      } else if (errno == EINTR) {
+        continue;
+      } else {
+        return false;
+      }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = c.fd;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c.fd, &ev);
+    return true;
+  }
+
+  int listen_fd_ = -1, epoll_fd_ = -1, wake_fd_ = -1;
+  uint16_t port_ = 0;
+  std::atomic<bool> running_{false};
+  std::atomic<bool> model_bumped_{false};
+  std::atomic<int> idle_timeout_ms_{30000};
+  std::thread loop_;
+  std::map<int, GConn> conns_;
+  relayrl::EventHub hub_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rl_grpc_server_create(const char* host, uint16_t port) {
+  auto* s = new GrpcServer();
+  if (!s->create(host, port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int rl_grpc_server_start(void* h) {
+  return static_cast<GrpcServer*>(h)->start() ? 0 : -1;
+}
+void rl_grpc_server_stop(void* h) { static_cast<GrpcServer*>(h)->stop(); }
+void rl_grpc_server_destroy(void* h) { delete static_cast<GrpcServer*>(h); }
+uint16_t rl_grpc_server_port(void* h) {
+  return static_cast<GrpcServer*>(h)->port();
+}
+
+void rl_grpc_server_set_model(void* h, uint64_t version, const uint8_t* data,
+                              size_t len) {
+  static_cast<GrpcServer*>(h)->set_model(version, data, len);
+}
+
+void rl_grpc_server_broadcast(void* h, uint64_t version, const uint8_t* data,
+                              size_t len) {
+  static_cast<GrpcServer*>(h)->broadcast(version, data, len);
+}
+
+void rl_grpc_server_set_idle_timeout(void* h, int ms) {
+  static_cast<GrpcServer*>(h)->set_idle_timeout(ms);
+}
+
+long rl_grpc_server_poll(void* h, int timeout_ms, int* ev_type, uint8_t* buf,
+                         size_t cap) {
+  return static_cast<GrpcServer*>(h)->poll(timeout_ms, ev_type, buf, cap);
+}
+
+long rl_grpc_server_poll_batch(void* h, int timeout_ms, int max_items,
+                               uint8_t* buf, size_t cap, int* n_items) {
+  return static_cast<GrpcServer*>(h)->poll_batch(timeout_ms, max_items, buf,
+                                                 cap, n_items);
+}
+
+}  // extern "C"

@@ -39,14 +39,7 @@
 #include <thread>
 #include <vector>
 
-namespace relayrl {
-// codec.cc: trajectory envelope msgpack -> columnar RLD1 blob, plus the
-// shared raw-envelope fallback writer (one owner of the blob layout).
-void decode_envelope_to_blob(const uint8_t* data, size_t len,
-                             std::vector<uint8_t>* out);
-void write_raw_envelope_blob(const uint8_t* data, size_t len,
-                             std::vector<uint8_t>* out);
-}  // namespace relayrl
+#include "event_hub.h"  // shared poll/poll_batch + model state
 
 namespace {
 
@@ -132,12 +125,14 @@ class Server {
     epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
     ev.data.fd = wake_fd_;
     epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    hub_.reset();
     running_.store(true);
     loop_ = std::thread([this] { run(); });
     return true;
   }
 
   void stop() {
+    hub_.shutdown();  // wake embedder poll()s promptly
     if (!running_.exchange(false)) {
       cleanup_fds();
       return;
@@ -148,9 +143,7 @@ class Server {
   }
 
   void set_model(uint64_t version, const uint8_t* data, size_t len) {
-    std::lock_guard<std::mutex> g(model_mu_);
-    model_version_ = version;
-    model_.assign(data, data + len);
+    hub_.set_model(version, data, len);
   }
 
   void broadcast(uint64_t version, const uint8_t* data, size_t len) {
@@ -162,109 +155,15 @@ class Server {
     wake();
   }
 
-  // Returns payload size and consumes the event when it fits in cap;
-  // returns required size (without consuming) when cap is too small;
-  // returns -1 on timeout.
   long poll(int timeout_ms, int* ev_type, uint8_t* buf, size_t cap) {
-    std::unique_lock<std::mutex> lk(ev_mu_);
-    if (!ev_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                         [this] { return !events_.empty() || !running_.load(); }))
-      return -1;
-    if (events_.empty()) return -1;
-    Event& e = events_.front();
-    *ev_type = e.type;
-    if (e.payload.size() > cap) return static_cast<long>(e.payload.size());
-    memcpy(buf, e.payload.data(), e.payload.size());
-    long n = static_cast<long>(e.payload.size());
-    events_.pop_front();
-    return n;
+    return hub_.poll(timeout_ms, ev_type, buf, cap);
   }
 
-  // Batch drain with native decode: waits for >=1 queued event, then
-  // drains up to max_items, decoding each trajectory envelope into a
-  // columnar RLD1 blob (codec.cc) OUTSIDE the event lock — the embedding
-  // Python thread calls this through ctypes with the GIL released, so the
-  // whole msgpack parse overlaps the learner's device step. The output
-  // buffer holds u64-length-prefixed blobs; blobs that don't fit stay
-  // pending for the next call. Returns bytes written (with *n_items set),
-  // the required size when even the first blob doesn't fit, or -1 on
-  // timeout.
+  // Batch drain with native decode — see EventHub::poll_batch
+  // (event_hub.h): whole-batch envelope decode off-GIL into RLD1 blobs.
   long poll_batch(int timeout_ms, int max_items, uint8_t* buf, size_t cap,
                   int* n_items) {
-    *n_items = 0;
-    std::vector<Event> local;
-    std::deque<std::vector<uint8_t>> blobs;
-    {
-      std::unique_lock<std::mutex> lk(ev_mu_);
-      if (pending_blobs_.empty() &&
-          !ev_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                           [this] {
-                             return !events_.empty() || !running_.load();
-                           }))
-        return -1;
-      blobs.swap(pending_blobs_);
-      long budget = static_cast<long>(max_items) -
-                    static_cast<long>(blobs.size());
-      while (budget-- > 0 && !events_.empty()) {
-        local.push_back(std::move(events_.front()));
-        events_.pop_front();
-      }
-    }
-    if (local.empty() && blobs.empty()) return -1;
-    for (Event& e : local) {
-      std::vector<uint8_t> blob;
-      if (e.type == 1) {
-        try {
-          relayrl::decode_envelope_to_blob(e.payload.data(), e.payload.size(),
-                                           &blob);
-        } catch (...) {
-          // Decoder exception (e.g. bad_alloc on a pathological payload):
-          // hand the raw envelope to Python as a kind-3 blob so its
-          // decoder decides (and accounts any drop) — never unwind
-          // through the poll call.
-          blob.clear();
-          relayrl::write_raw_envelope_blob(e.payload.data(),
-                                           e.payload.size(), &blob);
-        }
-      } else {
-        // Registration (kind 2) / unregistration (kind 4): RLD1 header,
-        // id = payload.
-        uint32_t magic = 0x31444C52;
-        uint8_t kind = e.type == 2 ? 2 : 4;
-        uint32_t id_len = static_cast<uint32_t>(e.payload.size());
-        blob.resize(9 + id_len);
-        memcpy(blob.data(), &magic, 4);
-        blob[4] = kind;
-        memcpy(blob.data() + 5, &id_len, 4);
-        if (id_len) memcpy(blob.data() + 9, e.payload.data(), id_len);
-      }
-      blobs.push_back(std::move(blob));
-    }
-    size_t used = 0;
-    int packed = 0;
-    while (!blobs.empty()) {
-      std::vector<uint8_t>& b = blobs.front();
-      size_t need = 8 + b.size();
-      if (used + need > cap) break;
-      uint64_t blen = b.size();
-      memcpy(buf + used, &blen, 8);
-      memcpy(buf + used + 8, b.data(), b.size());
-      used += need;
-      ++packed;
-      blobs.pop_front();
-    }
-    long required = 0;
-    if (!blobs.empty()) {
-      required = static_cast<long>(8 + blobs.front().size());
-      std::lock_guard<std::mutex> lk(ev_mu_);
-      while (!blobs.empty()) {
-        pending_blobs_.push_front(std::move(blobs.back()));
-        blobs.pop_back();
-      }
-    }
-    if (packed == 0) return required;  // grow-and-retry signal
-    *n_items = packed;
-    return static_cast<long>(used);
+    return hub_.poll_batch(timeout_ms, max_items, buf, cap, n_items);
   }
 
   uint16_t port() const { return port_; }
@@ -440,14 +339,10 @@ class Server {
         push_event(1, payload, len);
         return true;
       case kFrameGetModel: {
-        std::vector<uint8_t> body;
-        {
-          std::lock_guard<std::mutex> g(model_mu_);
-          body.resize(8 + model_.size());
-          memcpy(body.data(), &model_version_, 8);
-          if (!model_.empty())
-            memcpy(body.data() + 8, model_.data(), model_.size());
-        }
+        auto [version, model] = hub_.model_copy();
+        std::vector<uint8_t> body(8 + model.size());
+        memcpy(body.data(), &version, 8);
+        if (!model.empty()) memcpy(body.data() + 8, model.data(), model.size());
         return send_frame(c, kFrameModel, body.data(), body.size());
       }
       case kFrameModelSet: {
@@ -476,14 +371,7 @@ class Server {
   }
 
   void push_event(int type, const uint8_t* payload, size_t len) {
-    {
-      std::lock_guard<std::mutex> g(ev_mu_);
-      Event e;
-      e.type = type;
-      e.payload.assign(payload, payload + len);
-      events_.push_back(std::move(e));
-    }
-    ev_cv_.notify_one();
+    hub_.push_event(type, payload, len);
   }
 
   void maybe_broadcast() {
@@ -494,13 +382,10 @@ class Server {
       pending_broadcast_ = false;
     }
     if (!todo) return;
-    std::vector<uint8_t> body;
-    {
-      std::lock_guard<std::mutex> g(model_mu_);
-      body.resize(8 + model_.size());
-      memcpy(body.data(), &model_version_, 8);
-      if (!model_.empty()) memcpy(body.data() + 8, model_.data(), model_.size());
-    }
+    auto [version, model] = hub_.model_copy();
+    std::vector<uint8_t> body(8 + model.size());
+    memcpy(body.data(), &version, 8);
+    if (!model.empty()) memcpy(body.data() + 8, model.data(), model.size());
     std::vector<int> dead;
     for (auto& [fd, conn] : conns_) {
       if (!conn.subscriber) continue;
@@ -558,17 +443,10 @@ class Server {
   std::thread loop_;
   std::map<int, Conn> conns_;
 
-  std::mutex model_mu_;
-  uint64_t model_version_ = 0;
-  std::vector<uint8_t> model_;
-
   std::mutex bcast_mu_;
   bool pending_broadcast_ = false;
 
-  std::mutex ev_mu_;
-  std::condition_variable ev_cv_;
-  std::deque<Event> events_;
-  std::deque<std::vector<uint8_t>> pending_blobs_;  // batch-drain holdbacks
+  relayrl::EventHub hub_;  // embedder event queue + model state
 };
 
 // ---------------- client (blocking sockets) ----------------

@@ -164,12 +164,25 @@ def make_server_transport(server_type: str, config: ConfigLoader,
                 "model_pub_addr", config.get_train_server().address),
         )
     if server_type == "grpc":
+        bind_addr = overrides.get("bind_addr",
+                                  config.get_train_server().host_port)
+        idle_s = config.get_grpc_idle_timeout_s()
+        # The native C++ gRPC server (grpc_server.cc: HTTP/2 + the two
+        # RPCs, EventHub batch decode) is the default when the library is
+        # built — same wire protocol, so grpcio agents are unaffected.
+        # native_grpc=False pins the pure-grpcio fallback.
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if overrides.get("native_grpc", True) and native_available():
+            from relayrl_tpu.transport.native_backend import (
+                NativeGrpcServerTransport,
+            )
+
+            return NativeGrpcServerTransport(bind_addr=bind_addr,
+                                             idle_timeout_s=idle_s)
         from relayrl_tpu.transport.grpc_backend import GrpcServerTransport
 
-        return GrpcServerTransport(
-            bind_addr=overrides.get("bind_addr", config.get_train_server().host_port),
-            idle_timeout_s=config.get_grpc_idle_timeout_s(),
-        )
+        return GrpcServerTransport(bind_addr=bind_addr, idle_timeout_s=idle_s)
     if server_type == "native":
         from relayrl_tpu.transport.native_backend import NativeServerTransport
 

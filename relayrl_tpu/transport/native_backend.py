@@ -70,6 +70,14 @@ def NativeServerTransport(*args, **kwargs):
     return NativeServerTransportImpl(_require_lib(), *args, **kwargs)
 
 
+def NativeGrpcServerTransport(*args, **kwargs):
+    from relayrl_tpu.transport.native_bindings import (
+        NativeGrpcServerTransportImpl,
+    )
+
+    return NativeGrpcServerTransportImpl(_require_lib(), *args, **kwargs)
+
+
 def NativeAgentTransport(*args, **kwargs):
     from relayrl_tpu.transport.native_bindings import NativeAgentTransportImpl
 

@@ -82,6 +82,29 @@ def _load(lib_path: str) -> ctypes.CDLL:
     lib.rl_sub_receipts.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_long]
+    # native gRPC/HTTP-2 server (grpc_server.cc): same embedder surface
+    lib.rl_grpc_server_create.restype = ctypes.c_void_p
+    lib.rl_grpc_server_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.rl_grpc_server_start.restype = ctypes.c_int
+    lib.rl_grpc_server_start.argtypes = [ctypes.c_void_p]
+    lib.rl_grpc_server_stop.argtypes = [ctypes.c_void_p]
+    lib.rl_grpc_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.rl_grpc_server_port.restype = ctypes.c_uint16
+    lib.rl_grpc_server_port.argtypes = [ctypes.c_void_p]
+    lib.rl_grpc_server_set_model.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
+    lib.rl_grpc_server_broadcast.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
+    lib.rl_grpc_server_set_idle_timeout.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int]
+    lib.rl_grpc_server_poll.restype = ctypes.c_long
+    lib.rl_grpc_server_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int), u8p,
+        ctypes.c_size_t]
+    lib.rl_grpc_server_poll_batch.restype = ctypes.c_long
+    lib.rl_grpc_server_poll_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int)]
     return lib
 
 
@@ -90,12 +113,14 @@ def _buf(data: bytes):
 
 
 class NativeServerTransportImpl(ServerTransport):
+    PREFIX = "rl_server"  # symbol prefix: framed-TCP core (transport.cc)
+
     def __init__(self, lib_path: str, bind_addr: str,
                  idle_timeout_s: float = 0.0):
         super().__init__()
         self._lib = _load(lib_path)
         host, port = _parse_host_port(bind_addr)
-        self._handle = self._lib.rl_server_create(host.encode(), port)
+        self._handle = self._fn("create")(host.encode(), port)
         if not self._handle:
             raise RuntimeError(f"native server bind failed on {bind_addr}")
         # 0 disables reaping; live agents heartbeat well inside any sane
@@ -105,19 +130,22 @@ class NativeServerTransportImpl(ServerTransport):
         self._stop = threading.Event()
         self.drain_parse_failures = 0  # lost decoded batches (observable)
 
+    def _fn(self, name):
+        return getattr(self._lib, f"{self.PREFIX}_{name}")
+
     @property
     def port(self) -> int:
-        return int(self._lib.rl_server_port(self._handle))
+        return int(self._fn("port")(self._handle))
 
     def start(self) -> None:
-        if self._lib.rl_server_start(self._handle) != 0:
+        if self._fn("start")(self._handle) != 0:
             raise RuntimeError("native server start failed")
         if self._idle_timeout_ms > 0:
-            self._lib.rl_server_set_idle_timeout(self._handle,
+            self._fn("set_idle_timeout")(self._handle,
                                                  self._idle_timeout_ms)
         version, bundle = self.get_model()
         data = _buf(bundle)
-        self._lib.rl_server_set_model(self._handle, version, data,
+        self._fn("set_model")(self._handle, version, data,
                                       len(bundle))
         self._stop.clear()
         self._poller = threading.Thread(target=self._poll_loop,
@@ -129,19 +157,19 @@ class NativeServerTransportImpl(ServerTransport):
         if self._poller is not None:
             self._poller.join(timeout=5)
             self._poller = None
-        self._lib.rl_server_stop(self._handle)
+        self._fn("stop")(self._handle)
 
     def __del__(self):
         try:
             if getattr(self, "_handle", None):
-                self._lib.rl_server_destroy(self._handle)
+                self._fn("destroy")(self._handle)
                 self._handle = None
         except Exception:
             pass
 
     def publish_model(self, version: int, bundle_bytes: bytes) -> None:
         data = _buf(bundle_bytes)
-        self._lib.rl_server_broadcast(self._handle, version, data,
+        self._fn("broadcast")(self._handle, version, data,
                                       len(bundle_bytes))
 
     def _poll_loop(self) -> None:
@@ -171,7 +199,7 @@ class NativeServerTransportImpl(ServerTransport):
         buf = (ctypes.c_uint8 * cap)()
         n_items = ctypes.c_int(0)
         while not self._stop.is_set():
-            n = self._lib.rl_server_poll_batch(
+            n = self._fn("poll_batch")(
                 self._handle, 100, 256, buf, cap, ctypes.byref(n_items))
             if n < 0:
                 continue
@@ -220,7 +248,7 @@ class NativeServerTransportImpl(ServerTransport):
         buf = (ctypes.c_uint8 * cap)()
         ev_type = ctypes.c_int(0)
         while not self._stop.is_set():
-            n = self._lib.rl_server_poll(self._handle, 100,
+            n = self._fn("poll")(self._handle, 100,
                                          ctypes.byref(ev_type), buf, cap)
             if n < 0:
                 continue
@@ -380,3 +408,32 @@ class NativeAgentTransportImpl(AgentTransport):
             if handle:
                 self._lib.rl_client_close(handle)
         self._ctrl = self._sub = None
+
+
+class NativeGrpcServerTransportImpl(NativeServerTransportImpl):
+    """The native gRPC plane (native/grpc_server.cc): a from-scratch
+    HTTP/2 server speaking the exact gRPC wire protocol of the Python
+    backend's two RPCs (SendActions, ClientPoll long-poll), with the same
+    embedder surface as the framed core — EventHub batch drain, columnar
+    decode, model broadcast waking parked polls. grpcio agents connect to
+    it unchanged.
+
+    ``idle_timeout_s`` here is the ClientPoll long-poll window (the
+    Python backend's semantic), not connection reaping.
+    """
+
+    PREFIX = "rl_grpc_server"
+
+    def __init__(self, lib_path: str, bind_addr: str,
+                 idle_timeout_s: float = 30.0):
+        super().__init__(lib_path, bind_addr, idle_timeout_s=idle_timeout_s)
+
+    @property
+    def idle_timeout_s(self) -> float:
+        return self._idle_timeout_ms / 1000.0
+
+    @idle_timeout_s.setter
+    def idle_timeout_s(self, value: float) -> None:
+        # tests/embedders tune the long-poll window after construction
+        self._idle_timeout_ms = int(value * 1000)
+        self._fn("set_idle_timeout")(self._handle, self._idle_timeout_ms)
