@@ -47,6 +47,17 @@ def registered_algorithms() -> list[str]:
     return sorted(_ALGO_REGISTRY)
 
 
+def anchor_path(path: str, env_dir: str | None) -> str:
+    """Anchor a relative artifact path (model file, checkpoint dir) under
+    ``env_dir`` so default-named run artifacts land in the run's directory
+    instead of the caller's cwd. Absolute paths pass through untouched."""
+    import os
+
+    if env_dir and not os.path.isabs(path):
+        return os.path.join(env_dir, path)
+    return path
+
+
 class AlgorithmBase(abc.ABC):
     """Host-side orchestration wrapper around a pure jitted learner step."""
 
@@ -68,6 +79,16 @@ class AlgorithmBase(abc.ABC):
     @abc.abstractmethod
     def log_epoch(self) -> None:
         """Dump the epoch's tabular diagnostics."""
+
+    # -- multi-host contract (optional; the TrainingServer broadcast loop
+    # uses it when jax.process_count() > 1 — SURVEY §7.4 item 5). A family
+    # supports multi-host by providing:
+    #   accumulate(item)       coordinator-side ingest, returns ready host
+    #                          batch(es) (dict, list of dicts, or None)
+    #   train_on_batch(batch)  the collective update, called on every rank
+    #   mh_zero_batch(d1, d2)  shape/dtype placeholder for non-coordinators
+    #   maybe_log_epoch()      epoch logging policy after a collective step
+    #   enable_multihost(mesh) re-compile the update over the global mesh
 
     # -- TPU-native surface --
     def _jitted_policy_step(self):

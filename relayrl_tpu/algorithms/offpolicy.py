@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from relayrl_tpu.algorithms.base import AlgorithmBase
+from relayrl_tpu.algorithms.base import AlgorithmBase, anchor_path
 from relayrl_tpu.config import ConfigLoader
 from relayrl_tpu.data.step_buffer import StepReplayBuffer
 from relayrl_tpu.types.action import ActionRecord
@@ -112,7 +112,12 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._ep_returns: list[float] = []
         self._ep_lengths: list[int] = []
         self._last_metrics: dict[str, float] = {}
-        self.server_model_path = loader.get_server_model_path()
+        self._mesh = None    # set by enable_multihost
+        self._place = None   # mesh-aware batch placement
+        # Relative default ("server_model.rlx") anchors under env_dir so
+        # example runs don't litter the caller's cwd (see anchor_path).
+        self.server_model_path = anchor_path(
+            loader.get_server_model_path(), env_dir)
 
     # -- subclass contract --
     def _setup(self, params: dict, learner: dict) -> None:
@@ -135,32 +140,16 @@ class OffPolicyAlgorithm(AlgorithmBase):
         :class:`~relayrl_tpu.types.columnar.DecodedTrajectory` (native
         columnar decode — marker rewards already folded, so the reward
         totals agree across paths)."""
-        from relayrl_tpu.types.columnar import DecodedTrajectory
-
-        if isinstance(actions, DecodedTrajectory):
-            if actions.n_steps == 0:
-                return False
-            rew_total = actions.total_reward
-        elif not actions or all(a.act is None for a in actions):
-            # Empty or marker-only (a capacity flush can strand the
-            # terminal marker in its own send) — no steps to store, and
-            # logging it would record a phantom zero-length episode.
-            return False
-        else:
-            rew_total = float(sum(a.rew for a in actions))
-        stored = self.buffer.add_episode(actions)
-        self._ep_returns.append(rew_total)
-        self._ep_lengths.append(stored)
-        self._traj_since_log += 1
+        # accumulate() owns the empty/marker-only validation and the
+        # update-debt ledger; here (single-host) the sampled batches train
+        # immediately. Empty/marker-only trajectories (a capacity flush
+        # can strand the terminal marker in its own send) store nothing
+        # and log no phantom zero-length episode.
+        batches = self.accumulate(actions)
         trained = False
-        if (self.updates_per_step > 0
-                and self.buffer.total_steps >= self.update_after
-                and stored > 0):
-            self._update_debt += stored * self.updates_per_step
-            n = min(self.max_updates_per_ingest,
-                    max(1, int(self._update_debt)))
-            self._train_batches(n)
-            self._update_debt = max(0.0, self._update_debt - n)
+        if batches:
+            for batch in batches:
+                self.train_on_batch(batch)
             trained = True
         if self._traj_since_log >= self.traj_per_epoch:
             self.log_epoch()
@@ -172,11 +161,97 @@ class OffPolicyAlgorithm(AlgorithmBase):
 
     def _train_batches(self, n: int) -> None:
         for _ in range(int(n)):
-            batch = self.buffer.sample(self.batch_size)
-            device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
-            self.state, metrics = self._update(self.state, device_batch)
+            self.train_on_batch(self.buffer.sample(self.batch_size))
+
+    def train_on_batch(self, host_batch: Mapping[str, Any]
+                       ) -> Mapping[str, float]:
+        """One jitted update on a sampled transition batch. Multi-host:
+        every process calls this with the same (broadcast) batch — the
+        replay buffer itself stays coordinator-side."""
+        if self._place is not None:
+            device_batch = self._place(dict(host_batch))
+        else:
+            device_batch = {k: jnp.asarray(v) for k, v in host_batch.items()}
+        self.state, metrics = self._update(self.state, device_batch)
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
-        self.logger.store(**self._last_metrics)
+        from relayrl_tpu.parallel.distributed import is_coordinator
+
+        if is_coordinator():
+            # Non-coordinators never dump_tabular, so storing there would
+            # only accumulate unread rows.
+            self.logger.store(**self._last_metrics)
+        return self._last_metrics
+
+    # -- multi-host contract (server broadcast loop; SURVEY §7.4 item 5) --
+    def accumulate(self, item):
+        """Coordinator-side ingest WITHOUT training: store the episode,
+        keep the update-debt ledger, and return the list of sampled
+        training batches now due (None when no update is due — warmup, or
+        updates_per_step=0). The training step itself is collective:
+        :meth:`train_on_batch` runs on every process with each batch."""
+        from relayrl_tpu.types.columnar import DecodedTrajectory
+
+        if isinstance(item, DecodedTrajectory):
+            if item.n_steps == 0:
+                return None
+            rew_total = item.total_reward
+        elif not item or all(a.act is None for a in item):
+            return None
+        else:
+            rew_total = float(sum(a.rew for a in item))
+        stored = self.buffer.add_episode(item)
+        self._ep_returns.append(rew_total)
+        self._ep_lengths.append(stored)
+        self._traj_since_log += 1
+        if (self.updates_per_step <= 0
+                or self.buffer.total_steps < self.update_after
+                or stored == 0):
+            return None
+        self._update_debt += stored * self.updates_per_step
+        n = min(self.max_updates_per_ingest, max(1, int(self._update_debt)))
+        self._update_debt = max(0.0, self._update_debt - n)
+        return [self.buffer.sample(self.batch_size) for _ in range(n)]
+
+    def mh_zero_batch(self, b: int, t: int) -> dict:
+        """Placeholder transition batch matching :meth:`StepReplayBuffer.
+        sample`'s schema — what non-coordinators feed the broadcast
+        (values are overwritten; only shape/dtype matter). ``t`` is unused
+        (transition batches have no time axis); the descriptor's second
+        slot carries obs_dim instead."""
+        act = (np.zeros((b,), np.int32) if self.buffer.discrete
+               else np.zeros((b, self.act_dim), np.float32))
+        return {
+            "obs": np.zeros((b, self.obs_dim), np.float32),
+            "act": act,
+            "rew": np.zeros((b,), np.float32),
+            "obs2": np.zeros((b, self.obs_dim), np.float32),
+            "mask2": np.ones((b, self.act_dim), np.float32),
+            "done": np.zeros((b,), np.float32),
+        }
+
+    def maybe_log_epoch(self) -> None:
+        """Epoch logging is per ``traj_per_epoch`` trajectories, not per
+        update (the broadcast loop calls this after every collective
+        step)."""
+        if self._traj_since_log >= self.traj_per_epoch:
+            self.log_epoch()
+
+    def enable_multihost(self, mesh) -> None:
+        """Re-compile the update over a (possibly multi-process) mesh and
+        place the state on it; see OnPolicyAlgorithm.enable_multihost."""
+        from relayrl_tpu.parallel import (
+            make_sharded_update,
+            place_batch,
+            place_state,
+        )
+        from relayrl_tpu.parallel.sharding import replicated
+
+        self._mesh = mesh
+        self._update = make_sharded_update(self._update, mesh, self.state)
+        self.state = place_state(self.state, mesh)
+        self._place = lambda b: place_batch(b, mesh)
+        self._gather_params = jax.jit(lambda p: p,
+                                      out_shardings=replicated(mesh))
 
     def log_epoch(self) -> None:
         self.epoch += 1
@@ -196,13 +271,27 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self.bundle().save(path or self.server_model_path)
 
     def bundle(self) -> ModelBundle:
-        host_params = jax.device_get(self._actor_params())
+        """Multi-host: params may be sharded across processes; the jitted
+        re-shard to replicated assembles the full copy, making this a
+        COLLECTIVE when ``jax.process_count() > 1`` (the server's
+        broadcast loop calls it at the same point on every process)."""
+        params = self._actor_params()
+        if self._mesh is not None and jax.process_count() > 1:
+            params = self._gather_params(params)
+            host_params = jax.tree_util.tree_map(
+                lambda x: np.asarray(x.addressable_data(0)), params)
+        else:
+            host_params = jax.device_get(params)
         return ModelBundle(version=self.version, arch=self._publish_arch(),
                            params=host_params)
 
     @property
     def version(self) -> int:
-        return int(self.state.step)
+        step = self.state.step
+        try:
+            return int(step)
+        except Exception:  # multi-host replicated array: read a local shard
+            return int(np.asarray(step.addressable_data(0)))
 
     # convenience for in-process actors/tests
     def act(self, obs, mask=None):

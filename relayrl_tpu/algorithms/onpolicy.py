@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from relayrl_tpu.algorithms.base import AlgorithmBase
+from relayrl_tpu.algorithms.base import AlgorithmBase, anchor_path
 from relayrl_tpu.config import ConfigLoader
 from relayrl_tpu.data import EpochBuffer
 from relayrl_tpu.types.action import ActionRecord
@@ -85,7 +85,11 @@ class OnPolicyAlgorithm(AlgorithmBase):
                                  "obs_dim": obs_dim, "act_dim": act_dim})
         self.epoch = 0
         self._last_metrics: dict[str, float] = {}
-        self.server_model_path = loader.get_server_model_path()
+        # A relative model path (the default "server_model.rlx") anchors
+        # under env_dir so example runs don't litter the caller's cwd; an
+        # absolute configured path is honoured verbatim.
+        self.server_model_path = anchor_path(
+            loader.get_server_model_path(), env_dir)
         self._mesh = None    # set by enable_multihost
         self._place = None   # mesh-aware batch placement
 
@@ -143,6 +147,18 @@ class OnPolicyAlgorithm(AlgorithmBase):
 
     def train_model(self) -> Mapping[str, float]:
         return self.train_on_batch(self.buffer.drain().as_dict())
+
+    def mh_zero_batch(self, b: int, t: int) -> dict:
+        """Placeholder epoch batch (shape/dtype only) that non-coordinators
+        feed the batch broadcast — the descriptor carries (B, T)."""
+        from relayrl_tpu.data.batching import TrajectoryBatch
+
+        return TrajectoryBatch.zeros(b, t, self.obs_dim, self.act_dim,
+                                     self.discrete)
+
+    def maybe_log_epoch(self) -> None:
+        # One collective update == one epoch for the on-policy family.
+        self.log_epoch()
 
     def enable_multihost(self, mesh) -> None:
         """Re-compile the update over a (possibly multi-process) mesh and

@@ -96,34 +96,25 @@ class TrainingServer:
         )
 
         learner_cfg = self.config.get_learner_params()
-        if self.distributed_info["multi_host"]:
-            # The learner step becomes SPMD over the global (all-host)
-            # mesh: coordinator-side socket ingest assembles batches, the
-            # broadcast loop ships them, every process steps in lockstep
-            # (SURVEY.md §7.4 item 5's asymmetric-ingest design).
-            if resume:
-                raise NotImplementedError(
-                    "resume=True is not supported with a multi-host "
-                    "learner yet — restart fresh or restore on one host")
-            if not hasattr(self.algorithm, "enable_multihost"):
-                raise NotImplementedError(
-                    f"{algorithm_name} has no multi-host support "
-                    "(enable_multihost); use an on-policy algorithm")
-            from relayrl_tpu.parallel import make_mesh
-
-            self._mh_mesh = make_mesh(learner_cfg.get("mesh") or {"dp": -1})
-            self.algorithm.enable_multihost(self._mh_mesh)
-            print(f"[TrainingServer] multi-host mesh "
-                  f"{dict(self._mh_mesh.shape)} over "
-                  f"{len(self._mh_mesh.devices.flat)} devices", flush=True)
         # One resolution for save AND resume — a falsy configured value
         # disables checkpointing entirely, anything else is used by both
         # paths (a split default here would resume from a dir never written).
+        # Relative dirs anchor under env_dir (see anchor_path) so example
+        # runs don't leave `checkpoints/` in the caller's cwd.
+        from relayrl_tpu.algorithms.base import anchor_path
+
         self._checkpoint_dir = learner_cfg.get("checkpoint_dir", "checkpoints")
+        if self._checkpoint_dir:
+            self._checkpoint_dir = anchor_path(self._checkpoint_dir, env_dir)
         self._checkpoint_every = max(
             1, int(learner_cfg.get("checkpoint_every_epochs", 10)))
 
         if resume and self._checkpoint_dir:
+            # Multi-host: EVERY rank restores the same full state from the
+            # shared checkpoint dir BEFORE enable_multihost places it on
+            # the global mesh — identical state everywhere, exactly like a
+            # fresh seed_salt=0 init. (Saves are already collective; see
+            # the broadcast loop.)
             from relayrl_tpu.checkpoint import restore_algorithm
 
             try:
@@ -133,6 +124,23 @@ class TrainingServer:
             except FileNotFoundError:
                 print("[TrainingServer] no checkpoint to resume; fresh start",
                       flush=True)
+
+        if self.distributed_info["multi_host"]:
+            # The learner step becomes SPMD over the global (all-host)
+            # mesh: coordinator-side socket ingest assembles batches, the
+            # broadcast loop ships them, every process steps in lockstep
+            # (SURVEY.md §7.4 item 5's asymmetric-ingest design).
+            if not hasattr(self.algorithm, "enable_multihost"):
+                raise NotImplementedError(
+                    f"{algorithm_name} has no multi-host support "
+                    "(enable_multihost)")
+            from relayrl_tpu.parallel import make_mesh
+
+            self._mh_mesh = make_mesh(learner_cfg.get("mesh") or {"dp": -1})
+            self.algorithm.enable_multihost(self._mh_mesh)
+            print(f"[TrainingServer] multi-host mesh "
+                  f"{dict(self._mh_mesh.shape)} over "
+                  f"{len(self._mh_mesh.devices.flat)} devices", flush=True)
 
         # Multi-actor registry (ref: MultiactorParams,
         # training_server_wrapper.rs:159-163). Always multi-capable; the
@@ -281,8 +289,10 @@ class TrainingServer:
 
     def _mh_accumulate(self, item) -> dict | None:
         """Coordinator: feed one decoded queue entry into the algorithm
-        buffer; returns a ready epoch batch dict (at most one per call —
-        extras queue in _mh_ready)."""
+        buffer; returns a ready training batch dict (at most one per call
+        — extras queue in _mh_ready). On-policy accumulate yields one
+        epoch batch; off-policy yields a LIST of sampled transition
+        batches (the update-to-data ratio's worth)."""
         items = (item if (isinstance(item, list) and item
                           and isinstance(item[0], DecodedTrajectory))
                  else [item])
@@ -293,15 +303,11 @@ class TrainingServer:
             except Exception as e:
                 print(f"[TrainingServer] accumulate error: {e!r}", flush=True)
                 continue
-            if got is not None:
+            if isinstance(got, list):
+                self._mh_ready.extend(got)
+            elif got is not None:
                 self._mh_ready.append(got)
         return self._mh_ready.pop(0) if self._mh_ready else None
-
-    def _mh_zero_batch(self, b: int, t: int) -> dict:
-        from relayrl_tpu.data.batching import TrajectoryBatch
-
-        a = self.algorithm
-        return TrajectoryBatch.zeros(b, t, a.obs_dim, a.act_dim, a.discrete)
 
     def _learner_loop_multihost(self) -> None:
         import numpy as np
@@ -320,6 +326,12 @@ class TrainingServer:
                 # after draining hundreds of queued trajectories.
                 if not self._stop.is_set():
                     if self._mh_ready:
+                        # _mh_busy flips BEFORE the batch leaves the
+                        # queues (here and below, ahead of task_done):
+                        # drain() checks queues-empty AND ready-empty AND
+                        # not-busy, so a gap between "popped" and "busy"
+                        # would let it report drained with a step pending.
+                        self._mh_busy = True
                         batch = self._mh_ready.pop(0)
                     tick_deadline = time.monotonic() + 0.2
                     while batch is None and time.monotonic() < tick_deadline:
@@ -329,6 +341,8 @@ class TrainingServer:
                             continue
                         try:
                             batch = self._mh_accumulate(item)
+                            if batch is not None:
+                                self._mh_busy = True
                         finally:
                             self._decoded.task_done()
                 code = (self._MH_STOP if self._stop.is_set()
@@ -344,11 +358,13 @@ class TrainingServer:
             desc = broadcast_from_coordinator(desc)
             code = int(desc[0])
             if code == self._MH_STOP:
+                self._mh_busy = False  # a preempted batch is dropped
                 break
             if code == self._MH_IDLE:
                 continue
             if not coord:
-                batch = self._mh_zero_batch(int(desc[1]), int(desc[2]))
+                batch = self.algorithm.mh_zero_batch(int(desc[1]),
+                                                     int(desc[2]))
             self._mh_busy = True
             batch = broadcast_from_coordinator(batch)
             try:
@@ -362,7 +378,9 @@ class TrainingServer:
             if coord:
                 self.stats["updates"] += 1
                 try:
-                    self.algorithm.log_epoch()
+                    # On-policy: one update == one epoch. Off-policy: the
+                    # algorithm throttles to its traj_per_epoch cadence.
+                    self.algorithm.maybe_log_epoch()
                 except Exception as e:
                     print(f"[TrainingServer] log error: {e!r}", flush=True)
                 raw = bundle.to_bytes()

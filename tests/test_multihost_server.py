@@ -1,10 +1,14 @@
 """Live actor fleet → 2-process ``jax.distributed`` TrainingServer.
 
-The missing end-to-end of VERDICT r2 (#3): real ZMQ agents feed the
-coordinator's sockets while BOTH processes of a 2-process CPU-mesh
-learner execute the sharded update in lockstep via the server's broadcast
-loop, to the point of actually learning a bandit. Complements
-test_multihost.py (which exercises the primitives without the server).
+The end-to-end of VERDICT r2 #3, widened per VERDICT r3 #2/#9: real
+socket agents feed the coordinator's ingest while BOTH processes of a
+2-process CPU-mesh learner execute the sharded update in lockstep via the
+server's broadcast loop. Cells: on-policy over ZMQ (learns a bandit),
+the same fleet over the native framed-TCP transport, off-policy DQN
+(replay buffer coordinator-side, sampled batches broadcast), and
+kill-and-resume (collective orbax checkpoint → full teardown → resume on
+both ranks → further training). Complements test_multihost.py (which
+exercises the primitives without the server).
 """
 
 import os
@@ -26,8 +30,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_fleet_trains_two_process_learner(tmp_path):
-    ports = [str(_free_port()) for _ in range(4)]
+def _native_lib_available() -> bool:
+    from relayrl_tpu.transport.native_backend import native_available
+
+    return native_available()
+
+
+@pytest.mark.parametrize("mode", [
+    "zmq",
+    pytest.param("native", marks=pytest.mark.skipif(
+        not _native_lib_available(),
+        reason="native library not built (make -C native)")),
+    "offpolicy",
+    "resume",
+])
+def test_fleet_trains_two_process_learner(tmp_path, mode):
+    coord = str(_free_port())
+    ports = [str(_free_port()) for _ in range(6)]
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))
@@ -35,7 +54,8 @@ def test_fleet_trains_two_process_learner(tmp_path):
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(rank), *ports, str(tmp_path)],
+            [sys.executable, _WORKER, str(rank), mode, coord, *ports,
+             str(tmp_path)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for rank in range(2)

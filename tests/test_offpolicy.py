@@ -331,21 +331,21 @@ class TestUpdateBurstBounding:
         algo = _mk(tmp_cwd, "DQN", act_dim=2, update_after=1,
                    updates_per_step=1.0, max_updates_per_ingest=8)
         calls = []
-        orig = algo._train_batches
-        algo._train_batches = lambda n: (calls.append(n), orig(n))[1]
+        orig = algo.train_on_batch
+        algo.train_on_batch = lambda b: (calls.append(1), orig(b))[1]
         algo.receive_trajectory(_discrete_episode(100, lambda r: 0, seed=0))
-        assert calls == [8]
+        assert len(calls) == 8
         assert algo._update_debt == pytest.approx(92.0)
         # The debt drains across later (short) episodes at the same cap.
         algo.receive_trajectory(_discrete_episode(2, lambda r: 0, seed=1))
-        assert calls == [8, 8]
+        assert len(calls) == 16
         assert algo._update_debt == pytest.approx(86.0)
 
     def test_fractional_ratio_still_updates(self, tmp_cwd):
         algo = _mk(tmp_cwd, "DQN", act_dim=2, update_after=1,
                    updates_per_step=0.1, max_updates_per_ingest=8)
         calls = []
-        orig = algo._train_batches
-        algo._train_batches = lambda n: (calls.append(n), orig(n))[1]
+        orig = algo.train_on_batch
+        algo.train_on_batch = lambda b: (calls.append(1), orig(b))[1]
         algo.receive_trajectory(_discrete_episode(5, lambda r: 0, seed=0))
-        assert calls == [1]  # post-warmup trajectory always trains >= once
+        assert len(calls) == 1  # post-warmup trajectory always trains >= once
