@@ -116,10 +116,23 @@ class PolicyActor:
         mask=None,
         reward: float = 0.0,
     ) -> ActionRecord:
-        """Run the policy, append the step to the current trajectory."""
+        """Run the policy, append the step to the current trajectory.
+
+        ``reward`` is the env reward earned since the previous request —
+        it is attached to the PREVIOUS record via ``update_reward`` so
+        ``ActionRecord.rew`` always means "reward earned BY this action".
+        The reference stores the incoming reward on the NEW record instead
+        (agent_grpc.rs:434-441 builds the fresh action with it), a
+        one-step credit shift its return-to-go REINFORCE tolerates but
+        that inverts 1-step TD targets (DQN credited a_t with r_{t-1});
+        deliberate departure, SURVEY.md §7.5 spirit. The only reward that
+        can be lost is one spanning a capacity-flush chunk boundary (the
+        previous record already left the process)."""
         obs = np.asarray(obs, dtype=np.float32)
         mask_arr = None if mask is None else np.asarray(mask, dtype=np.float32)
         with self._lock:
+            if reward and self.trajectory.get_actions():
+                self.trajectory.get_actions()[-1].update_reward(float(reward))
             self._rng, sub = jax.random.split(self._rng)
             if self._window_fn is not None:
                 rolled = self._push_window(obs)
@@ -142,7 +155,7 @@ class PolicyActor:
                 obs=obs,
                 act=np.asarray(act),
                 mask=mask_arr,
-                rew=float(reward),
+                rew=0.0,  # filled by the NEXT request / terminal marker
                 data={k: np.asarray(v) for k, v in aux.items()},
                 done=False,
             )
