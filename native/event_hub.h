@@ -75,6 +75,10 @@ class EventHub {
   // blob (codec.cc) OUTSIDE the lock — the embedding Python thread calls
   // this through ctypes with the GIL released. Output holds u64-length-
   // prefixed blobs; blobs that don't fit stay pending for the next call.
+  // SINGLE-CONSUMER CONTRACT: poll/poll_batch must be called from ONE
+  // consumer thread per hub — pending_blobs_ is decoded and re-queued
+  // outside the lock, so concurrent pollers would interleave and reorder
+  // events. Every transport owns exactly one Python poller thread.
   // Returns bytes written (*n_items set), the required size when even the
   // first blob doesn't fit, or -1 on timeout.
   long poll_batch(int timeout_ms, int max_items, uint8_t* buf, size_t cap,

@@ -282,6 +282,21 @@ constexpr uint8_t kFlagEndStream = 0x1, kFlagAck = 0x1, kFlagEndHeaders = 0x4,
 const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 constexpr size_t kPrefaceLen = 24;
 
+// RFC 7540 §7 error codes (the subset we emit in GOAWAY).
+constexpr uint32_t kErrProtocol = 0x1, kErrFlowControl = 0x3,
+                   kErrFrameSize = 0x6, kErrCompression = 0x9,
+                   kErrCalm = 0xB;
+// We never advertise SETTINGS_MAX_FRAME_SIZE, so the RFC default 16384
+// binds the peer; anything larger is a FRAME_SIZE_ERROR, and enforcing it
+// bounds rbuf growth against adversarial 16MB-length frames.
+constexpr size_t kMaxRecvFrame = 16384;
+// Caps against resource-exhaustion bytes a real grpc client never sends:
+// an unterminated CONTINUATION flood, unbounded request bodies, or
+// opening streams forever without closing any.
+constexpr size_t kMaxHeaderBlock = 1u << 20;
+constexpr size_t kMaxBody = 1u << 28;
+constexpr size_t kMaxStreams = 1024;
+
 void put_frame_header(std::vector<uint8_t>* out, size_t len, uint8_t type,
                       uint8_t flags, uint32_t stream) {
   out->push_back(static_cast<uint8_t>(len >> 16));
@@ -317,6 +332,7 @@ struct GConn {
   size_t woff = 0;
   HpackDecoder hpack;
   std::map<uint32_t, Stream> streams;
+  uint32_t last_stream = 0;  // highest stream id seen, for GOAWAY
   int64_t conn_send_window = 65535;
   uint32_t peer_max_frame = 16384;
   int64_t peer_initial_window = 65535;
@@ -488,6 +504,26 @@ class GrpcServer {
     conns_.erase(fd);
   }
 
+  // Queue a GOAWAY (best-effort flush; the caller closes the connection
+  // right after) and return false so error paths read
+  // `return goaway(c, kErrX);`. Malformed input never crashes the server
+  // — it ends the one connection with a diagnosable error code.
+  bool goaway(GConn& c, uint32_t code) {
+    std::vector<uint8_t> out;
+    put_frame_header(&out, 8, kFrameGoaway, 0, 0);
+    out.push_back(static_cast<uint8_t>(c.last_stream >> 24));
+    out.push_back(static_cast<uint8_t>(c.last_stream >> 16));
+    out.push_back(static_cast<uint8_t>(c.last_stream >> 8));
+    out.push_back(static_cast<uint8_t>(c.last_stream));
+    out.push_back(static_cast<uint8_t>(code >> 24));
+    out.push_back(static_cast<uint8_t>(code >> 16));
+    out.push_back(static_cast<uint8_t>(code >> 8));
+    out.push_back(static_cast<uint8_t>(code));
+    queue_bytes(c, std::move(out));
+    flush(c);
+    return false;
+  }
+
   bool handle_read(GConn& c) {
     char tmp[65536];
     size_t budget = 1 << 20;
@@ -511,7 +547,7 @@ class GrpcServer {
         fprintf(stderr,
                 "[relayrl-grpc] peer did not send the HTTP/2 preface — "
                 "server_type mismatch, dropping connection\n");
-        return false;
+        return goaway(c, kErrProtocol);
       }
       c.preface_done = true;
       off = kPrefaceLen;
@@ -520,7 +556,9 @@ class GrpcServer {
       size_t len = (static_cast<size_t>(c.rbuf[off]) << 16) |
                    (static_cast<size_t>(c.rbuf[off + 1]) << 8) |
                    c.rbuf[off + 2];
-      if (len > (1u << 24)) return false;
+      // We never raise SETTINGS_MAX_FRAME_SIZE, so the RFC default binds
+      // the peer; also bounds buffering against fuzzed 16MB lengths.
+      if (len > kMaxRecvFrame) return goaway(c, kErrFrameSize);
       if (c.rbuf.size() - off < 9 + len) break;
       uint8_t type = c.rbuf[off + 3];
       uint8_t flags = c.rbuf[off + 4];
@@ -539,20 +577,28 @@ class GrpcServer {
 
   bool handle_frame(GConn& c, uint8_t type, uint8_t flags, uint32_t stream,
                     const uint8_t* p, size_t len) {
+    // A header block must be contiguous: HEADERS then only CONTINUATIONs
+    // until END_HEADERS (RFC 7540 §4.3).
+    if (c.collecting_headers && type != kFrameContinuation)
+      return goaway(c, kErrProtocol);
     switch (type) {
       case kFrameSettings: {
         if (flags & kFlagAck) return true;
+        if (len % 6 != 0) return goaway(c, kErrFrameSize);
         for (size_t i = 0; i + 6 <= len; i += 6) {
           uint16_t id = (p[i] << 8) | p[i + 1];
           uint32_t val = (static_cast<uint32_t>(p[i + 2]) << 24) |
                          (static_cast<uint32_t>(p[i + 3]) << 16) |
                          (static_cast<uint32_t>(p[i + 4]) << 8) | p[i + 5];
           if (id == 4) {  // INITIAL_WINDOW_SIZE: adjust open streams
+            if (val > 0x7fffffffu) return goaway(c, kErrFlowControl);
             int64_t delta =
                 static_cast<int64_t>(val) - c.peer_initial_window;
             c.peer_initial_window = val;
             for (auto& [sid, s] : c.streams) s.send_window += delta;
           } else if (id == 5) {
+            if (val < 16384 || val > (1u << 24) - 1)
+              return goaway(c, kErrProtocol);
             c.peer_max_frame = val;
           }
         }
@@ -562,20 +608,28 @@ class GrpcServer {
         return flush(c);
       }
       case kFrameWindowUpdate: {
-        if (len != 4) return false;
+        if (len != 4) return goaway(c, kErrFrameSize);
         uint32_t inc = ((static_cast<uint32_t>(p[0]) << 24) |
                         (static_cast<uint32_t>(p[1]) << 16) |
                         (static_cast<uint32_t>(p[2]) << 8) | p[3]) &
                        0x7fffffff;
+        if (inc == 0) return goaway(c, kErrProtocol);
         if (stream == 0) {
           c.conn_send_window += inc;
+          if (c.conn_send_window > 0x7fffffff)
+            return goaway(c, kErrFlowControl);
         } else {
           auto it = c.streams.find(stream);
-          if (it != c.streams.end()) it->second.send_window += inc;
+          if (it != c.streams.end()) {
+            it->second.send_window += inc;
+            if (it->second.send_window > 0x7fffffff)
+              return goaway(c, kErrFlowControl);
+          }
         }
         return pump_streams(c);
       }
       case kFramePing: {
+        if (len != 8) return goaway(c, kErrFrameSize);
         if (flags & kFlagAck) return true;
         std::vector<uint8_t> out;
         put_frame_header(&out, len, kFramePing, kFlagAck, 0);
@@ -584,14 +638,16 @@ class GrpcServer {
         return flush(c);
       }
       case kFrameHeaders: {
+        if (stream == 0) return goaway(c, kErrProtocol);
         size_t pad = 0, skip = 0;
         if (flags & kFlagPadded) {
-          if (len < 1) return false;
+          if (len < 1) return goaway(c, kErrProtocol);
           pad = p[0];
           skip = 1;
         }
         if (flags & kFlagPriority) skip += 5;
-        if (skip + pad > len) return false;
+        if (skip + pad > len) return goaway(c, kErrProtocol);
+        if (stream > c.last_stream) c.last_stream = stream;
         c.header_block.assign(p + skip, p + len - pad);
         c.header_stream = stream;
         c.header_end_stream = (flags & kFlagEndStream) != 0;
@@ -600,24 +656,28 @@ class GrpcServer {
         return true;
       }
       case kFrameContinuation: {
-        if (!c.collecting_headers || stream != c.header_stream) return false;
+        if (!c.collecting_headers || stream != c.header_stream)
+          return goaway(c, kErrProtocol);
+        if (c.header_block.size() + len > kMaxHeaderBlock)
+          return goaway(c, kErrCalm);  // CONTINUATION flood
         c.header_block.insert(c.header_block.end(), p, p + len);
         if (flags & kFlagEndHeaders) return finish_headers(c);
         return true;
       }
       case kFrameData: {
+        if (stream == 0) return goaway(c, kErrProtocol);
         size_t pad = 0, skip = 0;
         if (flags & kFlagPadded) {
-          if (len < 1) return false;
+          if (len < 1) return goaway(c, kErrProtocol);
           pad = p[0];
           skip = 1;
         }
-        if (skip + pad > len) return false;
+        if (skip + pad > len) return goaway(c, kErrProtocol);
         auto it = c.streams.find(stream);
         if (it == c.streams.end()) return true;  // canceled stream
         Stream& s = it->second;
         s.body.insert(s.body.end(), p + skip, p + len - pad);
-        if (s.body.size() > (1u << 30)) return false;
+        if (s.body.size() > kMaxBody) return goaway(c, kErrCalm);
         // replenish the peer's send budget promptly (conn + stream)
         std::vector<uint8_t> out;
         uint32_t inc = static_cast<uint32_t>(len);
@@ -657,8 +717,11 @@ class GrpcServer {
       fprintf(stderr,
               "[relayrl-grpc] unsupported/malformed HPACK block "
               "(Huffman-coded client?) — closing connection\n");
-      return false;
+      return goaway(c, kErrCompression);
     }
+    if (c.streams.size() >= kMaxStreams &&
+        c.streams.find(c.header_stream) == c.streams.end())
+      return goaway(c, kErrCalm);  // stream-open flood
     Stream& s = c.streams[c.header_stream];
     s.id = c.header_stream;
     s.send_window = c.peer_initial_window;
@@ -682,7 +745,13 @@ class GrpcServer {
       }
     }
     if (s.path == "/relayrl.RelayRLRoute/SendActions") {
-      if (msg) hub_.push_event(1, msg, msg_len);
+      if (!msg) {
+        // Malformed/incomplete grpc framing: fail the RPC (13 INTERNAL)
+        // instead of acking — a silent ack would make the dropped
+        // trajectory unobservable on both ends.
+        return respond_status(c, s, "13");
+      }
+      hub_.push_event(1, msg, msg_len);
       std::vector<uint8_t> resp;
       relayrl::build_ack_response(&resp);
       return respond(c, s, resp);
@@ -711,10 +780,15 @@ class GrpcServer {
       return true;
     }
     // unknown method: grpc-status 12 UNIMPLEMENTED via trailers-only
+    return respond_status(c, s, "12");
+  }
+
+  // Trailers-only error response (no body), closing the stream.
+  bool respond_status(GConn& c, Stream& s, const char* grpc_status) {
     std::vector<uint8_t> block;
     block.push_back(0x88);  // :status 200
     hpack_emit_literal(&block, "content-type", "application/grpc");
-    hpack_emit_literal(&block, "grpc-status", "12");
+    hpack_emit_literal(&block, "grpc-status", grpc_status);
     std::vector<uint8_t> out;
     put_frame_header(&out, block.size(), kFrameHeaders,
                      kFlagEndHeaders | kFlagEndStream, s.id);

@@ -56,8 +56,13 @@ class Agent:
     def enable_agent(self) -> None:
         if self.active:
             return
+        # Auto-negotiation may retry-probe until the server binds; give it
+        # the agent's own handshake budget rather than a fixed 3s window.
+        overrides = dict(self._addr_overrides)
+        overrides.setdefault("negotiate_window_s",
+                             min(self._handshake_timeout_s * 0.5, 30.0))
         self.transport = make_agent_transport(
-            self.server_type, self.config, **self._addr_overrides)
+            self.server_type, self.config, **overrides)
         version, bundle_bytes = self.transport.fetch_model(self._handshake_timeout_s)
         bundle = ModelBundle.from_bytes(bundle_bytes)
         bundle.version = version

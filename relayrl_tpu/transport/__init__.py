@@ -205,8 +205,14 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
         raise ValueError(
             f"unknown server_type {server_type!r} (zmq|grpc|native|auto)")
     should_probe = overrides.pop("probe", True)
+    # Agents that start long before the server binds can spend more of
+    # their handshake budget negotiating instead of hitting the 3s default
+    # and splitting a mixed fleet on the local fallback (advisor r3).
+    negotiate_window_s = float(overrides.pop("negotiate_window_s", 3.0))
     if server_type == "auto":
-        server_type = (_negotiate_agent_auto(config, overrides)
+        server_type = (_negotiate_agent_auto(
+                           config, overrides,
+                           retry_window_s=negotiate_window_s)
                        if should_probe else _resolve_auto())
     elif should_probe:
         _verify_agent_protocol(server_type, config, overrides)

@@ -81,7 +81,11 @@ def probe_endpoint(host: str, port: int, timeout_s: float = 1.0) -> str:
     try:
         buf = b""
         pinged = False
-        passive_until = time.monotonic() + min(0.3, timeout_s / 2)
+        # Scale the passive window with the caller's budget: pinging a
+        # loaded zmq server that just hasn't greeted yet makes libzmq
+        # throttle greetings to later raw connections (see module header),
+        # so spend up to 60% of the timeout (capped 0.5s) listening first.
+        passive_until = time.monotonic() + min(0.5, timeout_s * 0.6)
         while time.monotonic() < deadline:
             verdict = _classify_frame(buf)
             if verdict:
