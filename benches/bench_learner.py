@@ -91,14 +91,24 @@ def offpolicy_batch(B, obs_dim, act_dim, discrete, rng):
 
 
 def bench_algo(name, make_state_update, batch, flops_per_update=None,
-               detail=None):
+               detail=None, trials=None):
     state, update = make_state_update()
     jitted = jax.jit(update)
     device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
-    dt = time_chained(lambda s: jitted(s, device_batch), state,
-                      iters=10 if quick() else 30)
+    # Multiple trials with the raw spread recorded: the tunneled platform
+    # drifts under sustained load (~25-40% between identical runs), so a
+    # single number is not comparable across rounds without its variance
+    # (VERDICT r3 weak #6). Canonical value = best trial (noise only ever
+    # slows a trial down).
+    trials = trials if trials is not None else (1 if quick() else 3)
+    dts = [time_chained(lambda s: jitted(s, device_batch), state,
+                        iters=10 if quick() else 30)
+           for _ in range(trials)]
+    dt = min(dts)
     config = {"algorithm": name, "platform": jax.default_backend(),
               **(detail or {})}
+    if trials > 1:
+        config["trials_updates_per_sec"] = [round(1.0 / d, 2) for d in dts]
     if flops_per_update:
         config["analytic_flops_per_update"] = float(flops_per_update)
         peak = chip_peak_flops()
@@ -173,12 +183,21 @@ def main():
         return state, make_sac_update(actor, critic, 1.0, 0.99, 3e-4, 3e-4,
                                       3e-4, 0.995, -float(ACT))
 
-    bench_algo("REINFORCE", mk_reinforce, onpolicy_batch(B, T, OBS, ACT, rng))
+    # Full shape config on every row so per-family numbers are comparable
+    # across rounds (VERDICT r3 weak #6).
+    mlp_shape = {"B": B, "T": T, "obs_dim": OBS, "act_dim": ACT,
+                 "hidden_sizes": [128, 128]}
+    bench_algo("REINFORCE", mk_reinforce, onpolicy_batch(B, T, OBS, ACT, rng),
+               detail={"family": "mlp", **mlp_shape, "train_vf_iters": 20})
     bench_algo("IMPALA", mk_impala, onpolicy_batch(B, T, OBS, ACT, rng),
                flops_per_update=3 * mlp_fwd_flops(B * T, OBS, ACT, [128, 128]),
-               detail={"family": "mlp", "B": B, "T": T})
-    bench_algo("DQN", mk_dqn, offpolicy_batch(256, OBS, ACT, True, rng))
-    bench_algo("SAC", mk_sac, offpolicy_batch(256, OBS, ACT, False, rng))
+               detail={"family": "mlp", **mlp_shape})
+    bench_algo("DQN", mk_dqn, offpolicy_batch(256, OBS, ACT, True, rng),
+               detail={"family": "mlp", "batch_size": 256, "obs_dim": OBS,
+                       "act_dim": ACT, "hidden_sizes": [128, 128]})
+    bench_algo("SAC", mk_sac, offpolicy_batch(256, OBS, ACT, False, rng),
+               detail={"family": "mlp", "batch_size": 256, "obs_dim": OBS,
+                       "act_dim": ACT, "hidden_sizes": [128, 128]})
 
     # -- flagship non-MLP families: transformer-flash and CNN-pixel, both
     #    through the IMPALA update (the async-fleet north star for big
@@ -213,7 +232,28 @@ def main():
         flops_per_update=3 * transformer_fwd_flops(
             t_B * t_T, t_T, 64, 18, t_d, t_L),
         detail={"family": "transformer_flash" if ON_TPU else "transformer",
-                "B": t_B, "T": t_T, "d_model": t_d, "n_layers": t_L})
+                "B": t_B, "T": t_T, "d_model": t_d, "n_layers": t_L,
+                "n_heads": 8, "head_dim": t_d // 8})
+
+    # Compute-bound transformer demo shape (docs/parallelism.md roofline):
+    # head_dim = d_model/heads = 128 fills the MXU's 128 lanes (the
+    # serving default d=256/H=8 gives head_dim 32 -> <=25% lane occupancy,
+    # the shape bound behind the 13.6% MFU row), and the per-layer weight
+    # reuse over 4096 tokens puts arithmetic intensity ~4x the v5e ridge.
+    if ON_TPU and not quick():
+        big_arch = {"kind": "transformer_discrete", "obs_dim": 64,
+                    "act_dim": 18, "d_model": 1024, "n_layers": 4,
+                    "n_heads": 8, "max_seq_len": 1024, "has_critic": True,
+                    "attention": "flash", "attention_block": 256,
+                    "precision": "bfloat16"}
+        bench_algo(
+            "IMPALA", lambda: mk_impala_for(big_arch),
+            onpolicy_batch(4, 1024, 64, 18, rng),
+            flops_per_update=3 * transformer_fwd_flops(
+                4 * 1024, 1024, 64, 18, 1024, 4),
+            detail={"family": "transformer_flash_computebound", "B": 4,
+                    "T": 1024, "d_model": 1024, "n_layers": 4,
+                    "n_heads": 8, "head_dim": 128})
 
     from relayrl_tpu.models.cnn import NATURE_CONV
 
@@ -229,7 +269,8 @@ def main():
         flops_per_update=3 * cnn_fwd_flops(
             c_B * c_T, obs_shape, conv_spec, 512, 18),
         detail={"family": "cnn_pixel", "B": c_B, "T": c_T,
-                "obs_shape": list(obs_shape)})
+                "obs_shape": list(obs_shape),
+                "conv_spec": [list(s) for s in conv_spec], "dense": 512})
 
 
 if __name__ == "__main__":

@@ -101,6 +101,9 @@ def bench_ingest(backend: str, cfg) -> dict:
         wall = time.time() - t0
         return {
             "bench": "transport_ingest", "backend": backend,
+            # grpc resolves to the native HTTP/2 server when the .so is
+            # built — record which implementation actually served the row.
+            "server_impl": type(server).__name__,
             "config": {"agents": N_AGENTS, "traj_per_agent": TRAJ_PER_AGENT,
                        "payload_bytes": len(PAYLOAD),
                        "host_cores": os.cpu_count()},
@@ -161,6 +164,7 @@ def bench_fanout(backend: str, cfg) -> dict:
                        if len(receipts.get(v, [])) >= N_AGENTS)
         return {
             "bench": "transport_fanout", "backend": backend,
+            "server_impl": type(server).__name__,
             "config": {"agents": N_AGENTS, "model_bytes": len(MODEL),
                        "publishes": PUBLISHES,
                        "host_cores": os.cpu_count()},

@@ -216,6 +216,13 @@ class TrainingServer:
         with self._bundle_lock:
             return self._bundle_version, self._bundle_bytes
 
+    @property
+    def latest_model_version(self) -> int:
+        """Version of the most recently published model bundle — what an
+        agent's hot-swap should converge to (embedder/eval surface)."""
+        with self._bundle_lock:
+            return self._bundle_version
+
     def _on_register(self, agent_id: str) -> None:
         with self._registry_lock:
             if agent_id not in self.agent_ids:
