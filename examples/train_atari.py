@@ -106,6 +106,10 @@ def main():
             print(f"[atari:{args.algo}] target {args.target} reached",
                   flush=True)
             break
+    # Deterministic probe of the final policy (nothing reaches the learner).
+    eval_result = runner.evaluate(episodes=10, max_steps=500)
+    print(f"[atari:{args.algo}] greedy eval over 10 episodes: "
+          f"avg_return={eval_result['avg_return']:.2f}", flush=True)
 
 
 if __name__ == "__main__":
