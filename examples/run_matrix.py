@@ -69,13 +69,22 @@ CELLS = [
     # epsilon schedule completes inside the cell budget and the update-
     # to-data ratio is high enough for the greedy policy to clear random
     # CartPole (VERDICT r3 weak #4: the old cell's curve declined).
-    ("DQN", {"update_after": 256, "batch_size": 64, "updates_per_step": 1.0,
-             "traj_per_epoch": 8, "hidden_sizes": [64, 64], "lr": 5e-4,
+    # Stability-tuned: at ratio 1.0 / lr 5e-4 / polyak 0.995 this cell
+    # SOLVED CartPole then diverged (LossQ exploding to 1e5 on some runs,
+    # timing-dependent). Slow targets (polyak .999), quarter update
+    # ratio, and a tight per-ingest cap keep the target chase stable:
+    # greedy 9 -> 200 (the cap) in ~100 s, repeatably.
+    ("DQN", {"update_after": 256, "batch_size": 64, "updates_per_step": 0.25,
+             "traj_per_epoch": 8, "hidden_sizes": [64, 64], "lr": 2.5e-4,
+             "polyak": 0.999, "max_updates_per_ingest": 8,
              "epsilon_decay_steps": 3000, "epsilon_end": 0.05}, "zmq",
      _CARTPOLE, {"expects": "learning", "updates_scale": 40,
                  # the greedy trend is only meaningful once the epsilon
-                 # schedule has completed (~3000 env steps)
-                 "trend_gate_updates": 3000}),
+                 # schedule has completed; "updates" here counts
+                 # trajectory-grain ingest events (~17+ env steps each),
+                 # so 500 of them is comfortably past the 3000-env-step
+                 # decay horizon
+                 "trend_gate_updates": 500}),
     ("SAC", {"update_after": 64, "batch_size": 32, "updates_per_step": 0.25,
              "traj_per_epoch": 4, "hidden_sizes": [32, 32],
              "discrete": False, "act_limit": 2.0}, "native", _PENDULUM,

@@ -1,0 +1,96 @@
+"""Reward-credit alignment on the wire path (the round-4 fix).
+
+The protocol delivers the reward for action t with request t+1 (or with
+the terminal marker). The reference stores that incoming reward on the
+NEW record (agent_grpc.rs:434-441), shifting every reward one step late —
+tolerable for return-to-go policy gradients, but it inverts 1-step TD
+credit (DQN on a bandit converged to the WRONG arm). Our actor instead
+back-attaches the reward to the previous record via ``update_reward``, so
+``ActionRecord.rew`` always means "reward earned BY this action". These
+tests pin that invariant at every consumer: the raw wire bytes, the
+on-policy padded fold, and the off-policy transition assembly.
+"""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.runtime.policy_actor import PolicyActor
+from relayrl_tpu.types.model_bundle import ModelBundle
+from relayrl_tpu.types.trajectory import deserialize_actions
+
+OBS_DIM, ACT_DIM = 4, 2
+
+
+@pytest.fixture
+def actor():
+    from relayrl_tpu.models import build_policy
+
+    arch = {"kind": "mlp_discrete", "obs_dim": OBS_DIM, "act_dim": ACT_DIM,
+            "hidden_sizes": [8]}
+    policy = build_policy(arch)
+    import jax
+
+    params = policy.init_params(jax.random.PRNGKey(0))
+    sent: list[bytes] = []
+    a = PolicyActor(ModelBundle(version=1, arch=arch, params=params),
+                    max_traj_length=100, on_send=sent.append, seed=0)
+    a._sent = sent
+    return a
+
+
+def drive_episode(actor, rewards):
+    """The canonical loop: reward for action t arrives with request t+1;
+    the last action's reward rides the terminal marker."""
+    obs = np.zeros(OBS_DIM, np.float32)
+    actor.request_for_action(obs, reward=0.0)
+    for r in rewards[:-1]:
+        actor.request_for_action(obs, reward=r)
+    actor.flag_last_action(rewards[-1], truncated=False)
+
+
+def test_wire_records_carry_earned_rewards(actor):
+    rewards = [1.0, -2.0, 3.0, 0.5]
+    drive_episode(actor, rewards)
+    assert len(actor._sent) == 1
+    records = deserialize_actions(actor._sent[0])
+    steps = [r for r in records if r.act is not None]
+    marker = [r for r in records if r.act is None]
+    assert len(steps) == 4 and len(marker) == 1
+    # Every step's rew is the reward ITS action earned (marker carries the
+    # final one; fold_trailing_markers adds it to the last step).
+    assert [s.rew for s in steps] == [1.0, -2.0, 3.0, 0.0]
+    assert marker[0].rew == 0.5
+
+
+def test_onpolicy_fold_total_and_alignment(actor):
+    from relayrl_tpu.data.batching import pad_trajectory
+
+    rewards = [1.0, -2.0, 3.0, 0.5]
+    drive_episode(actor, rewards)
+    padded = pad_trajectory(deserialize_actions(actor._sent[0]),
+                            horizon=8, obs_dim=OBS_DIM, act_dim=ACT_DIM,
+                            discrete=True)
+    assert padded.length == 4
+    assert list(padded.rew[:4]) == [1.0, -2.0, 3.0, 0.5]
+    assert float(padded.rew.sum()) == pytest.approx(sum(rewards))
+
+
+def test_offpolicy_transitions_pair_action_with_its_reward(actor):
+    from relayrl_tpu.data.step_buffer import StepReplayBuffer
+
+    rewards = [1.0, -2.0, 3.0, 0.5]
+    drive_episode(actor, rewards)
+    buf = StepReplayBuffer(obs_dim=OBS_DIM, act_dim=ACT_DIM, capacity=100,
+                           discrete=True, seed=0)
+    stored = buf.add_episode(deserialize_actions(actor._sent[0]))
+    assert stored == 4
+    assert list(buf.rew[:4]) == [1.0, -2.0, 3.0, 0.5]
+    assert buf.done[3] == 1.0 and all(buf.done[:3] == 0.0)
+
+
+def test_zero_rewards_do_not_mark_updated(actor):
+    drive_episode(actor, [0.0, 0.0, 1.0])
+    records = deserialize_actions(actor._sent[0])
+    steps = [r for r in records if r.act is not None]
+    assert [s.rew for s in steps] == [0.0, 0.0, 0.0]
+    assert not steps[0].reward_updated
