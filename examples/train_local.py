@@ -38,22 +38,33 @@ def main():
     ap.add_argument("--updates", type=int, default=40)
     ap.add_argument("--target", type=float, default=None,
                     help="stop early once the rolling avg return passes this")
+    ap.add_argument("--continuous", action="store_true",
+                    help="lunarlander only: the continuous-action variant "
+                         "(needs Gymnasium Box2D) for the DDPG/TD3/SAC "
+                         "family")
     args = ap.parse_args()
 
     from relayrl_tpu.envs import make
     from relayrl_tpu.runtime.local_runner import LocalRunner
 
+    if args.continuous and args.env != "lunarlander":
+        ap.error("--continuous only applies to --env lunarlander")
     hp = {}
+    env_kwargs = {}
     if args.algo.upper() == "REINFORCE":
         hp["with_vf_baseline"] = args.baseline
     if args.env == "pendulum":
         hp.setdefault("discrete", False)
         hp.setdefault("act_limit", 2.0)
+    if args.continuous:
+        hp.setdefault("discrete", False)
+        hp.setdefault("act_limit", 1.0)
+        env_kwargs["continuous"] = True
 
     env_ids = {"cartpole": "CartPole-v1", "pendulum": "Pendulum-v1",
                "lunarlander": "LunarLander-v3"}
-    runner = LocalRunner(make(env_ids[args.env]), algorithm_name=args.algo,
-                         **hp)
+    runner = LocalRunner(make(env_ids[args.env], **env_kwargs),
+                         algorithm_name=args.algo, **hp)
     done_updates = 0
     while done_updates < args.updates:
         result = runner.train(epochs=min(5, args.updates - done_updates))

@@ -15,6 +15,9 @@ Modes (VERDICT r3 #2 and #9):
                  256-actor fleets
 * ``offpolicy``— DQN: replay buffer stays coordinator-side, sampled
                  transition batches broadcast, every rank steps
+* ``offpolicy_sac`` — SAC on a continuous bandit: the non-discrete
+                 sampled-batch broadcast + continuous actions on the
+                 wire; learned behavior probed via the policy mode
 * ``resume``   — kill-and-resume: train + collective checkpoint, tear the
                  whole server down, rebuild with ``resume=True`` (every
                  rank restores the same orbax step before the mesh is
@@ -57,9 +60,12 @@ import numpy as np  # noqa: E402
 
 from relayrl_tpu.runtime.server import TrainingServer  # noqa: E402
 
-ALGO = "DQN" if mode == "offpolicy" else "REINFORCE"
-TARGET_UPDATES = 60 if mode == "offpolicy" else (12 if mode == "resume"
-                                                 else 30)
+ALGO = {"offpolicy": "DQN", "offpolicy_sac": "SAC"}.get(mode, "REINFORCE")
+CONTINUOUS = mode == "offpolicy_sac"
+# Multi-host "updates" are broadcast DEVICE steps (one sampled batch per
+# tick), not trajectory ingests — the SAC bandit needs a few hundred.
+TARGET_UPDATES = {"offpolicy": 60, "offpolicy_sac": 300,
+                  "resume": 12}.get(mode, 30)
 
 # Per-rank config copy (identical content; avoids a write race on a shared
 # file): fast checkpoint cadence so the resume mode banks a step quickly.
@@ -76,6 +82,17 @@ HYPERPARAMS = {
             # Decay must complete within the cell's ~124 env steps, or the
             # published epsilon dominates the sampled p(arm1).
             "epsilon_decay_steps": 100, "epsilon_end": 0.05},
+    # Continuous bandit: reward 1 - (a - 0.5)^2 — exercises non-discrete
+    # sampled-batch broadcast (mh_zero_batch float act column) and
+    # continuous actions on the wire under the lockstep protocol.
+    # Default SAC lrs (pi/q/alpha 3e-4); the probe-calibrated budget of
+    # ~300 broadcast steps converges the policy mode at those defaults.
+    "SAC": {"traj_per_epoch": 8, "hidden_sizes": [16], "seed": 3,
+            "update_after": 32, "batch_size": 128,
+            # 4-step episodes: a high update-to-data ratio packs enough
+            # device steps into the cell budget
+            "updates_per_step": 4.0, "max_updates_per_ingest": 16,
+            "discrete": False, "act_limit": 1.0},
 }[ALGO]
 
 
@@ -103,7 +120,7 @@ def agent_addr_overrides(phase_ports):
 
 def build_server(phase_ports, resume, start=True):
     return TrainingServer(
-        ALGO, obs_dim=3, act_dim=2, env_dir=scratch,
+        ALGO, obs_dim=3, act_dim=1 if CONTINUOUS else 2, env_dir=scratch,
         server_type=("native" if mode == "native" else "zmq"),
         config_path=cfg_path,
         hyperparams=HYPERPARAMS,
@@ -127,7 +144,11 @@ class _BanditEnv:
 
     def step(self, action):
         self._t += 1
-        rew = 1.0 if int(np.asarray(action).reshape(-1)[0]) == 1 else 0.0
+        if CONTINUOUS:
+            a = float(np.asarray(action).reshape(-1)[0])
+            rew = 1.0 - (a - 0.5) ** 2
+        else:
+            rew = 1.0 if int(np.asarray(action).reshape(-1)[0]) == 1 else 0.0
         return self.obs, rew, self._t >= self.horizon, False, {}
 
 
@@ -177,14 +198,26 @@ def drive_fleet(server, phase_ports, target_updates, tag):
         bundle = ModelBundle.from_bytes(server._bundle_bytes)
     policy = build_policy(bundle.arch)
     explore = exploration_kwargs(bundle.arch)
-    rng = jax.random.PRNGKey(0)
     obs = np.zeros(3, np.float32)
-    ones = 0
+    if CONTINUOUS:
+        # SAC's entropy target keeps the SAMPLED policy wide on a bandit;
+        # the deterministic mode is the right learned-behavior probe. The
+        # mode starts at tanh(0)=0 (score 0.75) and drifts toward the
+        # optimum 0.5 — require both an absolute score and clear
+        # directional movement off the init.
+        import jax.numpy as jnp
+
+        m = float(np.asarray(policy.mode(
+            bundle.params, jnp.asarray(obs), None)).reshape(-1)[0])
+        assert m >= 0.05, f"policy mode never moved toward 0.5: {m}"
+        return 1.0 - (m - 0.5) ** 2
+    rng = jax.random.PRNGKey(0)
+    score = 0.0
     for _ in range(200):
         rng, sub = jax.random.split(rng)
         act, _ = policy.step(bundle.params, sub, obs, None, **explore)
-        ones += int(np.asarray(act).reshape(-1)[0] == 1)
-    return ones / 200.0
+        score += float(np.asarray(act).reshape(-1)[0] == 1)
+    return score / 200.0
 
 
 def wait_for_stop(server):

@@ -5,10 +5,12 @@ socket agents feed the coordinator's ingest while BOTH processes of a
 2-process CPU-mesh learner execute the sharded update in lockstep via the
 server's broadcast loop. Cells: on-policy over ZMQ (learns a bandit),
 the same fleet over the native framed-TCP transport, off-policy DQN
-(replay buffer coordinator-side, sampled batches broadcast), and
-kill-and-resume (collective orbax checkpoint → full teardown → resume on
-both ranks → further training). Complements test_multihost.py (which
-exercises the primitives without the server).
+(replay buffer coordinator-side, sampled batches broadcast), off-policy
+SAC on a continuous bandit (non-discrete sampled-batch broadcast +
+continuous actions on the wire), and kill-and-resume (collective orbax
+checkpoint → full teardown → resume on both ranks → further training).
+Complements test_multihost.py (which exercises the primitives without
+the server).
 """
 
 import os
@@ -42,6 +44,7 @@ def _native_lib_available() -> bool:
         not _native_lib_available(),
         reason="native library not built (make -C native)")),
     "offpolicy",
+    "offpolicy_sac",
     "resume",
 ])
 def test_fleet_trains_two_process_learner(tmp_path, mode):
