@@ -1,8 +1,8 @@
-"""Worker for the live-fleet → 2-process multi-host TrainingServer tests.
+"""Worker for the live-fleet → multi-process TrainingServer tests.
 
-Each of two OS processes builds a real :class:`TrainingServer` over a
-shared ``jax.distributed`` coordinator (4 virtual CPU devices each → an
-8-device global dp mesh). The coordinator (rank 0) also runs two real
+Each of N OS processes (RELAYRL_NUM_PROCESSES, default 2) builds a real
+:class:`TrainingServer` over a shared ``jax.distributed`` coordinator
+(4 virtual CPU devices each → a 4N-device global dp mesh). The coordinator (rank 0) also runs two real
 socket :class:`Agent` threads driving a two-armed bandit; trajectories
 flow over real sockets into the coordinator's ingest, and every training
 batch is broadcast so BOTH processes execute the sharded update in
@@ -49,7 +49,10 @@ os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=4 "
     + os.environ.get("XLA_FLAGS", ""))
 os.environ["RELAYRL_COORDINATOR"] = f"127.0.0.1:{coord_port}"
-os.environ["RELAYRL_NUM_PROCESSES"] = "2"
+# The spawning test sets RELAYRL_NUM_PROCESSES for >2-rank cells; the
+# lockstep protocol is rank-count agnostic.
+os.environ.setdefault("RELAYRL_NUM_PROCESSES", "2")
+NUM_PROCS = int(os.environ["RELAYRL_NUM_PROCESSES"])
 os.environ["RELAYRL_PROCESS_ID"] = str(rank)
 
 import jax  # noqa: E402
@@ -94,6 +97,10 @@ HYPERPARAMS = {
             "updates_per_step": 4.0, "max_updates_per_ingest": 16,
             "discrete": False, "act_limit": 1.0},
 }[ALGO]
+if ALGO == "REINFORCE" and NUM_PROCS > 2:
+    # The epoch batch rows shard over dp = 4*NUM_PROCS virtual devices;
+    # keep the batch divisible by the mesh.
+    HYPERPARAMS["traj_per_epoch"] = 4 * NUM_PROCS
 
 
 def server_addr_overrides(phase_ports):
@@ -233,15 +240,17 @@ def allgather_version(server):
 
     versions = multihost_utils.process_allgather(
         np.int64(server.algorithm.version))
-    assert versions.shape[0] == 2 and versions[0] == versions[1], versions
+    assert versions.shape[0] == NUM_PROCS, versions
+    assert all(v == versions[0] for v in versions), versions
     return int(versions[0])
 
 
 server = build_server(ports[:3], resume=False)
-assert server.distributed_info == {"multi_host": True, "process_id": rank,
-                                   "num_processes": 2}, server.distributed_info
+assert server.distributed_info == {
+    "multi_host": True, "process_id": rank,
+    "num_processes": NUM_PROCS}, server.distributed_info
 assert (server.transport is not None) == (rank == 0)
-assert jax.device_count() == 8
+assert jax.device_count() == 4 * NUM_PROCS
 
 p1 = -1.0
 if rank == 0:
