@@ -92,7 +92,16 @@ def _global_put(x, sharding):
         return jax.device_put(x, sharding)
     import numpy as np
 
-    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+    x = np.asarray(x)
+    # global_shape MUST be passed: without it JAX deduces the global shape
+    # by SCALING every process-spanning sharded dim by its process count —
+    # i.e. it treats x as this process's private shard. Our convention is
+    # the opposite (x is the host-global array, identical on every
+    # process), and the deduction silently DUPLICATED batch rows along dp
+    # (benign for mean-reduced losses, 2x wasted compute) and doubled the
+    # time axis under cross-process sp (positional-table overflow).
+    return jax.make_array_from_process_local_data(sharding, x,
+                                                  global_shape=x.shape)
 
 
 def place_state(state, mesh: Mesh):

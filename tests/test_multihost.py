@@ -58,6 +58,8 @@ def test_two_process_distributed(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"MULTIHOST_OK rank={rank}" in out, out
+        # Ring attention with the sp ring spanning both processes.
+        assert f"MULTIHOST_RING_OK rank={rank}" in out, out
     # Both ranks computed the identical replicated loss.
     losses = {line.split("loss_pi=")[1]
               for out in outs for line in out.splitlines()
