@@ -110,6 +110,68 @@ class ActionRecord:
             msgpack.unpackb(buf, raw=False, ext_hook=_ext_hook, strict_map_key=False)
         )
 
+    # -- JSON codec. Method-name parity with the reference's surface
+    #    (PyRelayRLAction.to_json / action_from_json,
+    #    bindings/python/o3_action.rs:29-235), NOT format parity — a
+    #    deliberate departure, like the msgpack-for-pickle swap documented
+    #    in trajectory.py: the reference feeds an already-parsed dict with
+    #    tensors as {"inner": {shape, dtype: "Float", data}} to its learner
+    #    IPC; here from_json takes the JSON *string* to_json produced, and
+    #    tensors are tagged {"__tensor__": {dtype, shape, data|b64}} so
+    #    numpy dtype + shape survive exactly. Human-readable debug/interop
+    #    surface — the hot path stays msgpack (to_bytes). Output is strict
+    #    RFC 8259 (allow_nan=False; non-finite floats are tagged), so
+    #    serde_json/JSON.parse-class decoders accept it. --
+    def to_jsonable(self) -> dict:
+        """Plain-dict form of :meth:`to_json` (no string encode) — used by
+        :meth:`Trajectory.to_json` to avoid per-action re-parsing."""
+        return {
+            "obs": _tensor_to_jsonable(self.obs),
+            "act": _tensor_to_jsonable(self.act),
+            "mask": _tensor_to_jsonable(self.mask),
+            "rew": _float_to_jsonable(float(self.rew)),
+            "data": (
+                None
+                if self.data is None
+                else {k: _aux_to_jsonable(v) for k, v in self.data.items()}
+            ),
+            "done": bool(self.done),
+            "reward_updated": bool(self.reward_updated),
+            "truncated": bool(self.truncated),
+        }
+
+    @classmethod
+    def from_jsonable(cls, obj: Mapping) -> "ActionRecord":
+        data = obj.get("data")
+        return cls(
+            obs=_tensor_field_from_jsonable(obj.get("obs"), "obs"),
+            act=_tensor_field_from_jsonable(obj.get("act"), "act"),
+            mask=_tensor_field_from_jsonable(obj.get("mask"), "mask"),
+            rew=_float_from_jsonable(obj.get("rew", 0.0)),
+            data=(
+                None
+                if data is None
+                else {k: _aux_from_jsonable(v) for k, v in data.items()}
+            ),
+            done=bool(obj.get("done", False)),
+            reward_updated=bool(obj.get("reward_updated", False)),
+            truncated=bool(obj.get("truncated", False)),
+        )
+
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps(self.to_jsonable(), allow_nan=False)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ActionRecord":
+        import json
+
+        return cls.from_jsonable(json.loads(text))
+
+    # reference static-method name (o3_action.rs `action_from_json`)
+    action_from_json = from_json
+
 
 def _pack_opt_tensor(value) -> msgpack.ExtType | None:
     if value is None:
@@ -161,3 +223,140 @@ def _ext_hook(code: int, payload: bytes):
     if code == EXT_TENSOR:
         return decode_tensor(payload)
     return msgpack.ExtType(code, payload)
+
+
+def _tensor_to_jsonable(value):
+    """Tagged JSON form `{"__tensor__": {dtype, shape, data|b64}}` — keeps
+    dtype + shape exact through a round trip (a bare nested list would
+    collapse float32 -> float64 and lose empty-dim shapes). Float arrays
+    holding non-finite values (e.g. -inf action-mask fills) switch the
+    payload to base64 raw bytes: RFC 8259 has no NaN/Infinity literal, so
+    a tolist() form would either crash allow_nan=False or emit JSON that
+    serde_json/JSON.parse-class decoders reject."""
+    if value is None:
+        return None
+    arr = np.asarray(value)
+    t = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
+    if _has_nonfinite(arr):
+        import base64
+
+        # Fixed little-endian payload (same convention as tensor.py's
+        # binary wire): dtype.name carries no endianness mark, so bytes
+        # must be order-normalized on the writer, not trusted to match
+        # the reader's native order.
+        t["b64"] = base64.b64encode(_to_le_bytes(arr)).decode("ascii")
+    else:
+        t["data"] = arr.tolist()
+    return {"__tensor__": t}
+
+
+def _has_nonfinite(arr: np.ndarray) -> bool:
+    """True when a float-like array (incl. bfloat16/float8, numpy kind
+    'V') holds values JSON has no literal for (NaN/Infinity)."""
+    if arr.dtype.kind not in "fV":
+        return False
+    try:
+        return not bool(np.isfinite(arr).all())
+    except TypeError:  # structured void dtypes — not float-like
+        return False
+
+
+def _to_le_bytes(arr: np.ndarray) -> bytes:
+    if arr.dtype.kind == "f":
+        le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+        return np.ascontiguousarray(le).tobytes()
+    # Custom float-likes (bfloat16/float8) have no numpy byte-order
+    # variant; normalize through a little-endian unsigned view of the
+    # same width.
+    width = arr.dtype.itemsize
+    uview = np.ascontiguousarray(arr).view(f"u{width}")
+    return uview.astype(f"<u{width}", copy=False).tobytes()
+
+
+def _from_le_bytes(raw: bytes, dtype: np.dtype, shape) -> np.ndarray:
+    if dtype.kind == "f":
+        le = np.frombuffer(raw, dtype=dtype.newbyteorder("<"))
+        return le.astype(dtype, copy=True).reshape(shape)
+    width = dtype.itemsize
+    units = np.frombuffer(raw, dtype=f"<u{width}").astype(f"=u{width}")
+    return units.view(dtype).reshape(shape).copy()
+
+
+def _tensor_from_jsonable(value):
+    if value is None:
+        return None
+    if isinstance(value, dict) and "__tensor__" in value:
+        t = value["__tensor__"]
+        dtype = np.dtype(t["dtype"])
+        if "b64" in t:
+            import base64
+
+            return _from_le_bytes(
+                base64.b64decode(t["b64"]), dtype, t["shape"])
+        return np.asarray(t["data"], dtype=dtype).reshape(t["shape"])
+    return value  # plain aux scalar (int/float/str/bool)
+
+
+def _tensor_field_from_jsonable(value, field: str):
+    """Strict decode for obs/act/mask: tensor-tagged or null only — the
+    JSON twin of :func:`_unpack_opt_tensor`'s TypeError on non-tensor
+    frames, so a malformed/foreign-format field fails at decode time
+    instead of smuggling a plain dict into the record."""
+    if value is None:
+        return None
+    if isinstance(value, dict) and "__tensor__" in value:
+        return _tensor_from_jsonable(value)
+    raise TypeError(
+        f"{field!r} must be a tagged tensor object or null, "
+        f"got {type(value).__name__}")
+
+
+def _float_to_jsonable(x: float):
+    """Non-finite floats as tagged strings (RFC 8259 has no literal)."""
+    return x if np.isfinite(x) else {"__float__": repr(x)}
+
+
+def _float_from_jsonable(x) -> float:
+    if isinstance(x, dict) and "__float__" in x:
+        return float(x["__float__"])
+    return float(x)
+
+
+def _aux_to_jsonable(value):
+    """Mirror of :func:`_pack_aux` semantics for the JSON surface: 0-d
+    numpy scalars unwrap to native Python (so both codecs decode a record
+    identically), arrays/jax values become tagged tensors, bytes become
+    tagged base64, non-finite plain floats are tagged, and anything
+    outside that union raises — exactly the set :func:`_pack_aux`
+    accepts, so a record is JSON-encodable iff it is msgpack-encodable
+    (rejecting dicts here also closes tag injection: no user value can
+    collide with the ``__tensor__``/``__bytes__``/``__float__`` tags)."""
+    if isinstance(value, np.generic) and getattr(value, "shape", None) == ():
+        value = value.item()
+    if isinstance(value, (np.ndarray, np.generic)) or (
+        hasattr(value, "dtype") and hasattr(value, "shape")
+    ):
+        return _tensor_to_jsonable(np.asarray(value))
+    if isinstance(value, bytes):
+        import base64
+
+        return {"__bytes__": base64.b64encode(value).decode("ascii")}
+    if isinstance(value, float):
+        return _float_to_jsonable(value)
+    if isinstance(value, (bool, int, str)):
+        return value
+    raise TypeError(
+        f"aux data has unsupported type {type(value)!r} for JSON encoding")
+
+
+def _aux_from_jsonable(value):
+    if isinstance(value, dict):
+        if "__tensor__" in value:
+            return _tensor_from_jsonable(value)
+        if "__bytes__" in value:
+            import base64
+
+            return base64.b64decode(value["__bytes__"])
+        if "__float__" in value:
+            return _float_from_jsonable(value)
+    return value

@@ -110,6 +110,45 @@ class Trajectory:
         traj._actions = actions
         return traj
 
+    # -- JSON codec. Method-name parity with the reference's surface
+    #    (PyRelayRLTrajectory.to_json / traj_from_json,
+    #    bindings/python/o3_trajectory.rs:113-166), NOT format parity —
+    #    a deliberate departure (see the action.py JSON codec note and
+    #    this module's docstring): from_json takes the JSON string
+    #    to_json produced, carries a version field, and uses the tagged
+    #    tensor form. Debug/interop surface; the hot path stays msgpack
+    #    (to_bytes). --
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps(
+            {
+                "version": WIRE_VERSION,
+                "max_length": self.max_length,
+                "actions": [a.to_jsonable() for a in self._actions],
+            },
+            allow_nan=False,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Trajectory":
+        import json
+
+        obj = json.loads(text)
+        version = obj.get("version")
+        if version != WIRE_VERSION:
+            raise ValueError(
+                f"unsupported trajectory json version: {version}")
+        actions = [
+            ActionRecord.from_jsonable(a) for a in obj.get("actions", [])
+        ]
+        traj = cls(max_length=obj.get("max_length") or max(len(actions), 1))
+        traj._actions = actions
+        return traj
+
+    # reference static-method name (o3_trajectory.rs `traj_from_json`)
+    traj_from_json = from_json
+
 
 def serialize_actions(actions: Iterable[ActionRecord]) -> bytes:
     """Actions → one msgpack frame (ref codec: trajectory.rs:50-55)."""

@@ -154,6 +154,116 @@ class TestActionRecord:
         assert a.get_done() is False
         np.testing.assert_array_equal(a.get_obs(), a.obs)
 
+    def test_json_round_trip(self):
+        # Reference API parity: to_json / action_from_json
+        # (bindings/python/o3_action.rs:29-235).
+        a = self._sample()
+        b = ActionRecord.action_from_json(a.to_json())
+        np.testing.assert_array_equal(b.obs, a.obs)
+        assert b.obs.dtype == a.obs.dtype  # dtype survives the text form
+        np.testing.assert_array_equal(b.act, a.act)
+        assert b.act.dtype == np.int32
+        assert b.rew == pytest.approx(a.rew)
+        assert b.data["note"] == "aux"
+        assert b.data["count"] == 7
+        np.testing.assert_array_equal(b.data["vec"], a.data["vec"])
+        assert b.data["vec"].dtype == np.float64
+
+    def test_json_none_fields(self):
+        a = ActionRecord(rew=0.5, done=True, truncated=True)
+        b = ActionRecord.from_json(a.to_json())
+        assert b.obs is None and b.act is None and b.mask is None
+        assert b.done is True and b.truncated is True
+
+    def test_json_nonfinite_and_bytes(self):
+        # RFC 8259 has no NaN/Infinity literal: -inf mask fills, non-finite
+        # rewards, and bytes aux values must still round-trip and the
+        # output must parse under strict decoders (allow_nan=False).
+        import json
+
+        mask = np.array([0.0, -np.inf, 1.0], dtype=np.float32)
+        a = ActionRecord(
+            obs=np.arange(2, dtype=np.float32),
+            mask=mask,
+            rew=float("-inf"),
+            data={"blob": b"\x00\xffraw", "nanval": float("nan")},
+        )
+        text = a.to_json()
+        json.loads(text)  # strict: would raise on bare NaN/Infinity tokens
+        assert "Infinity" not in text and "NaN" not in text
+        b = ActionRecord.from_json(text)
+        np.testing.assert_array_equal(b.mask, mask)
+        assert b.mask.dtype == np.float32
+        assert b.rew == float("-inf")
+        assert b.data["blob"] == b"\x00\xffraw"
+        assert np.isnan(b.data["nanval"])
+
+    def test_json_matches_msgpack_aux_semantics(self):
+        # Both codecs must decode the same record to the same aux types:
+        # 0-d numpy scalars unwrap to native Python on both paths.
+        a = self._sample()
+        via_msgpack = ActionRecord.from_bytes(a.to_bytes())
+        via_json = ActionRecord.from_json(a.to_json())
+        for key in a.data:
+            assert type(via_json.data[key]) is type(via_msgpack.data[key]), key
+
+    def test_json_zero_dim_shape_preserved(self):
+        # A 0-d scalar tensor must keep shape () through JSON, like msgpack.
+        a = ActionRecord(act=np.array(2, dtype=np.int64),
+                         obs=np.array(1.5, dtype=np.float32))
+        b = ActionRecord.from_json(a.to_json())
+        assert b.act.shape == () and b.act.dtype == np.int64
+        assert int(b.act) == 2
+        assert b.obs.shape == ()
+        # 0-d non-finite goes through the b64 branch; shape still ()
+        c = ActionRecord(obs=np.array(np.inf, dtype=np.float32))
+        d = ActionRecord.from_json(c.to_json())
+        assert d.obs.shape == () and np.isinf(d.obs)
+
+    def test_json_big_endian_b64_exact(self):
+        # dtype.name drops byte order; the b64 path must normalize to
+        # little-endian before serializing or a '>f4' array decodes to
+        # garbage.
+        mask = np.array([0.0, -np.inf, 1.0], dtype=">f4")
+        a = ActionRecord(mask=mask)
+        b = ActionRecord.from_json(a.to_json())
+        np.testing.assert_array_equal(b.mask, mask.astype("<f4"))
+
+    def test_json_bfloat16_nonfinite(self):
+        # bf16 has numpy kind 'V', not 'f' — a kind=='f' gate would send
+        # a bf16 -inf mask down tolist() and crash allow_nan=False. TPU
+        # runs mask in bf16, so this is the codec's bread-and-butter fill.
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        mask = np.array([0.0, -np.inf, 1.0], dtype=bf16)
+        a = ActionRecord(mask=mask, data={"w": np.array([np.nan], bf16)})
+        b = ActionRecord.from_json(a.to_json())
+        assert b.mask.dtype == bf16
+        np.testing.assert_array_equal(
+            b.mask.astype(np.float32), mask.astype(np.float32))
+        assert np.isnan(b.data["w"].astype(np.float32)).all()
+
+    def test_json_rejects_untagged_tensor_fields(self):
+        # obs/act/mask must be tagged-tensor or null: a foreign tensor
+        # form (e.g. the reference's {"shape","dtype","data"}) fails at
+        # decode instead of smuggling a dict into the record.
+        import json
+
+        obj = {"obs": {"shape": [1], "dtype": "Float", "data": [1.0]},
+               "rew": 0.0, "done": False, "reward_updated": False}
+        with pytest.raises(TypeError, match="obs"):
+            ActionRecord.from_json(json.dumps(obj))
+
+    def test_json_rejects_unsupported_aux_like_msgpack(self):
+        # JSON-encodable iff msgpack-encodable: lists/dicts raise on both
+        # paths (also closes __bytes__/__tensor__ tag injection via dicts).
+        for bad in ([1, 2], {"__bytes__": "AAAA"}, None):
+            a = ActionRecord(data={"bad": bad})
+            with pytest.raises(TypeError):
+                a.to_bytes()
+            with pytest.raises(TypeError):
+                a.to_json()
+
 
 class TestTrajectory:
     def _action(self, i, done=False):
@@ -173,6 +283,29 @@ class TestTrajectory:
         for i, a in enumerate(out):
             np.testing.assert_array_equal(a.obs, actions[i].obs)
             assert a.rew == float(i)
+
+    def test_json_round_trip(self):
+        # Reference API parity: to_json / traj_from_json
+        # (bindings/python/o3_trajectory.rs:113-166).
+        traj = Trajectory(max_length=16)
+        for i in range(4):
+            traj.add_action(self._action(i, done=(i == 3)),
+                            send_if_done=False)
+        out = Trajectory.traj_from_json(traj.to_json())
+        assert len(out) == 4
+        assert out.max_length == 16
+        assert out.get_actions()[-1].done is True
+        for i, a in enumerate(out.get_actions()):
+            np.testing.assert_array_equal(a.obs, traj.get_actions()[i].obs)
+            assert a.act.dtype == np.int64
+
+    def test_json_bad_version_rejected(self):
+        import json
+
+        obj = json.loads(Trajectory(max_length=4).to_json())
+        obj["version"] = 99
+        with pytest.raises(ValueError, match="version"):
+            Trajectory.from_json(json.dumps(obj))
 
     def test_send_on_done_clears(self):
         sent = []
