@@ -19,9 +19,34 @@ happen — index maps are static — but the matmuls are skipped).
 The backward pass is two more Pallas kernels (the standard two-pass flash
 VJP — no atomics or cross-block communication): a dq pass (grid q-major,
 KV innermost, accumulator in VMEM) and a dk/dv pass (grid kv-major, Q
-innermost), both recomputing p from the saved ``lse`` residual and using
-the identity ``ds = p * (dp - rowsum(do * o))``. Peak memory stays
+innermost), both recomputing p from the saved log-sum-exp residual and
+using the identity ``ds = p * (dp - rowsum(do * o))``. Peak memory stays
 O(T * block).
+
+VPU economy (the kernels are partly elementwise-bound at head_dim 64 —
+the two block matmuls only quarter-fill the MXU contraction depth, so the
+[block_q, block_kv] softmax traffic shows up on the critical path; a
+same-session on-chip A/B measured the changes below 2.2x faster fwd at
+T=2048 / 1.4x at T=8192 on v5e — ratios, not absolute ms, since the
+tunneled chip's throughput drifts between sessions; benches/README.md
+carries the caveat):
+
+* **log2-space softmax**: ``1/sqrt(D) * log2(e)`` is folded into q OUTSIDE
+  the kernel (one fused elementwise on the [BH, T, D] operand, 16x fewer
+  multiplies than scaling every [block_q, block_kv] score tile), so the
+  in-kernel recurrence uses ``exp2`` — faster than ``exp`` on the VPU —
+  and the saved residual is the log2-space LSE. The backward finalizers
+  undo the folding per output tile: ``dq = scale * acc`` and
+  ``dk = acc / log2(e)`` (dk's score-recompute contracts against the
+  pre-scaled q), a [block, D]-sized multiply once per block instead of a
+  [block_q, block_kv] one per grid step.
+* **diagonal specialization**: causal masking (two iotas, a compare and a
+  select over the full score tile) runs only on blocks that straddle the
+  diagonal; strictly-below blocks take a mask-free path. The separate
+  underflow guard the masked path used to carry is gone: with the KV axis
+  innermost the first block (k_start = 0) is live for every query row, so
+  the running max is finite from step 0 and ``exp2(-1e30 - m)`` flushes
+  to exactly 0 for masked entries.
 
 Numerics: scores/softmax in float32 regardless of input dtype; the second
 matmul runs in float32 against the f32 accumulator (MXU-friendly since
@@ -38,19 +63,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634
 
 
-def _masked_scores(q_ref, k_ref, q_start, k_start, causal: bool,
-                   block_q: int, block_kv: int, scale: float):
-    """Scaled (and causally masked) score tile for the current block pair —
-    the recompute shared by the forward and both backward kernels. Inputs
-    stay in their storage dtype (bf16 in production): the MXU runs
+def _masked_scores2(q_ref, k_ref, q_start, k_start, masked: bool,
+                    block_q: int, block_kv: int):
+    """Log2-space score tile for the current block pair — the recompute
+    shared by the forward and both backward kernels. q arrives pre-scaled
+    by ``log2(e)/sqrt(D)`` so no per-tile multiply is needed. Inputs stay
+    in their storage dtype (bf16 in production): the MXU runs
     bf16 x bf16 -> f32 at full rate, while casting to f32 first would
     quarter the matmul throughput; softmax math stays f32."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    if causal:
+        preferred_element_type=jnp.float32)
+    if masked:
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(
@@ -59,8 +86,35 @@ def _masked_scores(q_ref, k_ref, q_start, k_start, causal: bool,
     return s
 
 
+def _dispatch(update, q_ref, k_ref, q_start, k_start, causal: bool,
+              block_q: int, block_kv: int):
+    """Shared block-class dispatch for all three kernels: skip blocks
+    strictly above the causal diagonal, run mask-free on ``interior``
+    blocks (strictly at-or-below it), and pay the iota/compare/select
+    masking only on blocks that straddle the diagonal. ``live`` iff the
+    block's first key comes no later than its last query. Keeping this in
+    one place keeps forward and backward masking synchronized by
+    construction."""
+    if not causal:
+        update(_masked_scores2(q_ref, k_ref, q_start, k_start, False,
+                               block_q, block_kv))
+        return
+    live = k_start <= q_start + block_q - 1
+    interior = k_start + block_kv - 1 <= q_start
+
+    @pl.when(interior)
+    def _interior():
+        update(_masked_scores2(q_ref, k_ref, q_start, k_start, False,
+                               block_q, block_kv))
+
+    @pl.when(live & jnp.logical_not(interior))
+    def _diagonal():
+        update(_masked_scores2(q_ref, k_ref, q_start, k_start, True,
+                               block_q, block_kv))
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, causal: bool, block_q: int, block_kv: int, scale: float):
+                *, causal: bool, block_q: int, block_kv: int):
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -71,32 +125,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     q_start = pl.program_id(1) * block_q
     k_start = ik * block_kv
-    # Causal: the whole KV block is masked iff its first key comes after the
-    # last query of this Q block.
-    live = (k_start <= q_start + block_q - 1) if causal else True
 
-    @pl.when(live)
-    def _block():
-        s = _masked_scores(q_ref, k_ref, q_start, k_start, causal,
-                           block_q, block_kv, scale)
+    def update(s):
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        # Masked entries carry s == _NEG_INF; exp(s - m_new) underflows to 0
-        # except when m_new itself is _NEG_INF (a fully-masked row, which
-        # causal + ik==0 never produces for valid rows) — guard anyway.
-        p = jnp.where(s > 0.5 * _NEG_INF, jnp.exp(s - m_new), 0.0)
-        corr = jnp.exp(m_prev - m_new)
+        # Masked entries carry s == _NEG_INF; with KV innermost, block
+        # ik == 0 is fully live, so m_new is finite for every valid row
+        # and exp2(_NEG_INF - m_new) flushes to exactly 0.
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
+    _dispatch(update, q_ref, k_ref, q_start, k_start, causal,
+              block_q, block_kv)
+
     @pl.when(ik == pl.num_programs(2) - 1)
     def _finalize():
         l = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[:] = (m_ref[:] + jnp.log(l)).reshape(lse_ref.shape)
+        # log2-space LSE — the backward recomputes p = exp2(s2 - lse2).
+        lse_ref[:] = (m_ref[:] + jnp.log2(l)).reshape(lse_ref.shape)
 
 
 @functools.lru_cache(maxsize=None)
@@ -104,8 +156,7 @@ def _build_fwd(T: int, D: int, causal: bool, block_q: int, block_kv: int,
                in_dtype_name: str, interpret: bool):
     """Compile-cached pallas_call for a [BH, T, D] layout forward."""
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, block_q=block_q, block_kv=block_kv,
-        scale=1.0 / (D ** 0.5))
+        _fwd_kernel, causal=causal, block_q=block_q, block_kv=block_kv)
     grid = (None, T // block_q, T // block_kv)  # BH filled per call
 
     def call(qr, kr, vr):
@@ -151,12 +202,22 @@ def _bht_to_bthd(x, B, H):
     return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
+def _prescale_q(qr):
+    """Fold softmax scale and the exp->exp2 base change into q: one fused
+    elementwise over [BH, T, D] instead of a multiply on every
+    [block_q, block_kv] score tile inside the kernels."""
+    D = qr.shape[-1]
+    c = _LOG2E / (D ** 0.5)
+    return (qr.astype(jnp.float32) * c).astype(qr.dtype)
+
+
 def _fwd(q, k, v, causal, block_q, block_kv, interpret):
     B, T, H, D = q.shape
     call = _build_fwd(T, D, causal, block_q, block_kv, q.dtype.name,
                       interpret)
-    out, lse = call(_bthd_to_bht(q), _bthd_to_bht(k), _bthd_to_bht(v))
-    return _bht_to_bthd(out, B, H), lse.reshape(B, H, T)
+    out, lse2 = call(_prescale_q(_bthd_to_bht(q)), _bthd_to_bht(k),
+                     _bthd_to_bht(v))
+    return _bht_to_bthd(out, B, H), lse2.reshape(B, H, T)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -170,29 +231,30 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     q_start = pl.program_id(1) * block_q
     k_start = ik * block_kv
-    live = (k_start <= q_start + block_q - 1) if causal else True
 
-    @pl.when(live)
-    def _block():
-        s = _masked_scores(q_ref, k_ref, q_start, k_start, causal,
-                           block_q, block_kv, scale)
-        p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
+    def update(s):
+        p = jnp.exp2(s - lse_ref[0])                      # [bq, bk]
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta_ref[0])
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    _dispatch(update, q_ref, k_ref, q_start, k_start, causal,
+              block_q, block_kv)
+
     @pl.when(ik == pl.num_programs(2) - 1)
     def _finalize():
-        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+        # acc holds d/d(q.k) contractions; one [block_q, D] multiply undoes
+        # the score scaling (ds was accumulated in natural space).
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
-                block_q: int, block_kv: int, scale: float):
+                block_q: int, block_kv: int):
     iq = pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -202,27 +264,29 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = iq * block_q
     k_start = pl.program_id(1) * block_kv
-    live = (k_start <= q_start + block_q - 1) if causal else True
 
-    @pl.when(live)
-    def _block():
-        s = _masked_scores(q_ref, k_ref, q_start, k_start, causal,
-                           block_q, block_kv, scale)
-        p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
+    def update(s):
+        p = jnp.exp2(s - lse_ref[0])                      # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta_ref[0])
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    _dispatch(update, q_ref, k_ref, q_start, k_start, causal,
+              block_q, block_kv)
+
     @pl.when(iq == pl.num_programs(2) - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        # dk contracted ds against the PRE-SCALED q (scale * log2e folded
+        # in), while true dk = scale * (ds^T @ q_unscaled) — so divide the
+        # extra log2e back out. dv never touches scores: exact as-is.
+        dk_ref[0] = (dk_acc[:] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -238,8 +302,7 @@ def _build_bwd(T: int, D: int, causal: bool, block_q: int, block_kv: int,
     dq_kernel = functools.partial(_dq_kernel, causal=causal, block_q=block_q,
                                   block_kv=block_kv, scale=scale)
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal,
-                                   block_q=block_q, block_kv=block_kv,
-                                   scale=scale)
+                                   block_q=block_q, block_kv=block_kv)
     row_spec_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     row_spec_kv_inner = pl.BlockSpec((1, block_q, 1),
                                      lambda b, j, i: (b, i, 0))
@@ -292,13 +355,14 @@ def _build_bwd(T: int, D: int, causal: bool, block_q: int, block_kv: int,
     return call
 
 
-def _bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_kv, interpret):
+def _bwd_pallas(q, k, v, out, lse2, do, causal, block_q, block_kv, interpret):
     B, T, H, D = q.shape
     qr, kr, vr, dor = (_bthd_to_bht(x) for x in (q, k, v, do))
+    qr = _prescale_q(qr)  # the kernels recompute log2-space scores
     of = _bthd_to_bht(out)
     delta = jnp.sum(dor.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)              # [BH, T, 1]
-    lse3 = lse.reshape(B * H, T, 1)
+    lse3 = lse2.reshape(B * H, T, 1)
     call = _build_bwd(T, D, causal, block_q, block_kv, q.dtype.name,
                       interpret)
     dq, dk, dv = call(qr, kr, vr, dor, lse3, delta)
@@ -314,12 +378,12 @@ def _make_flash(causal: bool, block_q: int, block_kv: int, interpret: bool):
         return out
 
     def fwd(q, k, v):
-        out, lse = _fwd(q, k, v, causal, block_q, block_kv, interpret)
-        return out, (q, k, v, out, lse)
+        out, lse2 = _fwd(q, k, v, causal, block_q, block_kv, interpret)
+        return out, (q, k, v, out, lse2)
 
     def bwd(res, do):
-        q, k, v, out, lse = res
-        return _bwd_pallas(q, k, v, out, lse, do, causal, block_q, block_kv,
+        q, k, v, out, lse2 = res
+        return _bwd_pallas(q, k, v, out, lse2, do, causal, block_q, block_kv,
                            interpret)
 
     flash.defvjp(fwd, bwd)
@@ -339,12 +403,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Requires ``T`` divisible by both block sizes; callers pad or fall back.
 
     Default blocks are 1024 (clamped to T): the grid-step count dominates
-    kernel wall time on v5e at these head dims — halving the block size
-    measured ~1.6x slower fwd+bwd at T=8192, and the lax.scan recompute
-    VJP this replaced was ~2x slower still. benches/results/attention.json
-    holds the CURRENT committed numbers (run benches/bench_attention.py to
-    refresh). Shrink blocks only if VMEM pressure forces it (the in-kernel
-    score tile is block_q x block_kv f32).
+    kernel wall time on v5e at these head dims — halving either block
+    measured slower at both T=2048 and T=8192 (512-KV: ~1.15-1.35x; and
+    the lax.scan recompute VJP this kernel replaced was ~2x slower still).
+    benches/results/attention.json holds the CURRENT committed numbers
+    (run benches/bench_attention.py to refresh). Shrink blocks only if
+    VMEM pressure forces it (the in-kernel score tile is
+    block_q x block_kv f32).
     """
     B, T, H, D = q.shape
     block_q = min(block_q, T)
