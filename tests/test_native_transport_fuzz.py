@@ -1,0 +1,320 @@
+"""Adversarial-input tests for the native framed-TCP transport server
+(native/transport.cc).
+
+The reference's low-latency plane rides libzmq — a hardened library
+(reference: relayrl_framework/src/network/server/training_zmq.rs:71-1059).
+Ours is a hand-rolled epoll loop with a 5-byte frame header
+(u32 LE payload_len | u8 type | payload), so it gets the adversarial
+coverage a library would bring, the same way test_grpc_native_fuzz.py
+covers the hand-rolled HTTP/2 parser. Every attack ends with the real
+assertion: a FRESH connection still completes Ping -> Pong (the epoll
+loop is alive and accepting), and where state is involved, a well-formed
+handshake still works.
+
+Covered classes: oversize/truncated length fields, cross-protocol
+greetings (ZMTP, HTTP/2 preface — the fail-fast mismatch breadcrumbs),
+unknown frame types, huge/empty agent ids, garbage trajectory payloads
+surfacing through poll without killing the loop, read-budget abuse
+(many frames in one send), connection churn, and hypothesis-driven raw
+byte soup / framed soup.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.transport import make_server_transport
+
+# frame types (native/transport.cc)
+TRAJ, GET_MODEL, MODEL, MODEL_SET, ID_LOGGED, SUBSCRIBE, MODEL_PUSH = (
+    1, 2, 3, 4, 5, 6, 7)
+PING, PONG = 8, 9
+HEADER = 5
+MAX_FRAME = 1 << 30
+
+ZMTP_GREETING = bytes([0xFF, 0, 0, 0, 0, 0, 0, 0, 1, 0x7F])
+H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+
+def frame(ftype: int, payload: bytes = b"") -> bytes:
+    return struct.pack("<I", len(payload)) + bytes([ftype]) + payload
+
+
+def recv_frame(sock: socket.socket, timeout: float = 3.0):
+    """Read one complete frame off the socket, or None on close/timeout."""
+    sock.settimeout(timeout)
+    buf = b""
+    try:
+        while len(buf) < HEADER:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return None
+            buf += chunk
+        ln = struct.unpack("<I", buf[:4])[0]
+        ftype = buf[4]
+        body = buf[HEADER:]
+        while len(body) < ln:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return None
+            body += chunk
+        return ftype, body[:ln]
+    except (socket.timeout, OSError):
+        return None
+
+
+@pytest.fixture(autouse=True)
+def _require_native_lib():
+    from relayrl_tpu.transport.native_backend import native_available
+
+    if not native_available():
+        pytest.skip("native library not built (make -C native)")
+
+
+@pytest.fixture
+def cfg(tmp_cwd):
+    return ConfigLoader(create_if_missing=False)
+
+
+@pytest.fixture
+def server(cfg):
+    srv = make_server_transport("native", cfg, bind_addr="127.0.0.1:0")
+    srv.get_model = lambda: (1, b"model-bytes-v1")
+    srv.events = {"traj": [], "reg": [], "unreg": []}
+    srv.on_trajectory = lambda aid, p: srv.events["traj"].append((aid, p))
+    srv.on_register = srv.events["reg"].append
+    srv.on_unregister = srv.events["unreg"].append
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def wait_for(pred, timeout: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def assert_alive(port: int) -> None:
+    """The real assertion after every attack: a fresh connection still
+    round-trips Ping -> Pong through the epoll loop."""
+    with socket.create_connection(("127.0.0.1", port), timeout=3.0) as s:
+        s.sendall(frame(PING))
+        got = recv_frame(s)
+        assert got is not None and got[0] == PONG, \
+            f"server not answering pings (got {got!r})"
+
+
+def attack(port: int, raw: bytes, linger: float = 0.0) -> None:
+    with socket.create_connection(("127.0.0.1", port), timeout=3.0) as s:
+        try:
+            s.sendall(raw)
+        except OSError:
+            pass  # server may legitimately slam the door mid-send
+        if linger:
+            time.sleep(linger)
+
+
+class TestMalformedFrames:
+    def test_oversize_length_drops_connection(self, server):
+        # Length field over the 1 GiB cap: the connection must be cut
+        # without any attempt to buffer toward it.
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(struct.pack("<I", MAX_FRAME + 1) + bytes([TRAJ]))
+            assert recv_frame(s, timeout=2.0) is None  # server closed
+        assert_alive(server.port)
+
+    def test_huge_claimed_length_partial_body(self, server):
+        # Claim 512 MiB, deliver 1 MiB, close. The rbuf must not balloon
+        # (the read loop only ever buffers what arrives) and the loop must
+        # not wait on the phantom remainder.
+        raw = struct.pack("<I", 512 << 20) + bytes([TRAJ]) + b"\x00" * (1 << 20)
+        attack(server.port, raw, linger=0.2)
+        assert_alive(server.port)
+
+    def test_truncated_header(self, server):
+        attack(server.port, b"\x05\x00", linger=0.1)
+        assert_alive(server.port)
+
+    def test_truncated_frame_then_close(self, server):
+        raw = frame(TRAJ, b"x" * 100)[:40]
+        attack(server.port, raw, linger=0.1)
+        assert_alive(server.port)
+
+    def test_zmtp_greeting_dropped(self, server):
+        # A zmq peer's ZMTP greeting is the fail-fast mismatch breadcrumb:
+        # connection dropped, loop alive (transport/probe.py negotiation).
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(ZMTP_GREETING)
+            assert recv_frame(s, timeout=2.0) is None
+        assert_alive(server.port)
+
+    def test_http2_preface_dropped(self, server):
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(H2_PREFACE)
+            assert recv_frame(s, timeout=2.0) is None
+        assert_alive(server.port)
+
+    def test_unknown_frame_types_ignored(self, server):
+        # Forward compat: unknown types skip cleanly, later frames on the
+        # same connection still work.
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(0, b"???") + frame(200, b"\x00" * 64)
+                      + frame(255) + frame(PING))
+            got = recv_frame(s)
+            assert got is not None and got[0] == PONG
+        assert_alive(server.port)
+
+
+class TestStatefulAbuse:
+    def test_get_model_with_garbage_payload(self, server):
+        # GET_MODEL carries no payload by contract; one with garbage must
+        # still be answered (payload ignored), not misparsed.
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(GET_MODEL, b"\xde\xad\xbe\xef"))
+            got = recv_frame(s)
+            assert got is not None and got[0] == MODEL
+            version = struct.unpack("<Q", got[1][:8])[0]
+            assert version == 1 and got[1][8:] == b"model-bytes-v1"
+        assert_alive(server.port)
+
+    def test_huge_agent_id_registered_and_unregistered(self, server):
+        huge_id = "A" * (1 << 20)
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(MODEL_SET, huge_id.encode()))
+            got = recv_frame(s, timeout=5.0)
+            assert got is not None and got[0] == ID_LOGGED
+        # registration + unregister-on-drop both surface as events
+        assert wait_for(lambda: huge_id in server.events["reg"])
+        assert wait_for(lambda: huge_id in server.events["unreg"])
+        assert_alive(server.port)
+
+    def test_non_utf8_agent_id_survives(self, server):
+        # Registration ids are decoded with errors="replace" on the Python
+        # side — raw invalid UTF-8 must neither crash the poll thread nor
+        # the loop.
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(MODEL_SET, b"\xff\xfe\x80\x81 id"))
+            got = recv_frame(s)
+            assert got is not None and got[0] == ID_LOGGED
+        assert wait_for(lambda: len(server.events["reg"]) > 0)
+        assert_alive(server.port)
+
+    def test_empty_agent_id(self, server):
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(MODEL_SET))
+            got = recv_frame(s)
+            assert got is not None and got[0] == ID_LOGGED
+        assert_alive(server.port)
+
+    def test_garbage_trajectory_dropped_valid_one_survives(self, server):
+        # The wire accepts any TRAJ payload; the Python wrapper drops
+        # non-envelope garbage (decode isolation — test_native_codec.py
+        # covers envelope-level garbage). Neither the drop nor a valid
+        # envelope right behind it may disturb the loop.
+        from relayrl_tpu.transport.base import pack_trajectory_envelope
+
+        garbage = bytes(range(256)) * 7
+        good = pack_trajectory_envelope("fuzz-agent", b"real-payload")
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(TRAJ, garbage) + frame(TRAJ, good) + frame(PING))
+            got = recv_frame(s)
+            assert got is not None and got[0] == PONG
+        assert wait_for(
+            lambda: ("fuzz-agent", b"real-payload") in server.events["traj"])
+        assert_alive(server.port)
+
+    def test_many_frames_single_send(self, server):
+        # One send() carrying hundreds of frames exercises the per-wakeup
+        # read budget: all must parse (level-triggered epoll re-fires),
+        # none dropped.
+        from relayrl_tpu.transport.base import pack_trajectory_envelope
+
+        n = 500
+        payload = b"".join(
+            frame(TRAJ, pack_trajectory_envelope("blaster", b"t%d" % i))
+            for i in range(n)) + frame(PING)
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(payload)
+            got = recv_frame(s, timeout=5.0)
+            assert got is not None and got[0] == PONG
+        assert wait_for(lambda: len(server.events["traj"]) >= n, timeout=10.0)
+        assert len(server.events["traj"]) == n
+        assert_alive(server.port)
+
+    def test_subscriber_death_does_not_block_broadcast(self, server):
+        # A subscriber that stops reading then dies must not wedge
+        # publish_model for the healthy path.
+        dead = socket.create_connection(("127.0.0.1", server.port))
+        dead.sendall(frame(SUBSCRIBE))
+        time.sleep(0.1)
+        dead.close()
+        server.publish_model(2, b"model-v2")
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(frame(SUBSCRIBE))
+            time.sleep(0.1)
+            server.publish_model(3, b"model-v3")
+            got = recv_frame(s, timeout=5.0)
+            assert got is not None and got[0] == MODEL_PUSH
+            version = struct.unpack("<Q", got[1][:8])[0]
+            assert version == 3 and got[1][8:] == b"model-v3"
+        assert_alive(server.port)
+
+    def test_connection_churn(self, server):
+        # Rapid open/close (with and without bytes) must not leak the loop
+        # into a bad state.
+        for i in range(50):
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                if i % 3 == 0:
+                    s.sendall(frame(PING)[:3])
+                elif i % 3 == 1:
+                    s.sendall(b"\xff" * 7)
+        assert_alive(server.port)
+
+
+class TestByteSoup:
+    @settings(max_examples=25, deadline=None,
+              suppress_health_check=[HealthCheck.function_scoped_fixture])
+    @given(blob=st.binary(min_size=0, max_size=4096))
+    def test_raw_bytes_never_kill_server(self, server, blob):
+        attack(server.port, blob)
+        assert_alive(server.port)
+
+    @settings(max_examples=25, deadline=None,
+              suppress_health_check=[HealthCheck.function_scoped_fixture])
+    @given(frames=st.lists(
+        st.tuples(st.integers(min_value=0, max_value=255),
+                  st.binary(min_size=0, max_size=512)),
+        min_size=1, max_size=20))
+    def test_framed_soup_never_kills_server(self, server, frames):
+        raw = b"".join(frame(t, p) for t, p in frames)
+        attack(server.port, raw)
+        assert_alive(server.port)
+
+    @settings(max_examples=15, deadline=None,
+              suppress_health_check=[HealthCheck.function_scoped_fixture])
+    @given(cut=st.integers(min_value=1, max_value=60),
+           blob=st.binary(min_size=0, max_size=64))
+    def test_split_writes_reassemble(self, server, cut, blob):
+        # A valid PING split at an arbitrary byte boundary, with trailing
+        # soup on the same connection, must still answer the ping.
+        raw = frame(PING) + frame(TRAJ, blob)
+        cut = min(cut, len(raw) - 1)
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            s.sendall(raw[:cut])
+            time.sleep(0.02)
+            s.sendall(raw[cut:])
+            got = recv_frame(s)
+            assert got is not None and got[0] == PONG
+        assert_alive(server.port)
