@@ -272,6 +272,25 @@ def main():
                 "obs_shape": list(obs_shape),
                 "conv_spec": [list(s) for s in conv_spec], "dense": 512})
 
+    # TPU-native trunk (conv_spec="tpu"): Nature geometry with channel
+    # widths at MXU-lane multiples (64/128/128) — ~4x the FLOPs, but they
+    # land where the systolic array can retire them, so MFU (not
+    # updates/s) is the number to compare against the cnn_pixel row
+    # (docs/parallelism.md CNN roofline: Nature's 32-channel conv1 caps
+    # lane occupancy at <=25% on ~40% of its FLOPs).
+    if ON_TPU and not quick():
+        from relayrl_tpu.models.cnn import TPU_CONV
+
+        tpu_cnn_arch = dict(c_arch, conv_spec=TPU_CONV)
+        bench_algo(
+            "IMPALA", lambda: mk_impala_for(tpu_cnn_arch),
+            onpolicy_batch(c_B, c_T, c_obs, 18, rng),
+            flops_per_update=3 * cnn_fwd_flops(
+                c_B * c_T, obs_shape, TPU_CONV, 512, 18),
+            detail={"family": "cnn_pixel_tpu_trunk", "B": c_B, "T": c_T,
+                    "obs_shape": list(obs_shape),
+                    "conv_spec": [list(s) for s in TPU_CONV], "dense": 512})
+
 
 if __name__ == "__main__":
     main()

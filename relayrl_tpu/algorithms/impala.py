@@ -94,9 +94,12 @@ class IMPALA(OnPolicyAlgorithm):
     ALGO_NAME = "IMPALA"
 
     def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
-        kind = str(params.get("model_kind",
-                              "mlp_discrete" if self.discrete
-                              else "mlp_continuous"))
+        # obs_shape implies the pixel trunk, as in PPO/DQN/C51; an explicit
+        # model_kind (e.g. transformer_discrete) still wins.
+        default_kind = ("cnn_discrete" if "obs_shape" in params
+                        else "mlp_discrete" if self.discrete
+                        else "mlp_continuous")
+        kind = str(params.get("model_kind", default_kind))
         self.arch = {
             "kind": kind,
             "obs_dim": self.obs_dim,
@@ -107,6 +110,12 @@ class IMPALA(OnPolicyAlgorithm):
         }
         if kind == "cnn_discrete" and "obs_shape" in params:
             self.arch["obs_shape"] = list(params["obs_shape"])
+            # Same pixel-trunk passthrough as PPO (ppo.py): without it a
+            # conv_spec="tpu"/dense override silently trains the Nature
+            # trunk.
+            for key in ("conv_spec", "dense", "scale_obs"):
+                if key in params:
+                    self.arch[key] = params[key]
         apply_arch_overrides(self.arch, params)
         self.policy = build_policy(self.arch)
 

@@ -34,6 +34,30 @@ from relayrl_tpu.models.mlp import (
 # (features, kernel, stride) — the Nature-DQN trunk.
 NATURE_CONV = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
 
+# TPU-native trunk: same geometry (kernels/strides/receptive field) as the
+# Nature trunk, channel widths raised to MXU-lane multiples (64/128). The
+# Nature widths are shape-hostile to a 128x128 systolic array — conv1's
+# 32 output channels occupy <=25% of the lanes on ~40% of the FLOPs
+# (docs/parallelism.md roofline section). This spec spends ~4x the
+# arithmetic of NATURE_CONV but maps it where the MXU can actually retire
+# it; pick it with ``conv_spec="tpu"`` in the arch/hyperparams.
+TPU_CONV = ((64, 8, 4), (128, 4, 2), (128, 3, 1))
+
+CONV_PRESETS = {"nature": NATURE_CONV, "tpu": TPU_CONV}
+
+
+def resolve_conv_spec(spec) -> tuple:
+    """Resolve a conv spec that may be a preset name ("nature"/"tpu") or an
+    explicit ((features, kernel, stride), ...) sequence."""
+    if isinstance(spec, str):
+        try:
+            return CONV_PRESETS[spec.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown conv preset {spec!r}; known: {sorted(CONV_PRESETS)}"
+            ) from None
+    return tuple(tuple(int(x) for x in row) for row in spec)
+
 
 def validate_conv_spec(obs_shape, conv_spec) -> None:
     """Fail fast when a conv stack collapses the feature map to nothing
@@ -125,7 +149,8 @@ def build_cnn_discrete(arch: Mapping[str, Any]) -> Policy:
     obs_shape = tuple(int(d) for d in arch["obs_shape"])
     if len(obs_shape) != 3:
         raise ValueError(f"cnn_discrete needs obs_shape (H, W, C), got {obs_shape}")
-    validate_conv_spec(obs_shape, arch.get("conv_spec", NATURE_CONV))
+    conv_spec = resolve_conv_spec(arch.get("conv_spec", NATURE_CONV))
+    validate_conv_spec(obs_shape, conv_spec)
     obs_dim = int(jnp.prod(jnp.array(obs_shape)))
     arch = dict(arch)
     arch.setdefault("obs_dim", obs_dim)
@@ -136,8 +161,7 @@ def build_cnn_discrete(arch: Mapping[str, Any]) -> Policy:
     module = ConvActorCritic(
         act_dim=int(arch["act_dim"]),
         obs_shape=obs_shape,
-        conv_spec=tuple(tuple(int(x) for x in row)
-                        for row in arch.get("conv_spec", NATURE_CONV)),
+        conv_spec=conv_spec,
         dense=int(arch.get("dense", 512)),
         scale_obs=bool(arch.get("scale_obs", True)),
         has_critic=bool(arch.get("has_critic", True)),

@@ -101,14 +101,18 @@ def conv_trunk_kwargs(arch: Mapping[str, Any]) -> dict:
     obs_shape = arch.get("obs_shape")
     if obs_shape is None:
         return {}
-    from relayrl_tpu.models.cnn import NATURE_CONV, validate_conv_spec
+    from relayrl_tpu.models.cnn import (
+        NATURE_CONV,
+        resolve_conv_spec,
+        validate_conv_spec,
+    )
 
-    validate_conv_spec(obs_shape, arch.get("conv_spec") or NATURE_CONV)
+    spec = (resolve_conv_spec(arch["conv_spec"])
+            if arch.get("conv_spec") else None)
+    validate_conv_spec(obs_shape, spec or NATURE_CONV)
     return {
         "obs_shape": tuple(int(d) for d in obs_shape),
-        "conv_spec": tuple(tuple(int(x) for x in row)
-                           for row in arch["conv_spec"])
-        if arch.get("conv_spec") else None,
+        "conv_spec": spec,
         "dense": int(arch.get("dense", 512)),
         "scale_obs": bool(arch.get("scale_obs", True)),
     }

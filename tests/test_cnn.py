@@ -28,6 +28,57 @@ class TestCNNPolicy:
         assert policy.input_dim == 28 * 28 * 4
         assert policy.output_dim == 6
 
+    def test_conv_spec_presets(self):
+        # String presets resolve to the named trunks; unknown names fail
+        # loudly. "tpu" is the MXU-lane-width variant (docs/parallelism.md
+        # CNN roofline); both share the Nature geometry so an 84px frame
+        # satisfies both.
+        from relayrl_tpu.models.cnn import (
+            NATURE_CONV,
+            TPU_CONV,
+            resolve_conv_spec,
+        )
+
+        assert resolve_conv_spec("nature") == NATURE_CONV
+        assert resolve_conv_spec("TPU") == TPU_CONV
+        assert resolve_conv_spec([[8, 8, 4]]) == ((8, 8, 4),)
+        with pytest.raises(ValueError, match="unknown conv preset"):
+            resolve_conv_spec("resnet")
+        # end-to-end through build_policy: preset string in the arch
+        policy = build_policy({"kind": "cnn_discrete",
+                               "obs_shape": [84, 84, 4], "act_dim": 4,
+                               "conv_spec": "tpu", "dense": 64})
+        params = policy.init_params(jax.random.PRNGKey(0))
+        conv0 = params["params"]["trunk"]["conv_0"]["kernel"]
+        assert conv0.shape[-1] == TPU_CONV[0][0]  # 64 output channels
+        act, aux = policy.step(params, jax.random.PRNGKey(1),
+                               jnp.zeros((2, policy.input_dim)), None)
+        assert np.asarray(act).shape == (2,)
+
+    def test_conv_spec_preset_through_pixel_q_net(self):
+        # The q-net builders share the trunk resolution (DQN pixel path).
+        from relayrl_tpu.models.q_networks import conv_trunk_kwargs
+        from relayrl_tpu.models.cnn import TPU_CONV
+
+        kw = conv_trunk_kwargs({"obs_shape": [84, 84, 4],
+                                "conv_spec": "tpu"})
+        assert kw["conv_spec"] == TPU_CONV
+
+    @pytest.mark.parametrize("algo", ["IMPALA", "PPO"])
+    def test_conv_spec_reaches_pixel_learners(self, algo, tmp_cwd):
+        # Regression: IMPALA used to copy only obs_shape into the arch,
+        # silently dropping a conv_spec override (and with it the "tpu"
+        # preset the roofline docs advertise).
+        from relayrl_tpu.algorithms import build_algorithm
+
+        alg = build_algorithm(
+            algo, obs_dim=36 * 36 * 2, act_dim=4, env_dir=str(tmp_cwd),
+            obs_shape=[36, 36, 2], conv_spec=[[8, 8, 4], [16, 4, 2]],
+            dense=32)
+        assert alg.arch["conv_spec"] == [[8, 8, 4], [16, 4, 2]]
+        conv0 = alg.state.params["params"]["trunk"]["conv_0"]["kernel"]
+        assert conv0.shape[-1] == 8
+
     def test_step_single_and_batch(self):
         policy = _policy()
         params = policy.init_params(jax.random.PRNGKey(0))
