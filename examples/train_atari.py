@@ -63,6 +63,10 @@ def main():
                          "one — 0.01 is a good start")
     ap.add_argument("--out", default=None,
                     help="env_dir for logs/progress.txt (default: cwd)")
+    ap.add_argument("--conv", default=None, choices=["nature", "tpu"],
+                    help="conv trunk preset: 'nature' (reference shape) or "
+                         "'tpu' (MXU-lane channel widths 64/128/128 — "
+                         "higher MFU on chip; docs/parallelism.md)")
     args = ap.parse_args()
 
     from relayrl_tpu.envs import make_atari
@@ -91,6 +95,8 @@ def main():
         hp["seed_salt"] = args.seed_salt
     if args.ent_coef is not None:
         hp["ent_coef"] = args.ent_coef
+    if args.conv is not None:
+        hp["conv_spec"] = args.conv
     if args.algo in ("PPO", "IMPALA"):
         hp["model_kind"] = "cnn_discrete"  # DQN/C51 switch on obs_shape alone
     runner = LocalRunner(env, algorithm_name=args.algo, **hp)

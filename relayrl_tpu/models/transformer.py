@@ -80,12 +80,24 @@ def _resolve_attention(arch: Mapping[str, Any]) -> Callable:
         def ring_or_local(q, k, v):
             from relayrl_tpu.parallel.context import current_mesh
             from relayrl_tpu.parallel.ring import make_ring_attention
+            from relayrl_tpu.parallel.ring_flash import (
+                make_ring_flash_attention,
+                pick_chunk_block,
+            )
 
             mesh = current_mesh()
             if mesh is None or mesh.shape.get("sp", 1) <= 1:
                 if q.shape[1] % block == 0:
                     return blockwise_attention(q, k, v, block, causal=True)
                 return dense_attention(q, k, v, causal=True)
+            # On TPU the per-round combine runs as Pallas flash chunk
+            # kernels when the local chunk tiles; the scan ring is the
+            # portable fallback (and the off-TPU path, where the kernel
+            # would run in the interpreter).
+            chunk = q.shape[1] // mesh.shape["sp"]
+            if (jax.default_backend() == "tpu"
+                    and pick_chunk_block(chunk) is not None):
+                return make_ring_flash_attention(mesh)(q, k, v)
             return make_ring_attention(mesh)(q, k, v)
         return ring_or_local
     raise ValueError(f"unknown attention kind {kind!r}")

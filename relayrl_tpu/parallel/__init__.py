@@ -36,6 +36,10 @@ from relayrl_tpu.parallel.ring import (
     make_ring_attention,
     ring_attention_sharded,
 )
+from relayrl_tpu.parallel.ring_flash import (
+    make_ring_flash_attention,
+    ring_flash_attention_sharded,
+)
 
 __all__ = [
     "AXES",
@@ -60,4 +64,6 @@ __all__ = [
     "is_coordinator",
     "make_ring_attention",
     "ring_attention_sharded",
+    "make_ring_flash_attention",
+    "ring_flash_attention_sharded",
 ]

@@ -14,6 +14,7 @@ from relayrl_tpu.ops.attention import blockwise_attention, dense_attention
 from relayrl_tpu.parallel import (
     make_mesh,
     make_ring_attention,
+    make_ring_flash_attention,
     use_mesh,
 )
 
@@ -117,6 +118,74 @@ class TestRingAttention:
         g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g_ring, g_ref):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestRingFlashAttention:
+    """The Pallas-chunk ring (parallel/ring_flash.py), interpret mode on
+    the CPU mesh; anchors are dense attention on the unsharded sequence
+    and the scan ring it accelerates."""
+
+    @pytest.mark.parametrize("spec", [
+        {"dp": 1, "sp": 2}, {"dp": 2, "sp": 4}, {"dp": 1, "sp": 4},
+    ])
+    def test_matches_dense(self, spec):
+        n = spec.get("dp", 1) * spec.get("sp", 1)
+        mesh = make_mesh({**{"dp": 1, "fsdp": 1, "tp": 1, "sp": 1}, **spec},
+                         jax.devices()[:n])
+        q, k, v = _qkv(t=64)  # chunk of 64/sp tiles by 8
+        ref = dense_attention(q, k, v, causal=True)
+        out = jax.jit(make_ring_flash_attention(mesh, interpret=True))(
+            q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_non_causal_matches(self):
+        mesh = make_mesh({"dp": 1, "fsdp": 1, "tp": 1, "sp": 4},
+                         jax.devices()[:4])
+        q, k, v = _qkv(1, t=64)
+        ref = dense_attention(q, k, v, causal=False)
+        out = jax.jit(make_ring_flash_attention(
+            mesh, causal=False, interpret=True))(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_matches_scan_ring(self):
+        mesh = make_mesh({"dp": 1, "fsdp": 1, "tp": 1, "sp": 4},
+                         jax.devices()[:4])
+        q, k, v = _qkv(2, t=64)
+        scan = jax.jit(make_ring_attention(mesh))(q, k, v)
+        flash = jax.jit(make_ring_flash_attention(mesh, interpret=True))(
+            q, k, v)
+        np.testing.assert_allclose(flash, scan, rtol=1e-5, atol=1e-6)
+
+    def test_grad_matches_dense(self):
+        mesh = make_mesh({"dp": 1, "fsdp": 1, "tp": 1, "sp": 4},
+                         jax.devices()[:4])
+        q, k, v = _qkv(3, t=64)
+        ring = make_ring_flash_attention(mesh, interpret=True)
+
+        g_ring = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(ring(q, k, v) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(dense_attention(q, k, v) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_untileable_chunk_raises(self):
+        # T=32 over sp=8 leaves 4-row chunks (< the 8-row tile): the
+        # builder must refuse so callers fall back to the scan ring (the
+        # transformer "ring" path checks pick_chunk_block first).
+        from relayrl_tpu.parallel.ring_flash import pick_chunk_block
+
+        assert pick_chunk_block(4) is None
+        assert pick_chunk_block(64) == 64
+        assert pick_chunk_block(3 * 8) == 8
+        assert pick_chunk_block(4096) == 1024
+        mesh = make_mesh({"dp": 1, "fsdp": 1, "tp": 1, "sp": 8},
+                         jax.devices()[:8])
+        q, k, v = _qkv()  # T=32
+        with pytest.raises(Exception, match="does not tile"):
+            jax.jit(make_ring_flash_attention(mesh, interpret=True))(q, k, v)
 
 
 ARCH = {
