@@ -69,7 +69,19 @@ def run_shape(B, T, H, D, block, quick=False) -> None:
         "flash": lambda q, k, v: flash_attention(
             q, k, v, causal=True, block_q=FLASH_BLOCK, block_kv=FLASH_BLOCK),
     }
-    if platform != "tpu":
+    if platform == "tpu":
+        # Ring cost model: the same chunk kernels the sp ring runs, all
+        # local — fused-flash minus these rows is the per-device price of
+        # chunking (state-carry HBM traffic + per-call overhead), with ICI
+        # deliberately excluded. Forward-only (the ring backward is its
+        # own two-pass schedule).
+        from relayrl_tpu.parallel.ring_flash import chunked_flash_local
+
+        for n in (2, 4):
+            backends[f"flash_chunked{n}"] = (
+                lambda q, k, v, n=n: chunked_flash_local(
+                    q, k, v, n_chunks=n, causal=True))
+    else:
         backends.pop("flash")  # interpreter mode would dominate the chart
 
     flops_fwd = attention_flops(B, T, H, D)
@@ -101,6 +113,9 @@ def run_shape(B, T, H, D, block, quick=False) -> None:
         emit(f"attention_fwd_{name}", cfg, dt * 1e3, "ms")
         emit(f"attention_fwd_{name}_tflops", cfg,
              flops_fwd / dt / 1e12, "TFLOP/s")
+
+        if name.startswith("flash_chunked"):
+            continue  # fwd-only cost model (no VJP on the chunk helper)
 
         grad = jax.jit(jax.grad(
             lambda qq, kk, vv, fn=fn: jnp.sum(

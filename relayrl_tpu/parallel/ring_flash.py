@@ -296,6 +296,29 @@ def pick_chunk_block(C: int, cap: int = 1024) -> int | None:
     return b
 
 
+def _resolve_chunk_config(C: int, block: int | None,
+                          interpret: bool | None) -> tuple[int, bool]:
+    """Shared block-resolution/tile-validation/interpret-default policy for
+    the sharded ring and the single-device cost model — one copy, so the
+    bench rows always measure the same kernels the ring runs."""
+    if block is None:
+        block = pick_chunk_block(C)
+    if block is None or C % block:
+        raise ValueError(
+            f"chunk length {C} does not tile (block={block}); use the scan "
+            f"ring (relayrl_tpu.parallel.ring) for this shape")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    return int(block), bool(interpret)
+
+
+def _finalize_chunk_state(o, l, out_dtype):
+    """acc/l -> output chunk (the flash finalize; 1e-30 guards fully-masked
+    rows, which only padding can produce). Returns (out, l_safe)."""
+    l_safe = jnp.maximum(l, 1e-30)
+    return (o / l_safe).astype(out_dtype), l_safe
+
+
 def _round_mode(idx, r, axis_size, causal: bool):
     kv_idx = (idx - r) % axis_size
     if not causal:
@@ -353,8 +376,8 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool,
             (oml, _, _), _ = jax.lax.scan(
                 round_step, (oml, kb, vb), jnp.arange(1, axis_size))
         o, m, l = oml
-        l_safe = jnp.maximum(l, 1e-30)
-        out = _bht_to_bthd((o / l_safe).astype(q.dtype), B, H)
+        out_f, l_safe = _finalize_chunk_state(o, l, q.dtype)
+        out = _bht_to_bthd(out_f, B, H)
         lse2 = m + jnp.log2(l_safe)                      # [BH, C, 1], log2
         return out, lse2
 
@@ -438,17 +461,55 @@ def ring_flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     :func:`pick_chunk_block` and fall back to the scan ring when it
     returns None.
     """
-    C = q.shape[1]
-    if block is None:
-        block = pick_chunk_block(C)
-    if block is None or C % block:
-        raise ValueError(
-            f"chunk length {C} does not tile (block={block}); use the scan "
-            f"ring (relayrl_tpu.parallel.ring) for this shape")
-    if interpret is None:
-        interpret = jax.default_backend() not in ("tpu",)
-    return _make_ring_flash(axis_name, axis_size, causal, int(block),
+    block, interpret = _resolve_chunk_config(q.shape[1], block, interpret)
+    return _make_ring_flash(axis_name, axis_size, causal, block,
                             interpret)(q, k, v)
+
+
+def chunked_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                        n_chunks: int, causal: bool = True,
+                        block: int | None = None,
+                        interpret: bool | None = None) -> jax.Array:
+    """Single-device emulation of the ring's per-chunk kernel schedule
+    (forward only) — the ring cost model without a pod.
+
+    Runs the same flash state-carry chunk kernels the sp ring uses, but
+    with every chunk local: q-chunk i visits kv-chunks 0..i (causal)
+    under the same FULL/DIAG mode schedule, with the ``(acc, m, l)``
+    state bounced through HBM between calls exactly as the ring carries
+    it between rounds. Comparing this against the fused
+    :func:`relayrl_tpu.ops.flash.flash_attention` at equal T measures
+    what ring chunking costs per device (state-carry HBM traffic +
+    per-call overhead) separately from ICI transfer time, which this
+    deliberately excludes. ``benches/bench_attention.py`` emits rows for
+    it on TPU.
+    """
+    B, T, H, D = q.shape
+    if T % n_chunks:
+        raise ValueError(f"T={T} not divisible by n_chunks={n_chunks}")
+    C = T // n_chunks
+    block, interpret = _resolve_chunk_config(C, block, interpret)
+    fwd_call, _, _ = _build_chunk_calls(C, D, block, block,
+                                        q.dtype.name, interpret)
+    qs = _prescale_q(_bthd_to_bht(q))
+    kr, vr = _bthd_to_bht(k), _bthd_to_bht(v)
+    bh = qs.shape[0]
+    outs = []
+    for iq in range(n_chunks):
+        qc = jax.lax.dynamic_slice_in_dim(qs, iq * C, C, axis=1)
+        o = jnp.zeros((bh, C, D), jnp.float32)
+        m = jnp.full((bh, C, 1), _NEG_INF, jnp.float32)
+        l = jnp.zeros((bh, C, 1), jnp.float32)
+        last = iq if causal else n_chunks - 1
+        for kv in range(last + 1):
+            mode = jnp.full((1,), MODE_DIAG if (causal and kv == iq)
+                            else MODE_FULL, jnp.int32)
+            kc = jax.lax.dynamic_slice_in_dim(kr, kv * C, C, axis=1)
+            vc = jax.lax.dynamic_slice_in_dim(vr, kv * C, C, axis=1)
+            o, m, l = fwd_call(mode, qc, kc, vc, o, m, l)
+        out_f, _ = _finalize_chunk_state(o, l, q.dtype)
+        outs.append(out_f)
+    return _bht_to_bthd(jnp.concatenate(outs, axis=1), B, H)
 
 
 def make_ring_flash_attention(mesh: Mesh, axis_name: str = "sp",

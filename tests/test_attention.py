@@ -171,6 +171,19 @@ class TestRingFlashAttention:
         for a, b in zip(g_ring, g_ref):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("causal,n", [(True, 2), (True, 4), (False, 2)])
+    def test_chunked_local_matches_dense(self, causal, n):
+        # The single-device ring cost model (benches emit rows for it on
+        # TPU) must agree with dense — it runs the exact chunk kernels
+        # and mode schedule the sharded ring uses.
+        from relayrl_tpu.parallel.ring_flash import chunked_flash_local
+
+        q, k, v = _qkv(4, t=64)
+        ref = dense_attention(q, k, v, causal=causal)
+        out = jax.jit(lambda q, k, v: chunked_flash_local(
+            q, k, v, n_chunks=n, causal=causal, interpret=True))(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
     def test_untileable_chunk_raises(self):
         # T=32 over sp=8 leaves 4-row chunks (< the 8-row tile): the
         # builder must refuse so callers fall back to the scan ring (the
