@@ -12,6 +12,7 @@ recorded headline).
 """
 
 import os
+import sys
 
 import numpy as np
 
@@ -23,6 +24,14 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 ON_TPU = os.environ.get("RELAYRL_BENCH_TPU") == "1"
+
+# --profile=DIR (or RELAYRL_BENCH_PROFILE=DIR): capture one jax.profiler
+# trace per benched family under DIR before timing starts.
+PROFILE_DIR = os.environ.get("RELAYRL_BENCH_PROFILE", "")
+for _arg in list(sys.argv[1:]):
+    if _arg.startswith("--profile="):
+        PROFILE_DIR = _arg.split("=", 1)[1]
+        sys.argv.remove(_arg)
 
 
 def chip_peak_flops():
@@ -95,6 +104,21 @@ def bench_algo(name, make_state_update, batch, flops_per_update=None,
     state, update = make_state_update()
     jitted = jax.jit(update)
     device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    if PROFILE_DIR:
+        # One traced update per family under --profile=DIR: the
+        # jax.profiler trace (TensorBoard profile plugin / perfetto)
+        # shows where the update's time goes on the chip — the tracing
+        # tier SURVEY §5.1 maps tokio-console/flamegraph to.
+        from relayrl_tpu.utils.profiling import trace
+
+        fam = (detail or {}).get("family", name).replace("/", "_")
+        with trace(os.path.join(PROFILE_DIR, f"{name}_{fam}")):
+            out = jitted(state, device_batch)
+            # Host readback, NOT block_until_ready: on the tunneled TPU
+            # platform block_until_ready returns right after dispatch
+            # (bench.py:186), which would close the trace window before
+            # the device work runs.
+            float(np.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
     # Multiple trials with the raw spread recorded: the tunneled platform
     # drifts under sustained load (~25-40% between identical runs), so a
     # single number is not comparable across rounds without its variance
