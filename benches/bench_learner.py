@@ -111,14 +111,18 @@ def bench_algo(name, make_state_update, batch, flops_per_update=None,
         # tier SURVEY §5.1 maps tokio-console/flamegraph to.
         from relayrl_tpu.utils.profiling import trace
 
-        fam = (detail or {}).get("family", name).replace("/", "_")
-        with trace(os.path.join(PROFILE_DIR, f"{name}_{fam}")):
+        def run_once():
             out = jitted(state, device_batch)
             # Host readback, NOT block_until_ready: on the tunneled TPU
             # platform block_until_ready returns right after dispatch
             # (bench.py:186), which would close the trace window before
             # the device work runs.
             float(np.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
+
+        run_once()  # compile OUTSIDE the trace window
+        fam = (detail or {}).get("family", name).replace("/", "_")
+        with trace(os.path.join(PROFILE_DIR, f"{name}_{fam}")):
+            run_once()  # steady-state device step only
     # Multiple trials with the raw spread recorded: the tunneled platform
     # drifts under sustained load (~25-40% between identical runs), so a
     # single number is not comparable across rounds without its variance

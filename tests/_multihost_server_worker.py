@@ -13,6 +13,9 @@ Modes (VERDICT r3 #2 and #9):
 * ``native``   — same fleet over the native framed-TCP transport: the
                  coordinator-asymmetric design on the plane that carries
                  256-actor fleets
+* ``grpc``     — same fleet over gRPC (the native HTTP/2 server when the
+                 .so is built, grpcio otherwise), completing the
+                 transport x multi-host matrix
 * ``offpolicy``— DQN: replay buffer stays coordinator-side, sampled
                  transition batches broadcast, every rank steps
 * ``offpolicy_sac`` — SAC on a continuous bandit: the non-discrete
@@ -65,6 +68,10 @@ from relayrl_tpu.runtime.server import TrainingServer  # noqa: E402
 
 ALGO = {"offpolicy": "DQN", "offpolicy_sac": "SAC"}.get(mode, "REINFORCE")
 CONTINUOUS = mode == "offpolicy_sac"
+# Transport carrying the actor plane for this cell; single-endpoint
+# transports (native framed-TCP, gRPC) address via bind_addr/server_addr,
+# zmq via its three endpoints.
+TRANSPORT = mode if mode in ("native", "grpc") else "zmq"
 # Multi-host "updates" are broadcast DEVICE steps (one sampled batch per
 # tick), not trajectory ingests — the SAC bandit needs a few hundred.
 TARGET_UPDATES = {"offpolicy": 60, "offpolicy_sac": 300,
@@ -105,7 +112,7 @@ if ALGO == "REINFORCE" and NUM_PROCS > 2:
 
 def server_addr_overrides(phase_ports):
     p1, p2, p3 = phase_ports
-    if mode == "native":
+    if TRANSPORT in ("native", "grpc"):
         return {"bind_addr": f"127.0.0.1:{p1}"}
     return {
         "agent_listener_addr": f"tcp://127.0.0.1:{p1}",
@@ -116,7 +123,7 @@ def server_addr_overrides(phase_ports):
 
 def agent_addr_overrides(phase_ports):
     p1, p2, p3 = phase_ports
-    if mode == "native":
+    if TRANSPORT in ("native", "grpc"):
         return {"server_addr": f"127.0.0.1:{p1}"}
     return {
         "agent_listener_addr": f"tcp://127.0.0.1:{p1}",
@@ -128,7 +135,7 @@ def agent_addr_overrides(phase_ports):
 def build_server(phase_ports, resume, start=True):
     return TrainingServer(
         ALGO, obs_dim=3, act_dim=1 if CONTINUOUS else 2, env_dir=scratch,
-        server_type=("native" if mode == "native" else "zmq"),
+        server_type=TRANSPORT,
         config_path=cfg_path,
         hyperparams=HYPERPARAMS,
         resume=resume,
@@ -169,7 +176,7 @@ def drive_fleet(server, phase_ports, target_updates, tag):
 
     def actor(seed):
         agent = Agent(
-            server_type=("native" if mode == "native" else "zmq"),
+            server_type=TRANSPORT,
             handshake_timeout_s=60, seed=seed,
             config_path=cfg_path,
             model_path=os.path.join(scratch, f"client_{tag}_{seed}.msgpack"),
