@@ -61,6 +61,20 @@ def anchor_path(path: str, env_dir: str | None) -> str:
 class AlgorithmBase(abc.ABC):
     """Host-side orchestration wrapper around a pure jitted learner step."""
 
+    # Trajectories rejected by the ingest finite-value guard
+    # (types/columnar.py trajectory_is_finite); class default so the
+    # first increment materializes the instance counter.
+    dropped_nonfinite = 0
+
+    def _drop_nonfinite(self) -> None:
+        """Count + log one trajectory rejected by the finite-value guard —
+        the single owner of the drop policy for both algorithm families
+        (a NaN/inf would not crash; it would silently poison the learner
+        state and, through the next publish, the fleet)."""
+        self.dropped_nonfinite += 1
+        print(f"[{self.ALGO_NAME}] dropped non-finite trajectory "
+              f"(#{self.dropped_nonfinite})", flush=True)
+
     # -- reference contract (BaseAlgorithm.py:4-39) --
     @abc.abstractmethod
     def receive_trajectory(self, actions: Sequence[ActionRecord]) -> bool:

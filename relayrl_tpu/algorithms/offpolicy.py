@@ -189,7 +189,10 @@ class OffPolicyAlgorithm(AlgorithmBase):
         training batches now due (None when no update is due — warmup, or
         updates_per_step=0). The training step itself is collective:
         :meth:`train_on_batch` runs on every process with each batch."""
-        from relayrl_tpu.types.columnar import DecodedTrajectory
+        from relayrl_tpu.types.columnar import (
+            DecodedTrajectory,
+            trajectory_is_finite,
+        )
 
         if isinstance(item, DecodedTrajectory):
             if item.n_steps == 0:
@@ -199,6 +202,11 @@ class OffPolicyAlgorithm(AlgorithmBase):
             return None
         else:
             rew_total = float(sum(a.rew for a in item))
+        if not trajectory_is_finite(item):
+            # Replay poisoning is worse than the on-policy case — a
+            # non-finite transition keeps resampling forever.
+            self._drop_nonfinite()
+            return None
         stored = self.buffer.add_episode(item)
         self._ep_returns.append(rew_total)
         self._ep_lengths.append(stored)

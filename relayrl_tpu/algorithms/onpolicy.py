@@ -120,7 +120,10 @@ class OnPolicyAlgorithm(AlgorithmBase):
         multi-host server calls accumulate alone on the coordinator (the
         training step is collective — :meth:`train_on_batch` runs on
         every process with the broadcast batch)."""
-        from relayrl_tpu.types.columnar import DecodedTrajectory
+        from relayrl_tpu.types.columnar import (
+            DecodedTrajectory,
+            trajectory_is_finite,
+        )
 
         if isinstance(item, DecodedTrajectory):
             if item.n_steps == 0:
@@ -128,6 +131,9 @@ class OnPolicyAlgorithm(AlgorithmBase):
         elif not item or all(a.act is None for a in item):
             # Marker-only trajectories (stranded by a capacity flush)
             # carry no steps; padding would raise on the empty fold.
+            return None
+        if not trajectory_is_finite(item):
+            self._drop_nonfinite()
             return None
         if self.buffer.add_episode(item):
             return self.buffer.drain().as_dict()

@@ -88,6 +88,65 @@ class DecodedTrajectory:
         return records
 
 
+def _all_finite(value) -> bool:
+    """False iff the value holds NaN/inf. Delegates to action.py's
+    _has_nonfinite, whose kind check covers 'V' — bfloat16/float8 arrive
+    via ml_dtypes with dtype.kind 'V', and a kind-'f'-only check would
+    wave their NaNs straight through the guard."""
+    from relayrl_tpu.types.action import _has_nonfinite
+
+    try:
+        return not _has_nonfinite(np.asarray(value))
+    except Exception:
+        # Unconvertible aux values can't reach a batch column either
+        # (np.asarray fails identically there, isolated by the server's
+        # per-trajectory exception handling) — treat as inert here.
+        return True
+
+
+def trajectory_is_finite(item) -> bool:
+    """True iff every training-relevant float in the trajectory is finite.
+
+    The ingest trust boundary's semantic guard: a NaN/inf smuggled into
+    obs, act, reward, or a float aux column (v, logp_a feed REINFORCE/
+    IMPALA losses directly) would not crash anything — it would silently
+    poison the learner state and, through the next publish, the whole
+    fleet. Both algorithm families call this in ``accumulate`` and drop
+    the trajectory (counted, logged) when it fails. Action masks are
+    deliberately NOT checked: models consume them as ``mask > 0``, so a
+    -inf fill is semantically harmless.
+
+    Accepts either wire representation: a :class:`DecodedTrajectory`
+    (columnar fast path) or a list of :class:`ActionRecord`.
+    """
+    if isinstance(item, DecodedTrajectory):
+        for key in ("o", "a", "r"):
+            col = item.columns.get(key)
+            if col is not None and not _all_finite(col):
+                return False
+        for col in item.aux.values():
+            if not _all_finite(col):
+                return False
+        if item.final_obs is not None and not _all_finite(item.final_obs):
+            return False
+        return True
+    for a in item:
+        if not np.isfinite(a.rew):
+            return False
+        for value in (a.obs, a.act):
+            if value is not None and not _all_finite(value):
+                return False
+        for v in (a.data or {}).values():
+            # Skip only known-inert types: a NaN can arrive as a plain
+            # msgpack list (foreign encoder) or an ml_dtypes scalar, and
+            # both feed batch columns via np.asarray downstream.
+            if isinstance(v, (str, bytes, bool)):
+                continue
+            if not _all_finite(v):
+                return False
+    return True
+
+
 @dataclasses.dataclass
 class RawTrajectory:
     """Fallback: the native decoder couldn't columnarize this payload;

@@ -1,0 +1,136 @@
+"""Ingest trust boundary: semantically poisoned trajectories can't reach
+the learner.
+
+The wire fuzz suites (test_fuzz_codec / test_native_transport_fuzz /
+test_grpc_native_fuzz) prove malformed BYTES can't crash anything. This
+layer covers the nastier case: a perfectly well-formed trajectory whose
+floats are NaN/inf — from a buggy env, a corrupted actor, or an
+adversary. Nothing would crash; the learner state would silently go NaN
+and the next publish would poison every actor in the fleet. Both
+algorithm families must drop such trajectories at ``accumulate`` (the
+single choke point: receive_trajectory and the multi-host coordinator
+both route through it), count the drop, and keep training on good data.
+"""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import build_algorithm
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.columnar import trajectory_is_finite
+
+
+def _episode(obs_dim=4, n=4, rew=1.0, obs_fill=0.5, logp=-0.3):
+    recs = []
+    for t in range(n):
+        recs.append(ActionRecord(
+            obs=np.full((obs_dim,), obs_fill, np.float32),
+            act=np.int32(1),
+            rew=float(rew) if t == n - 1 else 0.0,
+            data={"v": np.float32(0.1), "logp_a": np.float32(logp)},
+            done=t == n - 1,
+        ))
+    return recs
+
+
+class TestFiniteGuard:
+    def test_clean_episode_passes(self):
+        assert trajectory_is_finite(_episode())
+
+    @pytest.mark.parametrize("poison", [
+        dict(rew=float("nan")),
+        dict(rew=float("inf")),
+        dict(obs_fill=float("nan")),
+        dict(logp=float("-inf")),
+    ])
+    def test_poisoned_episode_fails(self, poison):
+        assert not trajectory_is_finite(_episode(**poison))
+
+    def test_decoded_trajectory_representation(self):
+        # The columnar fast path (native decode) must agree with the
+        # record path on the same data.
+        from relayrl_tpu.types.columnar import DecodedTrajectory
+
+        def decoded(rew):
+            return DecodedTrajectory(
+                agent_id="a", n_steps=2, n_records=2,
+                marker_truncated=False,
+                columns={"o": np.zeros((2, 4), np.float32),
+                         "a": np.zeros((2,), np.int32),
+                         "r": np.array([0.0, rew], np.float32),
+                         "t": np.array([False, True])},
+                aux={"v": np.zeros((2,), np.float32),
+                     "logp_a": np.zeros((2,), np.float32)})
+
+        assert trajectory_is_finite(decoded(1.0))
+        assert not trajectory_is_finite(decoded(float("nan")))
+
+    def test_bfloat16_nan_is_caught(self):
+        # bfloat16 arrives via ml_dtypes with dtype.kind 'V'; a
+        # kind-'f'-only check would wave its NaNs through.
+        import ml_dtypes
+
+        recs = _episode()
+        bad = np.array([0.1, float("nan"), 0.2, 0.3],
+                       ml_dtypes.bfloat16)
+        recs[1] = ActionRecord(obs=bad, act=recs[1].act, rew=recs[1].rew,
+                               data=recs[1].data, done=recs[1].done)
+        assert not trajectory_is_finite(recs)
+
+    def test_plain_list_aux_nan_is_caught(self):
+        # Foreign encoders can deliver aux values as plain msgpack lists;
+        # downstream batching np.asarray's them, so the guard must too.
+        recs = _episode()
+        recs[0] = ActionRecord(obs=recs[0].obs, act=recs[0].act,
+                               rew=recs[0].rew,
+                               data={"v": [float("nan")], "logp_a": -0.1},
+                               done=recs[0].done)
+        assert not trajectory_is_finite(recs)
+
+    def test_string_aux_is_inert(self):
+        recs = _episode()
+        recs[0] = ActionRecord(obs=recs[0].obs, act=recs[0].act,
+                               rew=recs[0].rew,
+                               data={"tag": "episode-1", "v": 0.1,
+                                     "logp_a": -0.1},
+                               done=recs[0].done)
+        assert trajectory_is_finite(recs)
+
+    def test_neg_inf_mask_is_allowed(self):
+        # Masks are consumed as `mask > 0`; -inf fills are semantically
+        # inert and must NOT trip the guard.
+        recs = [ActionRecord(obs=r.obs, act=r.act,
+                             mask=np.array([1.0, -np.inf, 1.0, 1.0],
+                                           np.float32),
+                             rew=r.rew, data=r.data, done=r.done)
+                for r in _episode()]
+        assert trajectory_is_finite(recs)
+
+
+class TestLearnerDropsPoison:
+    def test_onpolicy_drops_and_keeps_training(self, tmp_cwd):
+        alg = build_algorithm("REINFORCE", obs_dim=4, act_dim=2,
+                              env_dir=str(tmp_cwd), traj_per_epoch=2,
+                              hidden_sizes=[8])
+        assert alg.accumulate(_episode(rew=float("nan"))) is None
+        assert alg.dropped_nonfinite == 1
+        # good episodes still fill the epoch buffer and train
+        assert alg.receive_trajectory(_episode()) is False
+        assert alg.receive_trajectory(_episode()) is True
+        params = alg.state.params
+        leaves = [np.asarray(x) for x in
+                  __import__("jax").tree.leaves(params)]
+        assert all(np.isfinite(a).all() for a in leaves), \
+            "params went non-finite"
+
+    def test_offpolicy_drops_before_replay(self, tmp_cwd):
+        alg = build_algorithm("DQN", obs_dim=4, act_dim=2,
+                              env_dir=str(tmp_cwd), hidden_sizes=[8],
+                              update_after=2, batch_size=2)
+        before = len(alg.buffer)
+        assert alg.accumulate(_episode(obs_fill=float("inf"))) is None
+        assert alg.dropped_nonfinite == 1
+        assert len(alg.buffer) == before, \
+            "poisoned transitions entered the replay ring"
+        alg.receive_trajectory(_episode())
+        assert len(alg.buffer) > before
