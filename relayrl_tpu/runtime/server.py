@@ -178,7 +178,12 @@ class TrainingServer:
         self._mh_ready: list = []   # assembled-but-untrained epoch batches
         self._mh_busy = False       # a broadcast step is in flight
         self.active = False
-        self.stats = {"trajectories": 0, "updates": 0, "dropped": 0}
+        # "dropped" counts transport/queue-level losses; the ingest
+        # finite-value guard's count is mirrored from the algorithm after
+        # each trajectory so operators see poisoning without reaching
+        # into algorithm internals.
+        self.stats = {"trajectories": 0, "updates": 0, "dropped": 0,
+                      "dropped_nonfinite": 0}
         # Per-thread time ledger (seconds): where the ingest pipeline
         # actually spends its time — the profile evidence that the learner
         # thread waits on the device, not on msgpack (SURVEY §7.4-1).
@@ -310,6 +315,8 @@ class TrainingServer:
             except Exception as e:
                 print(f"[TrainingServer] accumulate error: {e!r}", flush=True)
                 continue
+            finally:
+                self._sync_drop_stats()
             if isinstance(got, list):
                 self._mh_ready.extend(got)
             elif got is not None:
@@ -447,6 +454,13 @@ class TrainingServer:
                 self.timings["learn_s"] += time.monotonic() - t0
                 self._decoded.task_done()
 
+    def _sync_drop_stats(self) -> None:
+        """Mirror the algorithm's finite-guard counter into stats — the
+        single owner, so every ingest path (single-host, multi-host, any
+        future drain) keeps the operator-visible counter fresh."""
+        self.stats["dropped_nonfinite"] = getattr(
+            self.algorithm, "dropped_nonfinite", 0)
+
     def _process_one(self, item) -> None:
         """``item``: DecodedTrajectory (columnar fast path) or
         list[ActionRecord] (Python decode)."""
@@ -456,6 +470,8 @@ class TrainingServer:
         except Exception as e:  # never kill the loop on one bad batch
             print(f"[TrainingServer] learner error: {e!r}", flush=True)
             return
+        finally:
+            self._sync_drop_stats()
         if updated:
             self.stats["updates"] += 1
             try:

@@ -123,6 +123,24 @@ class TestLearnerDropsPoison:
         assert all(np.isfinite(a).all() for a in leaves), \
             "params went non-finite"
 
+    def test_server_stats_mirror_drop_counter(self, tmp_cwd):
+        # Operators watch server.stats, not algorithm internals.
+        from relayrl_tpu.runtime.server import TrainingServer
+
+        srv = TrainingServer("REINFORCE", obs_dim=4, act_dim=2,
+                             env_dir=str(tmp_cwd), start=False,
+                             hyperparams={"traj_per_epoch": 2,
+                                          "hidden_sizes": [8]})
+        try:
+            assert srv.stats["dropped_nonfinite"] == 0
+            srv._process_one(_episode(rew=float("nan")))
+            assert srv.stats["dropped_nonfinite"] == 1
+            srv._process_one(_episode())
+            assert srv.stats["trajectories"] == 2
+            assert srv.stats["dropped_nonfinite"] == 1
+        finally:
+            srv.disable_server()
+
     def test_offpolicy_drops_before_replay(self, tmp_cwd):
         alg = build_algorithm("DQN", obs_dim=4, act_dim=2,
                               env_dir=str(tmp_cwd), hidden_sizes=[8],
