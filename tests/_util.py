@@ -1,0 +1,11 @@
+"""Shared test helpers (imported as ``_util`` — conftest adds tests/ to
+sys.path via rootdir)."""
+
+import socket
+
+
+def free_port() -> int:
+    """An ephemeral localhost port (bound momentarily, then released)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

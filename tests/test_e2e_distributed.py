@@ -16,10 +16,7 @@ from relayrl_tpu.runtime.agent import Agent, run_gym_loop
 from relayrl_tpu.runtime.server import TrainingServer
 
 
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from _util import free_port  # noqa: E402
 
 
 def _zmq_addrs():

@@ -17,6 +17,12 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(code: str) -> str:
+    import importlib.util
+
+    # Self-check against vacuity: without torch installed, sys.modules can
+    # never contain it and the guard would pass while proving nothing.
+    assert importlib.util.find_spec("torch") is not None, \
+        "hygiene test vacuous: torch not installed in this environment"
     env = dict(os.environ)
     # Repo root ONLY: the ambient PYTHONPATH may carry accelerator plugin
     # site dirs whose import blocks when the device tunnel is down — this

@@ -1,0 +1,115 @@
+"""Hot-swap trust boundary: a corrupt or wrong-arch model broadcast must
+never take an actor down — or worse, leave it silently serving nothing.
+
+The publish plane ships whole ModelBundle bytes (the reference ships
+TorchScript files and load-panics on corruption, agent_zmq.rs:645-679).
+Here the agent's _on_model isolates ANY decode/validation failure,
+keeps serving the installed policy, and installs the next valid bundle
+as if the bad one never happened. Runs over a live zmq transport pair —
+the real listener thread, not a direct maybe_swap call (that unit angle
+lives in test_offpolicy.py).
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import build_algorithm
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.runtime.agent import Agent
+from relayrl_tpu.transport import make_server_transport
+
+
+from _util import free_port as _free_port  # noqa: E402
+
+
+@pytest.fixture
+def cfg(tmp_cwd):
+    return ConfigLoader(create_if_missing=False)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.mark.parametrize("kind", ["zmq", "native"])
+def test_corrupt_then_valid_broadcast(cfg, tmp_cwd, kind):
+    if kind == "native":
+        # Runtime check (repo convention, test_transport.py): a skipif
+        # argument would trigger the native build during collection of
+        # every pytest run.
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C native)")
+    alg = build_algorithm("REINFORCE", obs_dim=4, act_dim=2,
+                          env_dir=str(tmp_cwd), hidden_sizes=[8])
+    bundle_v1 = alg.bundle().to_bytes()
+
+    p1, p2, p3 = _free_port(), _free_port(), _free_port()
+    if kind == "native":
+        srv_addr = {"bind_addr": f"127.0.0.1:{p1}"}
+        ag_addr = {"server_addr": f"127.0.0.1:{p1}"}
+    else:
+        srv_addr = {"agent_listener_addr": f"tcp://127.0.0.1:{p1}",
+                    "trajectory_addr": f"tcp://127.0.0.1:{p2}",
+                    "model_pub_addr": f"tcp://127.0.0.1:{p3}"}
+        ag_addr = {"agent_listener_addr": f"tcp://127.0.0.1:{p1}",
+                   "trajectory_addr": f"tcp://127.0.0.1:{p2}",
+                   "model_sub_addr": f"tcp://127.0.0.1:{p3}"}
+    srv = make_server_transport(kind, cfg, **srv_addr)
+    srv.get_model = lambda: (1, bundle_v1)
+    srv.start()
+    try:
+        agent = Agent(server_type=kind, handshake_timeout_s=30, seed=0,
+                      config_path=None,
+                      model_path=str(tmp_cwd / "client.msgpack"),
+                      **ag_addr)
+        try:
+            assert agent.model_version == 1
+
+            # Ordered triplet on ONE publish channel: corrupt bytes (v2),
+            # wrong-arch bundle (v3), honest sentinel (v4). Transport
+            # ordering means the sentinel's arrival PROVES v2/v3 were
+            # delivered first and rejected — no sleep-and-hope negative
+            # assertions (and a listener thread killed by v2 would never
+            # install v4). Republished in a loop so a slow SUB
+            # subscription can't drop the whole triplet and pass
+            # vacuously: versions only move forward, so re-sends of v2/v3
+            # after v4 installs are stale-rejected by design.
+            other = build_algorithm("REINFORCE", obs_dim=4, act_dim=2,
+                                    env_dir=str(tmp_cwd),
+                                    hidden_sizes=[16, 16])
+            wrong_arch = other.bundle()
+            wrong_arch.version = 3
+            good = alg.bundle()
+            good.version = 4
+
+            def blast():
+                srv.publish_model(2, b"\xde\xad\xbe\xef not a bundle")
+                srv.publish_model(3, wrong_arch.to_bytes())
+                srv.publish_model(4, good.to_bytes())
+
+            deadline = time.monotonic() + 15
+            while agent.model_version != 4:
+                assert time.monotonic() < deadline, \
+                    "sentinel never installed (listener dead or drop)"
+                blast()
+                _wait(lambda: agent.model_version == 4, timeout=1.0)
+            # v2 (undecodable) and v3 (arch guard) were delivered before
+            # v4 and rejected; the actor still serves the ORIGINAL arch.
+            assert agent.model_version == 4
+            assert agent.actor.arch["hidden_sizes"] == [8]
+            act = agent.request_for_action(np.zeros(4, np.float32))
+            assert act.get_act() is not None
+        finally:
+            agent.disable_agent()
+    finally:
+        srv.stop()

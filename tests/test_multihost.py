@@ -20,10 +20,7 @@ pytestmark = pytest.mark.slow
 _WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from _util import free_port as _free_port  # noqa: E402
 
 
 def test_two_process_distributed(tmp_path):

@@ -28,10 +28,7 @@ _WORKER = os.path.join(os.path.dirname(__file__),
                        "_multihost_server_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from _util import free_port as _free_port  # noqa: E402
 
 
 def _native_lib_available() -> bool:

@@ -22,10 +22,7 @@ from relayrl_tpu.transport import (
 )
 
 
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from _util import free_port  # noqa: E402
 
 
 @pytest.fixture
