@@ -246,19 +246,13 @@ def main():
 
     cells = [c for c in CELLS
              if c[2] != "native" or native_available()]
-    if args.only:
-        def _tag(algo, hp, transport, env_spec):
-            env_id = env_spec[0]
-            env_tag = ("" if env_id == "CartPole-v1"
-                       else f"_{env_id.split('-')[0].lower()}")
-            return (f"{algo.lower()}"
-                    f"{'_baseline' if hp.get('with_vf_baseline') else ''}"
-                    f"{env_tag}_{transport}")
-        cells = [c for c in cells if args.only in _tag(c[0], c[1], c[2], c[3])]
-        assert cells, f"--only {args.only!r} matched no cells"
-    if len(cells) < len(CELLS):
+    if len(cells) < len(CELLS):  # before --only: that filter also shrinks
         print("[matrix] native .so unavailable — skipping native cells",
               flush=True)
+    if args.only:
+        cells = [c for c in cells
+                 if args.only in cell_tag(c[0], c[1], c[2], c[3])]
+        assert cells, f"--only {args.only!r} matched no cells"
     os.makedirs(args.out, exist_ok=True)
     results = [run_cell(algo, hp, transport, env_spec, args.updates,
                         args.out, meta)

@@ -19,6 +19,15 @@ from relayrl_tpu.runtime.server import TrainingServer
 from _util import free_port  # noqa: E402
 
 
+def _wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
 def _zmq_addrs():
     return {
         "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
@@ -205,6 +214,54 @@ def test_server_checkpoint_resume(tmp_cwd):
         assert resumed.algorithm.version == trained_version
     finally:
         resumed.disable_server()
+
+
+def test_agent_restart_and_repoint(tmp_cwd):
+    """Agent lifecycle parity (ref o3_agent.rs restart/enable/disable):
+    restart against the same server keeps serving; restart with address
+    overrides re-resolves to a DIFFERENT server — the reference's
+    address-re-resolution semantic (training_server_wrapper.rs:69-90),
+    agent side."""
+    hp = {"traj_per_epoch": 1, "hidden_sizes": [8],
+          "with_vf_baseline": False}
+    addrs_a = _zmq_addrs()
+    srv_a = TrainingServer("REINFORCE", obs_dim=4, act_dim=2,
+                           server_type="zmq", env_dir=str(tmp_cwd),
+                           hyperparams=hp, **addrs_a)
+    try:
+        agent = Agent(server_type="zmq", handshake_timeout_s=20,
+                      **_agent_addrs(addrs_a))
+        try:
+            v_a = agent.model_version
+            act = agent.request_for_action(np.zeros(4, np.float32))
+            assert act.get_act() is not None
+
+            # Same-address restart: full teardown + re-handshake.
+            agent.restart_agent()
+            assert agent.active and agent.model_version >= v_a
+            act = agent.request_for_action(np.zeros(4, np.float32))
+            assert act.get_act() is not None
+
+            # Re-point at a different server via addr overrides.
+            addrs_b = _zmq_addrs()
+            srv_b = TrainingServer("REINFORCE", obs_dim=4, act_dim=2,
+                                   server_type="zmq",
+                                   env_dir=str(tmp_cwd / "b"),
+                                   hyperparams=hp, **addrs_b)
+            try:
+                agent.restart_agent(**_agent_addrs(addrs_b))
+                assert agent.active
+                act = agent.request_for_action(np.zeros(4, np.float32))
+                agent.flag_last_action(reward=1.0)
+                assert _wait_for(lambda: srv_b.stats["trajectories"] >= 1)
+                assert srv_a.stats["trajectories"] == 0, \
+                    "trajectory went to the OLD server after re-point"
+            finally:
+                srv_b.disable_server()
+        finally:
+            agent.disable_agent()
+    finally:
+        srv_a.disable_server()
 
 
 def test_server_restart(tmp_cwd):
