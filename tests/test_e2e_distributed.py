@@ -94,16 +94,12 @@ def test_full_loop_model_update_reaches_agent(tmp_cwd, server_type):
             env = _RandomEnv()
             run_gym_loop(agent, env, episodes=2, max_steps=10)
 
-            deadline = time.monotonic() + 30
-            while server.stats["updates"] < 1 and time.monotonic() < deadline:
-                time.sleep(0.05)
-            assert server.stats["updates"] >= 1, (
+            assert _wait_for(lambda: server.stats["updates"] >= 1,
+                             timeout=30), (
                 f"learner never updated; stats={server.stats}")
 
-            deadline = time.monotonic() + 30
-            while agent.model_version < 1 and time.monotonic() < deadline:
-                time.sleep(0.05)
-            assert agent.model_version >= 1, "hot-swap never happened"
+            assert _wait_for(lambda: agent.model_version >= 1,
+                             timeout=30), "hot-swap never happened"
             assert agent.transport.identity in server.agent_ids
         finally:
             agent.disable_agent()
@@ -130,9 +126,7 @@ def test_drain_then_shutdown_processes_inflight(tmp_cwd):
         run_gym_loop(agent, env, episodes=6, max_steps=10)
         # In-flight socket bytes are invisible to drain(): wait for arrival
         # first (6 episodes / traj_per_epoch 2 => exactly 3 updates)...
-        deadline = time.monotonic() + 60
-        while server.stats["trajectories"] < 6 and time.monotonic() < deadline:
-            time.sleep(0.05)
+        _wait_for(lambda: server.stats["trajectories"] >= 6, timeout=60)
         # ...then drain guarantees processing/publishing has finished.
         assert server.drain(timeout=60)
         assert server.stats["updates"] == 3
@@ -163,17 +157,12 @@ def test_multi_agent_zmq(tmp_cwd):
         for a in agents:
             run_gym_loop(a, env, episodes=2, max_steps=8)
 
-        deadline = time.monotonic() + 30
-        while server.stats["updates"] < 1 and time.monotonic() < deadline:
-            time.sleep(0.05)
-        assert server.stats["updates"] >= 1
+        assert _wait_for(lambda: server.stats["updates"] >= 1, timeout=30)
         assert len(server.agent_ids) == 3
 
         for i, a in enumerate(agents):
-            deadline = time.monotonic() + 30
-            while a.model_version < 1 and time.monotonic() < deadline:
-                time.sleep(0.05)
-            assert a.model_version >= 1, f"agent {i} never got the new model"
+            assert _wait_for(lambda a=a: a.model_version >= 1, timeout=30), \
+                f"agent {i} never got the new model"
     finally:
         for a in agents:
             a.disable_agent()
@@ -194,10 +183,8 @@ def test_server_checkpoint_resume(tmp_cwd):
                       **_agent_addrs(server_addrs))
         try:
             run_gym_loop(agent, _RandomEnv(), episodes=3, max_steps=6)
-            deadline = time.monotonic() + 30
-            while server.stats["updates"] < 3 and time.monotonic() < deadline:
-                time.sleep(0.05)
-            assert server.stats["updates"] >= 3
+            assert _wait_for(lambda: server.stats["updates"] >= 3,
+                             timeout=30)
         finally:
             agent.disable_agent()
         trained_version = server.algorithm.version
@@ -216,21 +203,40 @@ def test_server_checkpoint_resume(tmp_cwd):
         resumed.disable_server()
 
 
-def test_agent_restart_and_repoint(tmp_cwd):
+def _transport_addr_pair(kind):
+    """(server_addrs, agent_addrs) for any transport kind."""
+    if kind == "zmq":
+        srv = _zmq_addrs()
+        return srv, _agent_addrs(srv)
+    port = free_port()
+    return ({"bind_addr": f"127.0.0.1:{port}"},
+            {"server_addr": f"127.0.0.1:{port}"})
+
+
+def _transports_available():
+    from relayrl_tpu.transport.native_backend import native_available
+
+    return ["zmq", "grpc"] + (["native"] if native_available() else [])
+
+
+@pytest.mark.parametrize("kind", ["zmq", "grpc", "native"])
+def test_agent_restart_and_repoint(tmp_cwd, kind):
     """Agent lifecycle parity (ref o3_agent.rs restart/enable/disable):
     restart against the same server keeps serving; restart with address
     overrides re-resolves to a DIFFERENT server — the reference's
     address-re-resolution semantic (training_server_wrapper.rs:69-90),
-    agent side."""
+    agent side. Parametrized across all three transports: teardown +
+    re-handshake is the transport-specific part."""
+    if kind not in _transports_available():
+        pytest.skip("native library not built (make -C native)")
     hp = {"traj_per_epoch": 1, "hidden_sizes": [8],
           "with_vf_baseline": False}
-    addrs_a = _zmq_addrs()
+    addrs_a, ag_a = _transport_addr_pair(kind)
     srv_a = TrainingServer("REINFORCE", obs_dim=4, act_dim=2,
-                           server_type="zmq", env_dir=str(tmp_cwd),
+                           server_type=kind, env_dir=str(tmp_cwd),
                            hyperparams=hp, **addrs_a)
     try:
-        agent = Agent(server_type="zmq", handshake_timeout_s=20,
-                      **_agent_addrs(addrs_a))
+        agent = Agent(server_type=kind, handshake_timeout_s=20, **ag_a)
         try:
             v_a = agent.model_version
             act = agent.request_for_action(np.zeros(4, np.float32))
@@ -243,13 +249,13 @@ def test_agent_restart_and_repoint(tmp_cwd):
             assert act.get_act() is not None
 
             # Re-point at a different server via addr overrides.
-            addrs_b = _zmq_addrs()
+            addrs_b, ag_b = _transport_addr_pair(kind)
             srv_b = TrainingServer("REINFORCE", obs_dim=4, act_dim=2,
-                                   server_type="zmq",
+                                   server_type=kind,
                                    env_dir=str(tmp_cwd / "b"),
                                    hyperparams=hp, **addrs_b)
             try:
-                agent.restart_agent(**_agent_addrs(addrs_b))
+                agent.restart_agent(**ag_b)
                 assert agent.active
                 act = agent.request_for_action(np.zeros(4, np.float32))
                 agent.flag_last_action(reward=1.0)
@@ -329,10 +335,8 @@ def test_offpolicy_and_async_families_over_sockets(tmp_cwd, algo, hp):
                 f"{algo} learner never updated; stats={server.stats}")
             assert server.stats["dropped"] == 0
 
-            deadline = time.monotonic() + 30
-            while agent.model_version < 1 and time.monotonic() < deadline:
-                time.sleep(0.05)
-            assert agent.model_version >= 1, f"{algo} hot-swap never happened"
+            assert _wait_for(lambda: agent.model_version >= 1,
+                             timeout=30), f"{algo} hot-swap never happened"
         finally:
             agent.disable_agent()
     finally:
