@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Refresh every chip-side benchmark artifact in one pass — run whenever a
+# TPU backend is reachable (the r4 flash/ring/conv work landed while the
+# tunnel was down, so attention.json + learner_tpu.json predate it).
+#
+#   bash benches/refresh_chip.sh            # full refresh
+#
+# Produces/updates (committed artifacts):
+#   benches/results/attention.json    flash vs dense vs blockwise vs
+#                                     flash_chunked{2,4} (ring cost model)
+#   benches/results/learner_tpu.json  per-family updates/s + MFU rows,
+#                                     incl. cnn_pixel_tpu_trunk (the
+#                                     conv_spec="tpu" lift) and the
+#                                     reworked-flash transformer rows
+#   plus a bench.py headline line on stdout (the driver records its own
+#   BENCH_r*.json; compare against benches/results/headline_chip_r4.json).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== backend probe =="
+python - <<'EOF'
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu", f"no accelerator: {d}"
+print("devices:", d)
+EOF
+
+# emit() prints JSON lines to stdout; the committed artifacts are those
+# lines captured (grep guards against any stray non-JSON stdout).
+echo "== attention shootout -> results/attention.json =="
+python bench_attention.py | grep '^{' | tee results/attention.json
+
+echo "== learner families -> results/learner_tpu.json =="
+RELAYRL_BENCH_TPU=1 python bench_learner.py | grep '^{' \
+    | tee results/learner_tpu.json
+
+echo "== headline (driver-shaped line, not committed) =="
+cd .. && python bench.py
