@@ -26,13 +26,18 @@ print("devices:", d)
 EOF
 
 # emit() prints JSON lines to stdout; the committed artifacts are those
-# lines captured (grep guards against any stray non-JSON stdout).
+# lines captured (grep guards against stray non-JSON stdout). Write to a
+# temp file and mv only on success: this script exists BECAUSE the
+# tunnel is flaky, and a mid-run death must not clobber the good
+# committed numbers with a partial file.
 echo "== attention shootout -> results/attention.json =="
-python bench_attention.py | grep '^{' | tee results/attention.json
+python bench_attention.py | grep '^{' | tee results/.attention.json.tmp
+mv results/.attention.json.tmp results/attention.json
 
 echo "== learner families -> results/learner_tpu.json =="
 RELAYRL_BENCH_TPU=1 python bench_learner.py | grep '^{' \
-    | tee results/learner_tpu.json
+    | tee results/.learner_tpu.json.tmp
+mv results/.learner_tpu.json.tmp results/learner_tpu.json
 
 echo "== headline (driver-shaped line, not committed) =="
 cd .. && python bench.py
