@@ -38,18 +38,30 @@ def free_port() -> int:
 
 
 def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
-               episodes: int, max_steps: int, queue):
+               episodes: int, max_steps: int, greedy_eval: int, queue,
+               eval_barrier):
     from relayrl_tpu.utils.hostpin import pin_cpu
 
     pin_cpu()  # actors are CPU hosts
     from relayrl_tpu.envs import make
-    from relayrl_tpu.runtime.agent import Agent, run_gym_loop
+    from relayrl_tpu.runtime.agent import Agent, run_eval_loop, run_gym_loop
 
     agent = Agent(server_type=server_type, seed=idx, **agent_addrs)
     env = make({"cartpole": "CartPole-v1",
-                "pendulum": "Pendulum-v1"}[env_id])
+                "pendulum": "Pendulum-v1",
+                "lunarlander": "LunarLander-v3"}[env_id])
+    t0 = time.time()
     returns = run_gym_loop(agent, env, episodes=episodes, max_steps=max_steps)
-    queue.put((idx, returns, agent.model_version))
+    train_s = time.time() - t0
+    greedy = []
+    if greedy_eval > 0:
+        # Rendezvous before evaluating: while any peer is still training,
+        # its trajectories keep triggering publishes that would hot-swap
+        # this actor's policy mid-eval and mix versions in the average.
+        eval_barrier.wait(timeout=600)
+        greedy = run_eval_loop(agent, env, episodes=greedy_eval,
+                               max_steps=max_steps)
+    queue.put((idx, returns, agent.model_version, greedy, train_s))
     agent.disable_agent()
 
 
@@ -57,7 +69,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--algo", default="REINFORCE")
     ap.add_argument("--env", default="cartpole",
-                    choices=["cartpole", "pendulum"])
+                    choices=["cartpole", "pendulum", "lunarlander"],
+                    help="lunarlander (the reference's committed-curve env, "
+                         "examples/REINFORCE_without_baseline/box2d/"
+                         "lunar_lander) needs gymnasium[box2d]")
     ap.add_argument("--transport", default="zmq",
                     choices=["zmq", "grpc", "native"])
     ap.add_argument("--actors", type=int, default=1)
@@ -66,6 +81,9 @@ def main():
     ap.add_argument("--max-steps", type=int, default=500)
     ap.add_argument("--baseline", action="store_true")
     ap.add_argument("--tensorboard", action="store_true")
+    ap.add_argument("--greedy-eval", type=int, default=0, metavar="N",
+                    help="after training, run N deterministic episodes per "
+                         "actor (nothing recorded or shipped)")
     args = ap.parse_args()
 
     if os.environ.get("RELAYRL_TPU") != "1":
@@ -98,7 +116,8 @@ def main():
         hp["discrete"] = False
         hp["act_limit"] = 2.0
 
-    env_dims = {"cartpole": (4, 2), "pendulum": (3, 1)}
+    env_dims = {"cartpole": (4, 2), "pendulum": (3, 1),
+                "lunarlander": (8, 4)}
     obs_dim, act_dim = env_dims[args.env]
 
     server = TrainingServer(
@@ -108,19 +127,37 @@ def main():
 
     ctx = mp.get_context("spawn")
     queue = ctx.Queue()
+    eval_barrier = ctx.Barrier(args.actors)
     procs = [
         ctx.Process(target=actor_proc,
                     args=(i, args.transport, agent_addrs, args.env,
-                          args.episodes, args.max_steps, queue))
+                          args.episodes, args.max_steps, args.greedy_eval,
+                          queue, eval_barrier))
         for i in range(args.actors)
     ]
-    t0 = time.time()
     for p in procs:
         p.start()
-    results = [queue.get() for _ in procs]
+    # Collect with a liveness check: an actor that dies before queue.put
+    # (e.g. --env lunarlander without gymnasium[box2d]) must fail the
+    # driver, not wedge it on a queue.get that will never be fed.
+    results = []
+    while len(results) < len(procs):
+        try:
+            results.append(queue.get(timeout=1.0))
+        except Exception:
+            reported = {r[0] for r in results}
+            dead = [(i, p.exitcode) for i, p in enumerate(procs)
+                    if p.exitcode is not None and i not in reported]
+            if dead and len(results) + len(dead) >= len(procs):
+                # every still-unreported actor is gone (any exit code —
+                # a clean sys.exit(0) before reporting is just as wedging)
+                server.disable_server()
+                raise SystemExit(
+                    f"actor(s) {dead} ((idx, exitcode)) exited before "
+                    f"reporting — see the traceback above")
     for p in procs:
         p.join()
-    elapsed = time.time() - t0
+    elapsed = max(r[4] for r in results)  # training-only, excludes eval
 
     # Actors just finished: wait for the last episodes to arrive off the
     # sockets, then drain the learner.
@@ -130,12 +167,17 @@ def main():
            and time.time() < deadline):
         time.sleep(0.05)
     server.drain()
-    total_eps = sum(len(r) for _, r, _ in results)
-    last = [r[-1] for _, r, _ in sorted(results)]
+    total_eps = sum(len(r) for _, r, _, _, _ in results)
+    last = [r[-1] for _, r, _, _, _ in sorted(results)]
     print(f"\n[distributed] {args.actors} actor(s) x {args.episodes} eps in "
           f"{elapsed:.1f}s ({total_eps / elapsed:.1f} eps/s); final returns "
           f"per actor: {[round(x, 1) for x in last]}; server version "
           f"{server.algorithm.version}", flush=True)
+    if args.greedy_eval > 0:
+        greedy = [g for _, _, _, gs, _ in results for g in gs]
+        print(f"[distributed] greedy eval ({args.greedy_eval} eps/actor): "
+              f"avg {sum(greedy) / len(greedy):.1f}  "
+              f"{[round(g, 1) for g in greedy]}", flush=True)
     server.disable_server()
 
 
