@@ -105,6 +105,67 @@ class AlgorithmBase(abc.ABC):
     #   enable_multihost(mesh) re-compile the update over the global mesh
 
     # -- TPU-native surface --
+    def warmup(self, should_continue=None) -> int:
+        """Pre-compile the jitted update for every batch shape the first
+        real epochs can hit, so the first update under load is a cache
+        hit instead of a compile. XLA compiles on a learner thread that —
+        in a one-process, few-core deployment (a notebook kernel hosting
+        both the server and a busy actor loop) — otherwise competes with
+        the actor for CPU and can stretch a ~2 s compile past the whole
+        example run. Returns the number of shapes compiled; families
+        without a known shape set return 0. Best-effort: callers treat
+        failures as non-fatal.
+
+        ``should_continue`` (nullary → bool) is consulted before each
+        shape: once real work is already queued, compiling on demand is
+        just as fast as warming up, so implementations stop early instead
+        of pre-paying shapes the caller may never hit.
+        """
+        return 0
+
+    def _warmup_is_collective(self) -> bool:
+        """True when this algorithm's update is a multi-process collective
+        (``enable_multihost`` over >1 jax processes) — warming up solo
+        would hang every other rank in the collective, so family
+        ``warmup()`` implementations refuse and return 0. This guard lives
+        at the algorithm altitude on purpose: the server's broadcast loop
+        is not the only possible caller."""
+        if getattr(self, "_mesh", None) is None:
+            return False
+        import jax
+
+        return jax.process_count() > 1
+
+    def _to_device(self, host_batch) -> dict:
+        """The single owner of host-batch → device-batch placement
+        (mesh-aware ``_place`` when multihost, plain ``asarray``
+        otherwise). Both families' ``train_on_batch`` and the warmup path
+        share it so a placement change cannot leave warmup compiling cache
+        entries the real update never hits."""
+        import jax.numpy as jnp
+
+        place = getattr(self, "_place", None)
+        if place is not None:
+            return place(dict(host_batch))
+        return {k: jnp.asarray(v) for k, v in host_batch.items()}
+
+    def _warmup_update(self, host_batch) -> None:
+        """Run ``self._update`` once on a shape/dtype placeholder batch and
+        discard every output. The state argument is donated
+        (``donate_argnums=0``), so the update consumes a copy — the live
+        ``self.state`` buffers, version, metrics, and logger are untouched.
+        Non-array state leaves pass through un-copied to keep the call
+        signature identical to the real update's (a dtype-changed leaf
+        would compile a cache entry the real call never hits)."""
+        import jax
+        import jax.numpy as jnp
+
+        state_copy = jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            self.state)
+        _, metrics = self._update(state_copy, self._to_device(host_batch))
+        jax.block_until_ready(metrics)
+
     def _jitted_policy_step(self):
         """``self.policy.step`` jitted once per instance — rebuilding the
         wrapper per call would bypass the compile cache and retrace every

@@ -168,11 +168,8 @@ class OffPolicyAlgorithm(AlgorithmBase):
         """One jitted update on a sampled transition batch. Multi-host:
         every process calls this with the same (broadcast) batch — the
         replay buffer itself stays coordinator-side."""
-        if self._place is not None:
-            device_batch = self._place(dict(host_batch))
-        else:
-            device_batch = {k: jnp.asarray(v) for k, v in host_batch.items()}
-        self.state, metrics = self._update(self.state, device_batch)
+        self.state, metrics = self._update(self.state,
+                                           self._to_device(host_batch))
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         from relayrl_tpu.parallel.distributed import is_coordinator
 
@@ -236,6 +233,16 @@ class OffPolicyAlgorithm(AlgorithmBase):
             "mask2": np.ones((b, self.act_dim), np.float32),
             "done": np.zeros((b,), np.float32),
         }
+
+    def warmup(self, should_continue=None) -> int:
+        """Replay samples are always ``[batch_size]`` transitions — one
+        compile covers every training batch this family draws."""
+        if self._warmup_is_collective():
+            return 0
+        if should_continue is not None and not should_continue():
+            return 0
+        self._warmup_update(self.mh_zero_batch(self.batch_size, 0))
+        return 1
 
     def maybe_log_epoch(self) -> None:
         """Epoch logging is per ``traj_per_epoch`` trajectories, not per

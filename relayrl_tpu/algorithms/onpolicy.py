@@ -143,11 +143,8 @@ class OnPolicyAlgorithm(AlgorithmBase):
         """One jitted update on an assembled batch dict (host or device
         arrays). Multi-host: every process must call this with the same
         batch (see the server's broadcast loop)."""
-        if self._place is not None:
-            device_batch = self._place(dict(host_batch))
-        else:
-            device_batch = {k: jnp.asarray(v) for k, v in host_batch.items()}
-        self.state, metrics = self._update(self.state, device_batch)
+        self.state, metrics = self._update(self.state,
+                                           self._to_device(host_batch))
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         return self._last_metrics
 
@@ -161,6 +158,24 @@ class OnPolicyAlgorithm(AlgorithmBase):
 
         return TrajectoryBatch.zeros(b, t, self.obs_dim, self.act_dim,
                                      self.discrete)
+
+    def warmup(self, should_continue=None) -> int:
+        """Epoch batches are always ``[traj_per_epoch, bucket]`` — one
+        compile per configured bucket length covers every batch this
+        family can ever assemble. Buckets go smallest-first (they arrive
+        sorted): short-episode tasks hit the small buckets, so an
+        early-stopped warmup has most likely already compiled the shape
+        that is about to be needed."""
+        if self._warmup_is_collective():
+            return 0
+        compiled = 0
+        for t in self.buffer.buckets:
+            if should_continue is not None and not should_continue():
+                break
+            self._warmup_update(
+                self.mh_zero_batch(self.traj_per_epoch, int(t)))
+            compiled += 1
+        return compiled
 
     def maybe_log_epoch(self) -> None:
         # One collective update == one epoch for the on-policy family.

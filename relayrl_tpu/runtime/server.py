@@ -190,7 +190,10 @@ class TrainingServer:
         #   decode_s      staging thread inside decode
         #   learn_s       learner thread inside receive_trajectory/update
         #   learner_idle_s learner thread blocked on an empty queue
-        self.timings = {"decode_s": 0.0, "learn_s": 0.0, "learner_idle_s": 0.0}
+        #   warmup_s      learner thread pre-compiling update shapes
+        self.timings = {"decode_s": 0.0, "learn_s": 0.0,
+                        "learner_idle_s": 0.0, "warmup_s": 0.0}
+        self._warmup_done = threading.Event()
 
         self._tb = None
         if tensorboard:
@@ -430,6 +433,30 @@ class TrainingServer:
 
     # -- learner loop --
     def _learner_loop(self) -> None:
+        if not self._warmup_done.is_set():
+            # Pre-compile the update for every shape the first epochs can
+            # hit, while the fleet is still handshaking/playing its first
+            # episodes. Without this, the first compile lands under ingest
+            # load — and in a one-process deployment (notebook kernel
+            # hosting server + busy actor loop on a small host) a ~2 s
+            # compile competing with the actor loop for CPU can stretch
+            # past the whole example run, so no update ever happens live.
+            t0 = time.monotonic()
+            try:
+                n = self.algorithm.warmup(
+                    should_continue=lambda: (self._decoded.empty()
+                                             and self._ingest.empty()
+                                             and not self._stop.is_set()))
+                if n:
+                    print(f"[TrainingServer] warmup: {n} update shape(s) "
+                          f"compiled in {time.monotonic() - t0:.1f}s",
+                          flush=True)
+            except Exception as e:  # best-effort: first batch compiles then
+                print(f"[TrainingServer] warmup failed (non-fatal): {e!r}",
+                      flush=True)
+            finally:
+                self.timings["warmup_s"] += time.monotonic() - t0
+                self._warmup_done.set()
         while not self._stop.is_set():
             t_wait = time.monotonic()
             try:
@@ -552,12 +579,29 @@ class TrainingServer:
             self._staging_thread.start()
         self._mh_ready = []
         self._mh_busy = False
+        if multi_host:
+            # The multi-host update is collective — a solo pre-compile
+            # would hang the other ranks; wait_warmup() must not block.
+            self._warmup_done.set()
         self._learner_thread = threading.Thread(
             target=(self._learner_loop_multihost if multi_host
                     else self._learner_loop),
             name="learner", daemon=True)
         self._learner_thread.start()
         self.active = True
+
+    def wait_warmup(self, timeout: float | None = None) -> bool:
+        """Block until the learner thread has pre-compiled its update
+        shapes (no-op/immediate on multi-host and after the first enable).
+        One-process deployments that run the actor loop on the main thread
+        (notebooks) call this right after construction: the main thread
+        sleeps on the event, so the compile gets the core to itself.
+        Returns False immediately when the server isn't running
+        (``start=False`` and no enable yet): no learner thread exists to
+        ever set the event, so blocking would hang forever."""
+        if not self.active and not self._warmup_done.is_set():
+            return False
+        return self._warmup_done.wait(timeout)
 
     def disable_server(self) -> None:
         if not self.active:

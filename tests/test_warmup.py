@@ -1,0 +1,115 @@
+"""Learner warmup: pre-compiling update shapes must be invisible to state.
+
+Motivation (found live): in a one-process deployment — a notebook kernel
+hosting both the TrainingServer and a busy actor loop on a small host —
+the first XLA compile of the update lands on the learner thread *under*
+ingest load, competes with the actor loop for CPU, and can stretch past
+the whole example run: trajectories freeze at one epoch batch, updates
+stay at 0, and the policy never hot-swaps mid-run. ``warmup()`` compiles
+the known shape set while the process is idle instead; the reference has
+nothing comparable (its learner is a separate subprocess, its models are
+eager TorchScript — no compile cliff to fall off).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import build_algorithm
+
+
+def _tree_equal(a, b) -> bool:
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        return False
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+@pytest.mark.parametrize("algo,hp", [
+    ("REINFORCE", {"with_vf_baseline": True}),
+    ("SAC", {"discrete": False, "act_limit": 1.0}),
+])
+def test_warmup_leaves_state_untouched(tmp_cwd, algo, hp):
+    alg = build_algorithm(algo, obs_dim=3, act_dim=2, env_dir=".",
+                          hyperparams=hp)
+    before = jax.tree_util.tree_map(np.asarray, alg.state)
+    v0 = alg.version
+    n = alg.warmup()
+    assert n >= 1
+    assert alg.version == v0
+    assert _tree_equal(before, alg.state), \
+        "warmup mutated live learner state"
+    # The logger saw no epoch rows from warmup (first_row still pending).
+    assert alg.epoch == 0 if hasattr(alg, "epoch") else True
+
+
+def test_warmup_covers_every_bucket_so_real_update_is_cache_hit(tmp_cwd):
+    alg = build_algorithm("REINFORCE", obs_dim=3, act_dim=2, env_dir=".",
+                          hyperparams={"with_vf_baseline": False})
+    n = alg.warmup()
+    assert n == len(alg.buffer.buckets)
+    size_after_warmup = alg._update._cache_size()
+    # A real update on any bucket shape must not add a compile cache entry.
+    for t in alg.buffer.buckets:
+        alg.train_on_batch(alg.mh_zero_batch(alg.traj_per_epoch, int(t)))
+    assert alg._update._cache_size() == size_after_warmup, \
+        "real updates recompiled shapes warmup claimed to cover"
+
+
+def test_warmup_stops_early_when_work_is_pending(tmp_cwd):
+    alg = build_algorithm("REINFORCE", obs_dim=3, act_dim=2, env_dir=".",
+                          hyperparams={"with_vf_baseline": False})
+    calls = []
+
+    def one_shape_only():
+        calls.append(None)
+        return len(calls) <= 1  # pending work appears after the 1st shape
+
+    assert alg.warmup(should_continue=one_shape_only) == 1
+    alg2 = build_algorithm("DQN", obs_dim=3, act_dim=2, env_dir=".")
+    assert alg2.warmup(should_continue=lambda: False) == 0
+
+
+def test_server_wait_warmup(tmp_cwd):
+    import socket
+
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    def port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    server = TrainingServer(
+        "REINFORCE", obs_dim=3, act_dim=2, env_dir=".", server_type="zmq",
+        agent_listener_addr=f"tcp://127.0.0.1:{port()}",
+        trajectory_addr=f"tcp://127.0.0.1:{port()}",
+        model_pub_addr=f"tcp://127.0.0.1:{port()}")
+    try:
+        assert server.wait_warmup(timeout=120)
+        assert server.timings["warmup_s"] > 0
+        assert server.stats["updates"] == 0  # warmup trained nothing
+    finally:
+        server.disable_server()
+
+
+def test_wait_warmup_returns_false_when_not_started(tmp_cwd):
+    import socket
+
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    def port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    server = TrainingServer(
+        "REINFORCE", obs_dim=3, act_dim=2, env_dir=".", server_type="zmq",
+        start=False,
+        agent_listener_addr=f"tcp://127.0.0.1:{port()}",
+        trajectory_addr=f"tcp://127.0.0.1:{port()}",
+        model_pub_addr=f"tcp://127.0.0.1:{port()}")
+    # No learner thread exists: must not block, regardless of timeout.
+    assert server.wait_warmup() is False
