@@ -84,6 +84,10 @@ def main():
     ap.add_argument("--greedy-eval", type=int, default=0, metavar="N",
                     help="after training, run N deterministic episodes per "
                          "actor (nothing recorded or shipped)")
+    ap.add_argument("--hp", action="append", default=[], metavar="K=V",
+                    help="extra algorithm hyperparameter (repeatable), e.g. "
+                         "--hp ent_coef=0.05 --hp lr=1e-4; values parse as "
+                         "JSON when possible, else stay strings")
     args = ap.parse_args()
 
     if os.environ.get("RELAYRL_TPU") != "1":
@@ -115,6 +119,16 @@ def main():
     if args.env == "pendulum":
         hp["discrete"] = False
         hp["act_limit"] = 2.0
+    for kv in args.hp:
+        key, _, raw = kv.partition("=")
+        if not _:
+            raise SystemExit(f"--hp expects K=V, got {kv!r}")
+        try:
+            import json
+
+            hp[key] = json.loads(raw)
+        except ValueError:
+            hp[key] = raw
 
     env_dims = {"cartpole": (4, 2), "pendulum": (3, 1),
                 "lunarlander": (8, 4)}
