@@ -210,7 +210,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", help="substring filter on notebook name")
     ap.add_argument("--no-execute", action="store_true")
+    ap.add_argument("--out", default=str(HERE), metavar="DIR",
+                    help="output directory (default: alongside this script; "
+                         "tests point it elsewhere so an authoring run can't "
+                         "clobber the committed executed notebooks)")
     args = ap.parse_args()
+    out_dir = Path(args.out)
+    out_dir.mkdir(parents=True, exist_ok=True)
 
     from nbclient import NotebookClient
 
@@ -221,7 +227,7 @@ def main():
                          f"{', '.join(cells())}")
     for name, (env_key, baseline, transport) in selected.items():
         nb = build(env_key, baseline, transport)
-        path = HERE / f"{name}.ipynb"
+        path = out_dir / f"{name}.ipynb"
         if not args.no_execute:
             t0 = time.time()
             print(f"== executing {name} ...", flush=True)
@@ -239,7 +245,7 @@ def main():
                 client.execute()
             print(f"   done in {time.time() - t0:.0f}s", flush=True)
         nbformat.write(nb, path)
-        print(f"   wrote {path.relative_to(HERE.parent.parent)}", flush=True)
+        print(f"   wrote {path}", flush=True)
 
 
 if __name__ == "__main__":

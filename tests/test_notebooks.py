@@ -1,0 +1,59 @@
+"""Harness contract for the example-notebook generator.
+
+The committed notebooks under ``examples/notebooks/`` are genuinely
+executed (their outputs are the evidence); re-executing them in CI is
+minutes of wall clock, so the suite guards the *authoring* contract:
+the generator still covers the reference's full 12-cell matrix
+(reference: examples/ tree — REINFORCE ± baseline × {cartpole,
+mountain_car, lunar_lander} × {zmq, grpc}), emits structurally valid
+notebooks, and keeps the load-bearing cells (warmup wait, drain before
+stats) that make the one-kernel topology correct.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import nbformat
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "examples" / "notebooks" / "make_notebooks.py"
+
+
+def test_generator_authors_full_matrix(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(SCRIPT), "--no-execute",
+         "--out", str(tmp_path / "nb")],
+        capture_output=True, text=True, timeout=120,
+        cwd=tmp_path, env={"PATH": "/usr/bin:/bin", "HOME": str(tmp_path),
+                           "PYTHONPATH": str(REPO)})
+    assert out.returncode == 0, out.stderr[-1500:]
+
+    written = sorted((tmp_path / "nb").glob("*.ipynb"))
+    names = {p.stem for p in written}
+    expected = {f"{env}_reinforce_{tag}_{tr}"
+                for env in ("cartpole", "mountaincar", "lunarlander")
+                for tag in ("baseline", "nobaseline")
+                for tr in ("zmq", "grpc")}
+    assert expected <= names, expected - names
+
+    for p in written:
+        nb = nbformat.read(p, as_version=4)
+        nbformat.validate(nb)
+        src = "\n".join(c.source for c in nb.cells if c.cell_type == "code")
+        # The cells that make one kernel hosting server+actor correct:
+        assert "wait_warmup" in src, p.name
+        assert "server.drain()" in src, p.name
+        assert "disable_server()" in src, p.name
+        # The explicit reference-style loop, not a helper call.
+        assert "request_for_action" in src and "flag_last_action" in src, p.name
+
+
+def test_generator_only_filter_rejects_nonsense(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(SCRIPT), "--no-execute", "--only", "nope-xyz"],
+        capture_output=True, text=True, timeout=60,
+        cwd=tmp_path, env={"PATH": "/usr/bin:/bin", "HOME": str(tmp_path),
+                           "PYTHONPATH": str(REPO)})
+    assert out.returncode != 0
+    assert "matches none" in (out.stderr + out.stdout)
