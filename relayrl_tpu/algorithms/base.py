@@ -123,6 +123,16 @@ class AlgorithmBase(abc.ABC):
         """
         return 0
 
+    def checkpoint_aux(self):
+        """Host-side arrays to persist alongside the train state (a pytree
+        of numpy arrays, or None). The off-policy family returns its
+        replay buffer here; on-policy has no host state worth carrying
+        (an epoch buffer refills within one epoch)."""
+        return None
+
+    def restore_aux(self, aux) -> None:
+        """Apply a previously saved :meth:`checkpoint_aux` payload."""
+
     def _warmup_is_collective(self) -> bool:
         """True when this algorithm's update is a multi-process collective
         (``enable_multihost`` over >1 jax processes) — warming up solo

@@ -234,6 +234,19 @@ class OffPolicyAlgorithm(AlgorithmBase):
             "done": np.zeros((b,), np.float32),
         }
 
+    def checkpoint_aux(self):
+        """Replay buffer contents (chronological) + counters: a resumed
+        off-policy learner keeps its experience instead of re-warming from
+        an empty ring (the reference loses everything but policy weights
+        on restart — SURVEY §5.4)."""
+        if len(self.buffer) == 0:
+            return None
+        return {"replay": self.buffer.state_arrays()}
+
+    def restore_aux(self, aux) -> None:
+        if aux and "replay" in aux:
+            self.buffer.load_state_arrays(aux["replay"])
+
     def warmup(self, should_continue=None) -> int:
         """Replay samples are always ``[batch_size]`` transitions — one
         compile covers every training batch this family draws."""

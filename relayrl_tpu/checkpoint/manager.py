@@ -33,8 +33,10 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, extra: dict | None = None,
-             wait: bool = False) -> None:
-        """Async save of the state pytree (+ JSON-able extras)."""
+             wait: bool = False, aux: Any = None) -> None:
+        """Async save of the state pytree (+ JSON-able extras; ``aux`` is
+        an optional host-array pytree — replay buffer contents — that
+        older checkpoints simply don't carry)."""
         import orbax.checkpoint as ocp
 
         args = {
@@ -42,6 +44,8 @@ class CheckpointManager:
             # always present so restore() can ask for it unconditionally
             "extra": ocp.args.JsonSave(extra if extra is not None else {}),
         }
+        if aux is not None:
+            args["aux"] = ocp.args.StandardSave(aux)
         self._mgr.save(step, args=ocp.args.Composite(**args))
         if wait:
             self._mgr.wait_until_finished()
@@ -49,23 +53,29 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, state_template: Any, step: int | None = None
-                ) -> tuple[Any, dict]:
-        """Restore (state, extra) at ``step`` (default latest)."""
+    def restore(self, state_template: Any, step: int | None = None,
+                load_aux: bool = True) -> tuple[Any, dict, Any]:
+        """Restore (state, extra, aux) at ``step`` (default latest); aux
+        is None for checkpoints that predate it (shapes are whatever was
+        saved — no template, the ring length varies between saves).
+        ``load_aux=False`` skips even reading the aux arrays — a
+        multi-process resume of a single-host checkpoint must not haul a
+        coordinator-only replay buffer onto every rank."""
         import orbax.checkpoint as ocp
 
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(state_template),
-                extra=ocp.args.JsonRestore(),
-            ),
-        )
+        items = {
+            "state": ocp.args.StandardRestore(state_template),
+            "extra": ocp.args.JsonRestore(),
+        }
+        has_aux = load_aux and "aux" in (self._mgr.item_metadata(step) or {})
+        if has_aux:
+            items["aux"] = ocp.args.StandardRestore()
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
         extra = dict(restored.get("extra") or {})
-        return restored["state"], extra
+        return restored["state"], extra, restored.get("aux")
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -87,7 +97,15 @@ def checkpoint_algorithm(algo, directory: str | None = None,
         "version": int(algo.version),
         "arch": algo.arch,
     }
-    mgr.save(int(algo.version), jax.device_get(algo.state), extra, wait=wait)
+    # aux (replay buffer) is single-host only: on a multi-process mesh the
+    # orbax save is collective and every rank must contribute an identical
+    # structure, but the buffer lives on the coordinator alone — multi-host
+    # resume refills the ring instead (docs/operations.md).
+    aux = None
+    if jax.process_count() == 1:
+        aux = algo.checkpoint_aux()
+    mgr.save(int(algo.version), jax.device_get(algo.state), extra, wait=wait,
+             aux=aux)
     return mgr
 
 
@@ -96,11 +114,18 @@ def restore_algorithm(algo, directory: str | None = None,
     """Restore a previously checkpointed algorithm in place."""
     directory = directory or osp.join(".", "checkpoints")
     mgr = CheckpointManager(directory)
-    state, extra = mgr.restore(jax.device_get(algo.state), step)
+    # Symmetric with the save-side gate: the replay buffer is a
+    # coordinator-only host structure, so a multi-process resume of a
+    # single-host checkpoint skips it (the ring refills) instead of
+    # loading it onto every rank.
+    state, extra, aux = mgr.restore(jax.device_get(algo.state), step,
+                                    load_aux=jax.process_count() == 1)
     if extra.get("arch") and json.dumps(extra["arch"], sort_keys=True) != \
             json.dumps(algo.arch, sort_keys=True):
         raise ValueError(
             f"checkpoint arch {extra.get('arch')} != algorithm arch {algo.arch}")
     algo.state = jax.device_put(state)
     algo.epoch = int(extra.get("epoch", 0))
+    if aux is not None:
+        algo.restore_aux(aux)
     mgr.close()

@@ -48,6 +48,7 @@ class StepReplayBuffer:
         self.ptr = 0
         self.size = 0
         self.total_steps = 0
+        self._seed = int(seed)
         self._rng = np.random.default_rng(seed)
 
     def __len__(self) -> int:
@@ -181,6 +182,44 @@ class StepReplayBuffer:
             self._put(obs, rec.act, rec.rew, obs2, done, mask2)
             stored += 1
         return stored
+
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        """Stored transitions in CHRONOLOGICAL order plus counters — the
+        checkpoint payload (SURVEY §5.4: the reference loses its buffer on
+        every restart; here off-policy resume keeps it). Only the filled
+        region is saved; when the ring has wrapped, rolling by ``ptr``
+        makes index 0 the oldest transition, so a restored buffer
+        overwrites oldest-first exactly like the original would have."""
+        s = self.size
+        if s == self.capacity and self.ptr:
+            order = np.r_[self.ptr:s, 0:self.ptr]
+        else:
+            order = np.arange(s)
+        return {
+            "obs": self.obs[order], "obs2": self.obs2[order],
+            "act": self.act[order], "mask2": self.mask2[order],
+            "rew": self.rew[order], "done": self.done[order],
+            "size": np.int64(s),
+            "total_steps": np.int64(self.total_steps),
+        }
+
+    def load_state_arrays(self, d) -> None:
+        """Inverse of :meth:`state_arrays`, tolerant of a capacity change:
+        a buffer smaller than the checkpoint keeps the most recent
+        transitions. The numpy sample RNG is reseeded deterministically
+        from (seed, total_steps) rather than checkpointed — jax RNG state
+        (inside the train state) restores exactly; the host-side sampler
+        only needs independence, not replay."""
+        n = int(d["size"])
+        keep = min(n, self.capacity)
+        sl = slice(n - keep, n)  # most recent when shrinking
+        for name in ("obs", "obs2", "act", "mask2", "rew", "done"):
+            getattr(self, name)[:keep] = np.asarray(d[name])[sl]
+        self.size = keep
+        self.ptr = keep % self.capacity
+        self.total_steps = int(d["total_steps"])
+        self._rng = np.random.default_rng(
+            (self._seed, self.total_steps))
 
     def sample(self, batch_size: int) -> dict[str, np.ndarray]:
         """Uniform sample of a fixed-size batch (with replacement)."""

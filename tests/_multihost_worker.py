@@ -120,7 +120,7 @@ from relayrl_tpu.checkpoint import CheckpointManager  # noqa: E402
 
 mgr = CheckpointManager(ckpt_dir)
 mgr.save(1, state, wait=True)
-restored, _ = mgr.restore(state)
+restored, _, _ = mgr.restore(state)
 for a, b in zip(jax.tree_util.tree_leaves(state),
                 jax.tree_util.tree_leaves(restored)):
     # Multi-host arrays are not fully addressable; compare the local shards.

@@ -39,7 +39,7 @@ class TestCheckpointManager:
         state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(4)}
         mgr = CheckpointManager(str(tmp_path / "ckpt"))
         mgr.save(4, state, extra={"note": "hi"}, wait=True)
-        restored, extra = mgr.restore(state)
+        restored, extra, _ = mgr.restore(state)
         np.testing.assert_array_equal(np.asarray(restored["w"]),
                                       np.arange(6.0).reshape(2, 3))
         assert extra["note"] == "hi"
@@ -53,7 +53,7 @@ class TestCheckpointManager:
         for s in (1, 2, 3):
             mgr.save(s, {"x": jnp.float32(s)}, wait=True)
         assert mgr.latest_step() == 3
-        restored, _ = mgr.restore({"x": jnp.float32(0)})
+        restored, _, _ = mgr.restore({"x": jnp.float32(0)})
         assert float(restored["x"]) == 3.0
         mgr.close()
 
@@ -88,6 +88,68 @@ class TestAlgorithmResume:
         # resumed algorithm keeps training (optimizer state intact)
         assert fresh.receive_trajectory(_episode(6, seed=3)) is True
         assert fresh.version == 3
+
+    def test_offpolicy_resume_keeps_replay_buffer(self, tmp_path, tmp_cwd):
+        """SURVEY §5.4: the reference loses everything but policy weights
+        on restart; here an off-policy resume keeps its experience —
+        contents, chronological overwrite order, and counters."""
+        def dqn(tag):
+            return build_algorithm(
+                "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+                batch_size=8, buf_size=64, update_after=10,
+                logger_kwargs={"output_dir": str(tmp_path / f"logs_{tag}")})
+
+        algo = dqn("a")
+        for s in range(5):
+            algo.receive_trajectory(_episode(6, seed=s))
+        assert len(algo.buffer) == 30
+        ckpt_dir = str(tmp_path / "ckpt_dqn")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True)
+
+        fresh = dqn("b")
+        assert len(fresh.buffer) == 0
+        restore_algorithm(fresh, ckpt_dir)
+        assert len(fresh.buffer) == 30
+        assert fresh.buffer.total_steps == algo.buffer.total_steps
+        want = algo.buffer.state_arrays()
+        got = fresh.buffer.state_arrays()
+        for k in ("obs", "act", "rew", "obs2", "mask2", "done"):
+            np.testing.assert_array_equal(np.asarray(want[k]),
+                                          np.asarray(got[k]))
+        # resumed learner trains from the restored experience
+        assert fresh.receive_trajectory(_episode(6, seed=99)) is True
+
+    def test_restore_tolerates_checkpoint_without_aux(self, tmp_path,
+                                                      tmp_cwd):
+        """On-policy checkpoints (and any pre-aux checkpoint) have no aux
+        entry; restore must not demand one."""
+        algo = _algo(tmp_path)
+        algo.receive_trajectory(_episode(6, seed=1))
+        ckpt_dir = str(tmp_path / "ckpt_noaux")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True)
+        fresh = _algo(tmp_path)
+        restore_algorithm(fresh, ckpt_dir)
+        assert fresh.version == algo.version
+
+    def test_ring_wrap_checkpoint_preserves_overwrite_order(self, tmp_path):
+        from relayrl_tpu.data.step_buffer import StepReplayBuffer
+
+        buf = StepReplayBuffer(obs_dim=2, act_dim=2, capacity=8, seed=0)
+        for i in range(11):  # wraps: holds transitions 3..10, ptr mid-ring
+            buf._put(np.full(2, i, np.float32), 1, float(i),
+                     np.full(2, i + 1, np.float32), 0.0, np.ones(2))
+        buf2 = StepReplayBuffer(obs_dim=2, act_dim=2, capacity=8, seed=0)
+        buf2.load_state_arrays(buf.state_arrays())
+        # chronological: oldest surviving transition is reward 3
+        assert buf2.rew[0] == 3.0 and buf2.size == 8
+        # next write overwrites the OLDEST (reward 3), like the original
+        buf2._put(np.zeros(2, np.float32), 1, 99.0,
+                  np.zeros(2, np.float32), 0.0, np.ones(2))
+        assert 3.0 not in buf2.rew and 99.0 in buf2.rew
+        # capacity shrink keeps the most recent
+        small = StepReplayBuffer(obs_dim=2, act_dim=2, capacity=4, seed=0)
+        small.load_state_arrays(buf.state_arrays())
+        assert small.size == 4 and set(small.rew) == {7.0, 8.0, 9.0, 10.0}
 
     def test_arch_mismatch_rejected(self, tmp_path, tmp_cwd):
         algo = _algo(tmp_path)

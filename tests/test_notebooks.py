@@ -51,8 +51,9 @@ def test_generator_authors_full_matrix(tmp_path):
 
 def test_generator_only_filter_rejects_nonsense(tmp_path):
     out = subprocess.run(
-        [sys.executable, str(SCRIPT), "--no-execute", "--only", "nope-xyz"],
-        capture_output=True, text=True, timeout=60,
+        [sys.executable, str(SCRIPT), "--no-execute", "--only", "nope-xyz",
+         "--out", str(tmp_path / "nb")],  # a regression must clobber tmp,
+        capture_output=True, text=True, timeout=60,  # never the committed set
         cwd=tmp_path, env={"PATH": "/usr/bin:/bin", "HOME": str(tmp_path),
                            "PYTHONPATH": str(REPO)})
     assert out.returncode != 0
