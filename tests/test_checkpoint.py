@@ -118,6 +118,9 @@ class TestAlgorithmResume:
                                           np.asarray(got[k]))
         # resumed learner trains from the restored experience
         assert fresh.receive_trajectory(_episode(6, seed=99)) is True
+        # the epsilon schedule reads buffer.total_steps, so exploration
+        # annealing resumes where it left off instead of restarting at 1.0
+        assert fresh.current_epsilon() < fresh.eps_start
 
     def test_restore_tolerates_checkpoint_without_aux(self, tmp_path,
                                                       tmp_cwd):
