@@ -14,7 +14,10 @@ import subprocess
 import sys
 from pathlib import Path
 
-import nbformat
+import pytest
+
+nbformat = pytest.importorskip(
+    "nbformat", reason="notebook authoring needs nbformat")
 
 REPO = Path(__file__).resolve().parent.parent
 SCRIPT = REPO / "examples" / "notebooks" / "make_notebooks.py"
