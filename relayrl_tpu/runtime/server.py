@@ -54,6 +54,7 @@ class TrainingServer:
         server_type: str = "zmq",
         start: bool = True,
         resume: bool = False,
+        handle_signals: bool = False,
         **addr_overrides,
     ):
         self.config = ConfigLoader(algorithm_name, config_path)
@@ -202,8 +203,64 @@ class TrainingServer:
             self._tb = TensorboardWriter.from_logger(
                 self.algorithm.logger, self.config.get_tb_params())
 
+        if handle_signals:
+            self._install_signal_handlers()
         if start:
             self.enable_server()
+
+    def _install_signal_handlers(self) -> None:
+        """Opt-in SIGTERM/SIGINT handling for long-lived deployments
+        (systemd stop, k8s pod eviction, ^C): write a final full-state
+        checkpoint, shut the planes down cleanly, then die by the SAME
+        signal so supervisors see an honest exit status. The reference
+        has no shutdown path at all beyond process death (SURVEY §5.3);
+        pairing this with ``resume=True`` on the next start makes a
+        restart lose nothing. Only possible on the main thread
+        (CPython restriction) — elsewhere this is a no-op with a note."""
+        import signal
+
+        def _handler(signum, frame):
+            # First thing: restore default disposition on BOTH signals, so
+            # a second ^C / a supervisor's follow-up SIGTERM kills
+            # immediately instead of re-entering a save in flight.
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, signal.SIG_DFL)
+            name = signal.Signals(signum).name
+            print(f"[TrainingServer] {name}: final checkpoint + clean "
+                  f"shutdown", flush=True)
+            try:
+                # Quiesce BEFORE snapshotting: joins the learner/staging
+                # threads so state/version/replay ring aren't mid-mutation
+                # under the save. Undelivered queue items are dropped —
+                # nothing the learner had trained on is lost.
+                self.disable_server()
+                if (self._checkpoint_dir and self.algorithm.version > 0
+                        and not self.distributed_info["multi_host"]):
+                    # Multi-host saves are collective and version-gated
+                    # (every rank must enter together); an eviction-time
+                    # solo save would deadlock the mesh — rely on the
+                    # periodic collective checkpoints there.
+                    from relayrl_tpu.checkpoint import checkpoint_algorithm
+
+                    try:
+                        checkpoint_algorithm(self.algorithm,
+                                             self._checkpoint_dir, wait=True)
+                    except Exception as e:
+                        # e.g. orbax step-already-exists when the periodic
+                        # save already wrote this version — same learned
+                        # state is on disk either way; say so and exit.
+                        print(f"[TrainingServer] final checkpoint skipped: "
+                              f"{e!r}", flush=True)
+            finally:
+                signal.raise_signal(signum)
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, _handler)
+        except ValueError:  # not the main thread
+            print("[TrainingServer] handle_signals requested off the main "
+                  "thread — skipped (install handlers in your main thread "
+                  "and call disable_server there instead)", flush=True)
 
     # -- transport callbacks (transport threads!) --
     def _on_trajectory(self, agent_id: str, payload: bytes) -> None:

@@ -1,0 +1,52 @@
+"""Subprocess body for the SIGTERM graceful-shutdown test: a live
+TrainingServer with handle_signals=True that has trained, idling on its
+main thread until the parent kills it."""
+
+import socket
+import sys
+
+import numpy as np
+
+from relayrl_tpu.runtime.server import TrainingServer
+from relayrl_tpu.types.action import ActionRecord
+
+
+def _port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _episode(n, seed):
+    rng = np.random.default_rng(seed)
+    return [ActionRecord(obs=rng.standard_normal(4).astype(np.float32),
+                         act=np.int64(rng.integers(2)), rew=1.0,
+                         done=(i == n - 1)) for i in range(n)]
+
+
+def main():
+    server = TrainingServer(
+        "DQN", obs_dim=4, act_dim=2, env_dir=".", server_type="zmq",
+        handle_signals=True,
+        hyperparams={"update_after": 10, "batch_size": 8,
+                     "buffer_size": 256,
+                     # periodic checkpointing effectively off: the final
+                     # signal-time save must be the only one
+                     "checkpoint_every_epochs": 10_000},
+        agent_listener_addr=f"tcp://127.0.0.1:{_port()}",
+        trajectory_addr=f"tcp://127.0.0.1:{_port()}",
+        model_pub_addr=f"tcp://127.0.0.1:{_port()}")
+    for k in range(6):
+        server.algorithm.receive_trajectory(_episode(6, k))
+    assert server.algorithm.version > 0
+    print(f"READY version={server.algorithm.version} "
+          f"buffer={len(server.algorithm.buffer)}", flush=True)
+    import time
+
+    time.sleep(300)  # interrupted by the parent's SIGTERM
+    print("UNREACHABLE", flush=True)
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()
