@@ -92,6 +92,17 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         hyperparams=hp,
         **addrs,
     )
+    # Steady-state SLO bench: exclude the one-time learner warmup from the
+    # measured window (deployments pay it once at bring-up; the fleet
+    # hasn't handshaken yet at that point anyway). NOTE the element cap
+    # (AlgorithmBase.warmup_max_elements) means buckets past 256 steps
+    # aren't pre-compiled at traj_per_epoch=64 — fine for the default
+    # 25-step episodes (bucket 64), but episode_len > 256 would compile
+    # in-window; the warmed flag in the result records any timeout.
+    warmed = server.wait_warmup(timeout=120)
+    if not warmed:
+        print("[bench] WARNING: warmup still running at window start -- "
+              "steady-state numbers are contaminated", file=sys.stderr)
     # Publisher timestamps in monotonic_ns: CLOCK_MONOTONIC is system-wide
     # on Linux, so these pair against the receiving transport layer's
     # stamps in the worker processes (native C++ ledger / SUB-thread
@@ -179,6 +190,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                    "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
                    "host_cores": os.cpu_count()},
+        "warmup_excluded": warmed,
         "agents_completed": len(agents),
         "agents_crashed": sum(1 for a in agents if a.get("crashed")),
         "env_steps_total": total_steps,
@@ -285,6 +297,14 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
                      "with_vf_baseline": True},
         **addrs,
     )
+    # Ingest-ceiling bench: the clock starts at the first push; let the
+    # one-time warmup finish first so drain() measures ingest+decode, not
+    # bring-up compile (learner-off configs skip warmup via the element
+    # cap, so this returns immediately there).
+    warmed = server.wait_warmup(timeout=120)
+    if not warmed:
+        print("[bench] WARNING: warmup unfinished before blast",
+              file=sys.stderr)
     rng = np.random.default_rng(0)
     records = [
         ActionRecord(obs=rng.standard_normal(obs_dim).astype(np.float32),
@@ -410,6 +430,7 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
                    "payload_bytes": len(payload), "pushers": n_pushers,
                    "learner": "on" if profile else "off",
                    "host_cores": os.cpu_count()},
+        "warmup_excluded": warmed,
         "drained": drained,
         "send_s": round(send_s, 2),
         "server_stats": stats,
@@ -446,6 +467,9 @@ def run_churn(n_actors: int = 16, agents_per_proc: int = 4,
         "IMPALA", obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
         hyperparams={"traj_per_epoch": 16, "hidden_sizes": [32, 32]},
         server_type="native", bind_addr=f"127.0.0.1:{port}")
+    if not server.wait_warmup(timeout=120):  # churn SLOs are steady-state
+        print("[bench] WARNING: warmup unfinished before churn window",
+              file=sys.stderr)
     # Partitioned (not crashed) peers go silent without a TCP close; the
     # idle reaper covers them. Crashes are reaped instantly via the
     # kernel-closed connection. 60s: comfortably above the agent-side

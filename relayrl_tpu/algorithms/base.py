@@ -61,6 +61,15 @@ def anchor_path(path: str, env_dir: str | None) -> str:
 class AlgorithmBase(abc.ABC):
     """Host-side orchestration wrapper around a pure jitted learner step."""
 
+    # Warmup executes one real (discarded) update per shape, so its cost
+    # scales with B*T (times vf iters for the actor-critic families) — a
+    # [2001, 1000] placeholder epoch measured 4+ minutes on a 1-core host.
+    # Shapes above this B*T bound are skipped and compile on first use
+    # instead (the bound covers every default config: traj_per_epoch=8 x
+    # the largest default bucket 1000 = 8000; override per-instance when a
+    # deployment with bigger epochs wants full pre-compilation anyway).
+    warmup_max_elements = 32768
+
     # Trajectories rejected by the ingest finite-value guard
     # (types/columnar.py trajectory_is_finite); class default so the
     # first increment materializes the instance counter.

@@ -252,6 +252,8 @@ class OffPolicyAlgorithm(AlgorithmBase):
         compile covers every training batch this family draws."""
         if self._warmup_is_collective():
             return 0
+        if self.batch_size > self.warmup_max_elements:
+            return 0
         if should_continue is not None and not should_continue():
             return 0
         self._warmup_update(self.mh_zero_batch(self.batch_size, 0))

@@ -170,6 +170,8 @@ class OnPolicyAlgorithm(AlgorithmBase):
             return 0
         compiled = 0
         for t in self.buffer.buckets:
+            if self.traj_per_epoch * int(t) > self.warmup_max_elements:
+                break  # buckets ascend: everything further is bigger
             if should_continue is not None and not should_continue():
                 break
             self._warmup_update(

@@ -58,6 +58,23 @@ def test_warmup_covers_every_bucket_so_real_update_is_cache_hit(tmp_cwd):
         "real updates recompiled shapes warmup claimed to cover"
 
 
+def test_warmup_skips_shapes_above_the_element_cap(tmp_cwd):
+    """A [2001, 1000] placeholder measured 4+ minutes on a 1-core host
+    (the ingest-blast bench's learner-off config) — shapes above the B*T
+    bound must compile on demand instead of stalling bring-up."""
+    alg = build_algorithm("REINFORCE", obs_dim=3, act_dim=2, env_dir=".",
+                          traj_per_epoch=64,
+                          hyperparams={"with_vf_baseline": False})
+    n = alg.warmup()
+    capped = [t for t in alg.buffer.buckets
+              if 64 * t <= alg.warmup_max_elements]
+    assert n == len(capped) < len(alg.buffer.buckets)
+    blast_like = build_algorithm(
+        "REINFORCE", obs_dim=3, act_dim=2, env_dir=".",
+        traj_per_epoch=2001, hyperparams={"with_vf_baseline": False})
+    assert blast_like.warmup() == 0
+
+
 def test_warmup_stops_early_when_work_is_pending(tmp_cwd):
     alg = build_algorithm("REINFORCE", obs_dim=3, act_dim=2, env_dir=".",
                           hyperparams={"with_vf_baseline": False})
