@@ -175,13 +175,22 @@ class AlgorithmBase(abc.ABC):
         ``self.state`` buffers, version, metrics, and logger are untouched.
         Non-array state leaves pass through un-copied to keep the call
         signature identical to the real update's (a dtype-changed leaf
-        would compile a cache entry the real call never hits)."""
+        would compile a cache entry the real call never hits).
+
+        Ordering: warmup must finish before any OTHER thread drives
+        ``train_on_batch`` — the real update donates its state argument
+        (``donate_argnums=0``), so a concurrent update can delete the
+        live buffers mid-copy here and this raises (the server's own
+        learner thread is already ordered warmup-then-train; out-of-band
+        callers should ``server.wait_warmup()`` first — a raise here is
+        caught as non-fatal and warmup is merely skipped)."""
         import jax
         import jax.numpy as jnp
 
+        live = self.state  # one read: a swap mid-warmup can't mix trees
         state_copy = jax.tree_util.tree_map(
             lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
-            self.state)
+            live)
         _, metrics = self._update(state_copy, self._to_device(host_batch))
         jax.block_until_ready(metrics)
 

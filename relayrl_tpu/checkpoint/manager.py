@@ -21,7 +21,10 @@ import jax
 class CheckpointManager:
     """Thin orbax wrapper: numbered step directories + latest-step resume."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    DEFAULT_MAX_TO_KEEP = 3
+
+    def __init__(self, directory: str,
+                 max_to_keep: int = DEFAULT_MAX_TO_KEEP):
         import orbax.checkpoint as ocp
 
         self.directory = osp.abspath(directory)
@@ -33,10 +36,19 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, extra: dict | None = None,
-             wait: bool = False, aux: Any = None) -> None:
+             wait: bool = False, aux: Any = None,
+             overwrite: bool = False) -> None:
         """Async save of the state pytree (+ JSON-able extras; ``aux`` is
         an optional host-array pytree — replay buffer contents — that
-        older checkpoints simply don't carry)."""
+        older checkpoints simply don't carry). ``overwrite=True`` makes a
+        same-step collision land at the next free step number instead of
+        being silently skipped (orbax no-ops a repeat save; its
+        ``force=True`` does not overwrite) — the signal path uses it so a
+        final save that collides with an aux-less periodic save at the
+        same version still lands WITH the replay snapshot. Bumping (not
+        delete-then-rewrite) means an interrupted final save can never
+        destroy the existing checkpoint; step numbers are labels — the
+        true version is inside state/extra."""
         import orbax.checkpoint as ocp
 
         args = {
@@ -46,6 +58,10 @@ class CheckpointManager:
         }
         if aux is not None:
             args["aux"] = ocp.args.StandardSave(aux)
+        if overwrite:
+            existing = self._mgr.all_steps()
+            if step in existing:
+                step = max(existing) + 1
         self._mgr.save(step, args=ocp.args.Composite(**args))
         if wait:
             self._mgr.wait_until_finished()
@@ -75,7 +91,28 @@ class CheckpointManager:
             items["aux"] = ocp.args.StandardRestore()
         restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
         extra = dict(restored.get("extra") or {})
-        return restored["state"], extra, restored.get("aux")
+        aux = restored.get("aux")
+        if load_aux and aux is None:
+            aux = self._restore_aux_fallback(step)
+        return restored["state"], extra, aux
+
+    def _restore_aux_fallback(self, newer_than: int) -> Any:
+        """Newest retained step OLDER than ``newer_than`` that carries an
+        aux snapshot. With ``checkpoint_aux_every > 1`` the latest step
+        usually has no replay snapshot — a crash-resume should still get
+        the newest retained experience rather than an empty ring (replay
+        data a few versions stale is valid off-policy experience; the
+        params/optimizer still come from the latest step)."""
+        import orbax.checkpoint as ocp
+
+        for s in sorted(self._mgr.all_steps(), reverse=True):
+            if s >= newer_than:
+                continue
+            if "aux" in (self._mgr.item_metadata(s) or {}):
+                restored = self._mgr.restore(s, args=ocp.args.Composite(
+                    aux=ocp.args.StandardRestore()))
+                return restored.get("aux")
+        return None
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -85,12 +122,26 @@ class CheckpointManager:
 
 
 def checkpoint_algorithm(algo, directory: str | None = None,
-                         wait: bool = False) -> CheckpointManager:
-    """Save an algorithm's full state (convenience used by the server)."""
+                         wait: bool = False,
+                         include_aux: bool = True,
+                         overwrite: bool = False,
+                         max_to_keep: int | None = None) -> CheckpointManager:
+    """Save an algorithm's full state (convenience used by the server).
+
+    ``include_aux=False`` skips the replay-buffer snapshot: for a large
+    ring (say 1M transitions) ``state_arrays()`` is a synchronous
+    multi-hundred-MB copy on the calling (learner) thread before orbax
+    even starts writing, so the server throttles aux to every Nth
+    periodic save (``learner.checkpoint_aux_every``) while final/signal
+    saves always carry it. Callers using an aux cadence must pass
+    ``max_to_keep >= cadence`` so retention always holds at least one
+    aux-carrying step for crash-resume (the server does)."""
     directory = directory or osp.join(".", "checkpoints")
     mgr = getattr(algo, "_ckpt_mgr", None)
     if mgr is None or mgr.directory != osp.abspath(directory):
-        mgr = CheckpointManager(directory)
+        mgr = CheckpointManager(
+            directory,
+            max_to_keep=max_to_keep or CheckpointManager.DEFAULT_MAX_TO_KEEP)
         algo._ckpt_mgr = mgr
     extra = {
         "epoch": int(getattr(algo, "epoch", 0)),
@@ -102,10 +153,10 @@ def checkpoint_algorithm(algo, directory: str | None = None,
     # structure, but the buffer lives on the coordinator alone — multi-host
     # resume refills the ring instead (docs/operations.md).
     aux = None
-    if jax.process_count() == 1:
+    if include_aux and jax.process_count() == 1:
         aux = algo.checkpoint_aux()
     mgr.save(int(algo.version), jax.device_get(algo.state), extra, wait=wait,
-             aux=aux)
+             aux=aux, overwrite=overwrite)
     return mgr
 
 

@@ -172,6 +172,11 @@ DEFAULT_CONFIG: dict = {
         "precision": "float32",
         "checkpoint_dir": "checkpoints",
         "checkpoint_every_epochs": 10,
+        # Replay-buffer snapshot cadence (off-policy): the ring copy is a
+        # synchronous host memcpy on the learner thread, ~buffer_size ×
+        # transition_bytes per save — raise this for big buffers so only
+        # every Nth periodic checkpoint carries experience.
+        "checkpoint_aux_every": 1,
         # multi-host learner bring-up (jax.distributed); single-process when
         # coordinator is null. Env overrides: RELAYRL_COORDINATOR,
         # RELAYRL_NUM_PROCESSES. The per-host rank is deliberately NOT a

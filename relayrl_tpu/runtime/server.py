@@ -109,6 +109,20 @@ class TrainingServer:
             self._checkpoint_dir = anchor_path(self._checkpoint_dir, env_dir)
         self._checkpoint_every = max(
             1, int(learner_cfg.get("checkpoint_every_epochs", 10)))
+        # Replay-buffer (aux) cadence: snapshotting the ring is a
+        # synchronous host copy on the learner thread, so large buffers
+        # can throttle it to every Nth periodic save. Final/signal saves
+        # always include aux regardless. Retention grows with the
+        # cadence (max_to_keep >= cadence) so a crash-resume always finds
+        # at least one retained aux-carrying step — the aux-less step
+        # dirs are cheap (params + opt state) next to the ring itself.
+        from relayrl_tpu.checkpoint import CheckpointManager
+
+        self._aux_every = max(
+            1, int(learner_cfg.get("checkpoint_aux_every", 1)))
+        self._ckpt_keep = max(CheckpointManager.DEFAULT_MAX_TO_KEEP,
+                              self._aux_every)
+        self._ckpt_saves = 0
 
         if resume and self._checkpoint_dir:
             # Multi-host: EVERY rank restores the same full state from the
@@ -233,7 +247,15 @@ class TrainingServer:
                 # threads so state/version/replay ring aren't mid-mutation
                 # under the save. Undelivered queue items are dropped —
                 # nothing the learner had trained on is lost.
-                self.disable_server()
+                # Multi-host: peers may be mid-collective and only THIS
+                # rank got the signal — an unbounded join can outlive the
+                # supervisor's grace period so the re-raise below never
+                # runs and the pod is SIGKILLed with sockets still open.
+                # Bound the quiesce; a timed-out thread dies with the
+                # process (the final save is skipped on multi-host anyway).
+                grace = (10.0 if self.distributed_info["multi_host"]
+                         else None)
+                self.disable_server(join_timeout=grace)
                 if (self._checkpoint_dir and self.algorithm.version > 0
                         and not self.distributed_info["multi_host"]):
                     # Multi-host saves are collective and version-gated
@@ -243,12 +265,15 @@ class TrainingServer:
                     from relayrl_tpu.checkpoint import checkpoint_algorithm
 
                     try:
+                        # overwrite: a periodic save may already sit at
+                        # this version WITHOUT the replay snapshot (aux
+                        # cadence) — the final save must land with it, so
+                        # a same-step collision bumps to a fresh step
+                        # instead of being skipped (never deletes).
                         checkpoint_algorithm(self.algorithm,
-                                             self._checkpoint_dir, wait=True)
+                                             self._checkpoint_dir, wait=True,
+                                             overwrite=True)
                     except Exception as e:
-                        # e.g. orbax step-already-exists when the periodic
-                        # save already wrote this version — same learned
-                        # state is on disk either way; say so and exit.
                         print(f"[TrainingServer] final checkpoint skipped: "
                               f"{e!r}", flush=True)
             finally:
@@ -479,13 +504,7 @@ class TrainingServer:
             # coordination.
             if (self._checkpoint_dir
                     and bundle.version % self._checkpoint_every == 0):
-                try:
-                    from relayrl_tpu.checkpoint import checkpoint_algorithm
-
-                    checkpoint_algorithm(self.algorithm, self._checkpoint_dir)
-                except Exception as e:
-                    print(f"[TrainingServer] checkpoint failed: {e!r}",
-                          flush=True)
+                self._periodic_checkpoint()
             self._mh_busy = False
 
     # -- learner loop --
@@ -616,11 +635,34 @@ class TrainingServer:
         if self._checkpoint_dir and bundle.version % self._checkpoint_every == 0:
             # Full-state checkpoint (params + optimizer + RNG + epoch);
             # async orbax save — the learner loop is not blocked.
-            try:
-                from relayrl_tpu.checkpoint import checkpoint_algorithm
+            self._periodic_checkpoint()
 
-                checkpoint_algorithm(self.algorithm, self._checkpoint_dir)
-            except Exception as e:
+    def _periodic_checkpoint(self) -> None:
+        """One periodic save, with the replay-buffer (aux) snapshot
+        throttled to every ``checkpoint_aux_every``-th save — the ring
+        copy is synchronous on this (learner) thread, so large buffers
+        pay it on a cadence instead of every save."""
+        try:
+            from relayrl_tpu.checkpoint import checkpoint_algorithm
+
+            include_aux = self._ckpt_saves % self._aux_every == 0
+            checkpoint_algorithm(self.algorithm, self._checkpoint_dir,
+                                 include_aux=include_aux,
+                                 max_to_keep=self._ckpt_keep)
+            # Count after submit so a SYNCHRONOUS failure (same-step
+            # collision, bad tree) doesn't consume the aux slot. Saves
+            # are async, so a deferred write failure surfaces at the
+            # NEXT call and that slot is still lost — best effort only.
+            self._ckpt_saves += 1
+        except Exception as e:
+            # A step collision happens after a signal-path final save
+            # bumped past this version (see manager.save overwrite) —
+            # benign, the state is already on disk at the bumped step.
+            if type(e).__name__ == "StepAlreadyExistsError":
+                print(f"[TrainingServer] checkpoint step exists, skipped "
+                      f"(post-resume overlap with a bumped final save)",
+                      flush=True)
+            else:
                 print(f"[TrainingServer] checkpoint failed: {e!r}", flush=True)
 
     # -- lifecycle (ref: training_zmq.rs:322-465 / o3_training_server.rs:153-272) --
@@ -660,7 +702,11 @@ class TrainingServer:
             return False
         return self._warmup_done.wait(timeout)
 
-    def disable_server(self) -> None:
+    def disable_server(self, join_timeout: float | None = None) -> None:
+        """``join_timeout`` overrides the per-thread join bounds — the
+        signal path passes a short grace on multi-host so a peer stuck
+        mid-collective can't hold this rank past its supervisor's
+        termination window."""
         if not self.active:
             return
         self._stop.set()
@@ -669,23 +715,38 @@ class TrainingServer:
         # (Multi-host: the coordinator's learner thread broadcasts STOP on
         # its way out, releasing every non-coordinator's loop — shut the
         # fleet down together or coordinator-last.)
+        # join_timeout is ONE deadline across both joins (the signal path
+        # sizes it to the supervisor grace window — two full grants would
+        # double it), not a per-thread grant.
+        deadline = (None if join_timeout is None
+                    else time.monotonic() + join_timeout)
         if self._staging_thread is not None:
-            self._staging_thread.join(timeout=30)
+            self._staging_thread.join(
+                timeout=30 if deadline is None
+                else max(0.0, deadline - time.monotonic()))
             self._staging_thread = None
         if self._learner_thread is not None:
             # Multi-host: the thread may be mid-collective (a step can
             # include a fresh XLA compile) — give it long enough to reach
             # the STOP broadcast; killing the transport under a live
             # publish would be worse than waiting.
+            default = 600 if self.distributed_info["multi_host"] else 30
             self._learner_thread.join(
-                timeout=600 if self.distributed_info["multi_host"] else 30)
+                timeout=default if deadline is None
+                else max(0.0, deadline - time.monotonic()))
             self._learner_thread = None
         if self.transport is not None:
             self.transport.stop()
         # Drain any in-flight async orbax save — the most recent checkpoint
         # is exactly the one a subsequent resume needs.
         mgr = getattr(self.algorithm, "_ckpt_mgr", None)
-        if mgr is not None:
+        if mgr is not None and join_timeout is None:
+            # Drain in-flight async saves — but NOT on the bounded
+            # (signal/emergency) path: a multi-host collective save waits
+            # on a cross-process commit barrier un-signaled peers never
+            # complete, and an unbounded wait here would defeat the
+            # bounded joins above (the process is about to die by signal;
+            # single-host final saves use wait=True themselves).
             try:
                 mgr.wait()
             except Exception as e:

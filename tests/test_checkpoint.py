@@ -122,6 +122,80 @@ class TestAlgorithmResume:
         # annealing resumes where it left off instead of restarting at 1.0
         assert fresh.current_epsilon() < fresh.eps_start
 
+    def test_include_aux_false_skips_replay_snapshot(self, tmp_path,
+                                                     tmp_cwd):
+        """The aux-cadence knob: an ``include_aux=False`` save writes no
+        replay snapshot (the ring copy is a synchronous learner-thread
+        cost), and a resume from it simply refills — it must not fail."""
+        algo = build_algorithm(
+            "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+            batch_size=8, buf_size=64, update_after=10,
+            logger_kwargs={"output_dir": str(tmp_path / "logs_na")})
+        for s in range(3):
+            algo.receive_trajectory(_episode(6, seed=s))
+        assert len(algo.buffer) > 0
+        ckpt_dir = str(tmp_path / "ckpt_dqn_noaux")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True, include_aux=False)
+        fresh = build_algorithm(
+            "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+            batch_size=8, buf_size=64, update_after=10,
+            logger_kwargs={"output_dir": str(tmp_path / "logs_nb")})
+        restore_algorithm(fresh, ckpt_dir)
+        assert fresh.version == algo.version
+        assert len(fresh.buffer) == 0  # no aux on disk: ring refills
+
+    def test_final_save_overwrites_auxless_collision(self, tmp_path,
+                                                     tmp_cwd):
+        """Signal-path scenario: a periodic no-aux save already sits at
+        this version; the final save (overwrite=True) must still land
+        WITH the replay snapshot — it bumps to a fresh step rather than
+        being silently skipped (and never deletes the existing save, so
+        an interrupted final save can't destroy the newest checkpoint)."""
+        algo = build_algorithm(
+            "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+            batch_size=8, buf_size=64, update_after=10,
+            logger_kwargs={"output_dir": str(tmp_path / "logs_ow")})
+        for s in range(3):
+            algo.receive_trajectory(_episode(6, seed=s))
+        ckpt_dir = str(tmp_path / "ckpt_ow")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True, include_aux=False)
+        # same version, now with aux — collides, must overwrite
+        checkpoint_algorithm(algo, ckpt_dir, wait=True, include_aux=True,
+                             overwrite=True)
+        fresh = build_algorithm(
+            "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+            batch_size=8, buf_size=64, update_after=10,
+            logger_kwargs={"output_dir": str(tmp_path / "logs_ow2")})
+        restore_algorithm(fresh, ckpt_dir)
+        assert fresh.version == algo.version
+        assert len(fresh.buffer) == len(algo.buffer)  # aux landed
+
+    def test_restore_falls_back_to_newest_retained_aux(self, tmp_path,
+                                                       tmp_cwd):
+        """checkpoint_aux_every > 1 crash-resume: the latest step has no
+        replay snapshot, but an older retained step does — resume should
+        use it (stale-but-valid off-policy experience) rather than refill
+        an empty ring. Params still come from the latest step."""
+        algo = build_algorithm(
+            "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+            batch_size=8, buf_size=64, update_after=10,
+            logger_kwargs={"output_dir": str(tmp_path / "logs_fb")})
+        for s in range(3):
+            algo.receive_trajectory(_episode(6, seed=s))
+        ckpt_dir = str(tmp_path / "ckpt_fb")
+        checkpoint_algorithm(algo, ckpt_dir, wait=True, include_aux=True)
+        aux_version, aux_len = algo.version, len(algo.buffer)
+        algo.receive_trajectory(_episode(6, seed=50))
+        checkpoint_algorithm(algo, ckpt_dir, wait=True, include_aux=False)
+        assert algo.version > aux_version
+        fresh = build_algorithm(
+            "DQN", obs_dim=4, act_dim=2, hidden_sizes=[16],
+            batch_size=8, buf_size=64, update_after=10,
+            logger_kwargs={"output_dir": str(tmp_path / "logs_fb2")})
+        restore_algorithm(fresh, ckpt_dir)
+        assert fresh.version == algo.version  # state from latest step
+        assert len(fresh.buffer) == aux_len  # experience from older step
+
     def test_restore_tolerates_checkpoint_without_aux(self, tmp_path,
                                                       tmp_cwd):
         """On-policy checkpoints (and any pre-aux checkpoint) have no aux

@@ -141,6 +141,75 @@ class TestAtariPipeline:
                 break
         assert total == 3.0  # tracked every drop
 
+    def test_gymnasium_branch_of_make_atari(self):
+        """The real-ALE branch of ``make_atari`` (any non-"synthetic" id)
+        goes through ``gymnasium.make(env_id, frameskip=1)``. ale_py isn't
+        in the image, so register a fake raw-pixel env with the Gymnasium
+        API — including accepting ALE's ``frameskip`` ctor kwarg — and
+        drive the identical wrapper pipeline through it: frame-skip owns
+        k raw steps, max-pool flicker removal over the last two frames,
+        grayscale + resize + stack, reward summation, early termination
+        mid-skip."""
+        import gymnasium
+        from gymnasium.envs.registration import register, registry
+
+        class FakeALE(gymnasium.Env):
+            """Raw 50x40 RGB env that flickers: the ball sprite renders
+            only on ODD raw frames (classic ALE sprite flicker — what
+            max-pool exists to fix)."""
+
+            def __init__(self, frameskip=4, render_mode=None):
+                assert frameskip == 1, "wrapper must disable ALE frameskip"
+                self.action_space = gymnasium.spaces.Discrete(3)
+                self.observation_space = gymnasium.spaces.Box(
+                    0, 255, (50, 40, 3), np.uint8)
+                self._t = 0
+
+            def _frame(self):
+                f = np.zeros((50, 40, 3), np.uint8)
+                # Flicker on ODD raw frames: with frame_skip=4 the last
+                # raw frame of a wrapper step (t=4) is blank, so the
+                # sprite reaches the stack ONLY via max-pool with t=3 —
+                # deleting the pooling breaks the assertion below.
+                if self._t % 2 == 1:
+                    f[10:14, 10:14] = 255
+                return f
+
+            def reset(self, seed=None, options=None):
+                super().reset(seed=seed)
+                self._t = 0
+                return self._frame(), {}
+
+            def step(self, action):
+                self._t += 1
+                terminated = self._t >= 10
+                return self._frame(), 1.0, terminated, False, {}
+
+        if "FakeALE-v0" not in registry:
+            register(id="FakeALE-v0", entry_point=FakeALE,
+                     disable_env_checker=True)
+
+        from relayrl_tpu.envs import make_atari
+
+        env = make_atari("FakeALE-v0", frame_size=16, frame_stack=4,
+                         frame_skip=4)
+        obs, _ = env.reset(seed=0)
+        assert obs.shape == (16 * 16 * 4,) and obs.dtype == np.float32
+        # One wrapper step = 4 raw steps, rewards summed.
+        obs, rew, term, trunc, _ = env.step(0)
+        assert rew == 4.0 and not term
+        # Max-pool: raw frame 4 (last, flicker-OFF) is blank — the sprite
+        # is only present via pooling with raw frame 3 (flicker-ON).
+        newest = obs.reshape(16, 16, 4)[:, :, -1]
+        assert newest.max() > 0.5  # sprite present ONLY via max-pool
+        # Termination mid-skip ends the wrapper step early: raw steps
+        # 5..8 would be the next step, 9-10 the one after (terminates
+        # at raw t=10, i.e. on the 2nd raw step of the 3rd wrapper step).
+        _, rew, term, *_ = env.step(0)
+        assert rew == 4.0 and not term
+        _, rew, term, *_ = env.step(0)
+        assert term and rew == 2.0  # only 2 raw steps ran
+
     def test_cnn_policy_consumes_pipeline_obs(self):
         import jax
 
