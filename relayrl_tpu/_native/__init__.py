@@ -1,0 +1,14 @@
+"""Container package for the bundled native library.
+
+Wheel builds place ``librelayrl_native.so`` here (see setup.py); source
+checkouts use ``native/librelayrl_native.so`` built by ``make -C
+native``. ``transport.native_backend._find_library`` checks both."""
+
+import os
+
+
+def bundled_library_path() -> str | None:
+    """Path of the wheel-bundled .so, or None in a source checkout."""
+    cand = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "librelayrl_native.so")
+    return cand if os.path.isfile(cand) else None

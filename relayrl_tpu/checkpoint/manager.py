@@ -28,6 +28,7 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         self.directory = osp.abspath(directory)
+        self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -45,7 +46,11 @@ class CheckpointManager:
         being silently skipped (orbax no-ops a repeat save; its
         ``force=True`` does not overwrite) — the signal path uses it so a
         final save that collides with an aux-less periodic save at the
-        same version still lands WITH the replay snapshot. Bumping (not
+        same version still lands WITH the replay snapshot. (Collision
+        behavior differs by manager instance: the instance that made the
+        earlier save silently skips the repeat — its should_save gate —
+        while a FRESH instance raises StepAlreadyExistsError; overwrite
+        handles both by checking all_steps up front.) Bumping (not
         delete-then-rewrite) means an interrupted final save can never
         destroy the existing checkpoint; step numbers are labels — the
         true version is inside state/extra."""
@@ -137,11 +142,17 @@ def checkpoint_algorithm(algo, directory: str | None = None,
     ``max_to_keep >= cadence`` so retention always holds at least one
     aux-carrying step for crash-resume (the server does)."""
     directory = directory or osp.join(".", "checkpoints")
+    want_keep = max_to_keep or CheckpointManager.DEFAULT_MAX_TO_KEEP
     mgr = getattr(algo, "_ckpt_mgr", None)
-    if mgr is None or mgr.directory != osp.abspath(directory):
-        mgr = CheckpointManager(
-            directory,
-            max_to_keep=max_to_keep or CheckpointManager.DEFAULT_MAX_TO_KEEP)
+    # Recreate the cached manager when the caller needs MORE retention —
+    # reusing a keep-3 manager under an aux cadence of 10 would
+    # garbage-collect every aux-carrying step and void the crash-resume
+    # guarantee the cadence relies on.
+    if (mgr is None or mgr.directory != osp.abspath(directory)
+            or mgr.max_to_keep < want_keep):
+        if mgr is not None and mgr.directory == osp.abspath(directory):
+            mgr.close()
+        mgr = CheckpointManager(directory, max_to_keep=want_keep)
         algo._ckpt_mgr = mgr
     extra = {
         "epoch": int(getattr(algo, "epoch", 0)),

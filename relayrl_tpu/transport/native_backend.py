@@ -15,6 +15,19 @@ _LIB_NAMES = ("librelayrl_native.so",)
 
 
 def _find_library() -> str | None:
+    # Wheel install: the .so ships inside the package (setup.py builds
+    # it into relayrl_tpu/_native/ — reference parity with its
+    # maturin-bundled native artifact). Checked first so an installed
+    # user never silently downgrades; source checkouts fall through to
+    # the make -C native output.
+    try:
+        from relayrl_tpu._native import bundled_library_path
+
+        bundled = bundled_library_path()
+        if bundled is not None:
+            return bundled
+    except ImportError:
+        pass
     here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     for name in _LIB_NAMES:
         for cand in (os.path.join(here, "native", name),

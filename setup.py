@@ -1,0 +1,82 @@
+"""Build the native C++ plane INTO the wheel.
+
+The reference bundles its native artifact into the wheel and patches
+rpaths so `pip install` delivers the full system (reference:
+scripts/distribution/maturin-build-release.sh; publish-pypi.yml:9-14).
+Parity here: `native/*.cc` compiles to a ctypes shared library shipped
+at `relayrl_tpu/_native/librelayrl_native.so` inside the wheel, so an
+installed user gets the native transport + columnar decode without a
+toolchain. Because the library is pure ctypes (no CPython ABI), the
+wheel is tagged ``py3-none-<platform>`` — one wheel covers every
+Python version on a platform.
+
+The extension is ``optional``: building from sdist on a host without a
+C++ toolchain still installs — the runtime then falls back to
+ZMQ/grpcio transports and Python decode (transport/native_backend.py).
+"""
+
+import os
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+try:  # setuptools >= 70 vendors bdist_wheel; older needs the wheel pkg
+    from setuptools.command.bdist_wheel import bdist_wheel
+except ImportError:  # pragma: no cover
+    from wheel.bdist_wheel import bdist_wheel
+
+
+class CTypesExtension(Extension):
+    """A shared library loaded via ctypes — not a Python extension."""
+
+
+class build_ctypes_ext(build_ext):
+    def build_extension(self, ext):
+        if not isinstance(ext, CTypesExtension):
+            return super().build_extension(ext)
+        objects = self.compiler.compile(
+            ext.sources,
+            output_dir=self.build_temp,
+            include_dirs=ext.include_dirs,
+            extra_postargs=["-O2", "-std=c++17", "-fPIC", "-Wall",
+                            "-pthread"],
+        )
+        out = self.get_ext_fullpath(ext.name)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        # distutils links C++ objects with the C driver — name libstdc++
+        # explicitly or the .so ships with unresolved ABI symbols.
+        self.compiler.link_shared_object(
+            objects, out, libraries=["stdc++"],
+            extra_postargs=["-pthread"])
+
+    def get_ext_filename(self, ext_name):
+        # ctypes library: fixed soname, no Python ABI suffix —
+        # relayrl_tpu._native.relayrl_native -> _native/librelayrl_native.so
+        parts = ext_name.split(".")
+        parts[-1] = f"lib{parts[-1]}.so"
+        return os.path.join(*parts)
+
+
+class bdist_wheel_ctypes(bdist_wheel):
+    def get_tag(self):
+        # No CPython ABI dependence: keep the platform tag (the .so is
+        # native) but claim every Python 3.
+        _, _, plat = super().get_tag()
+        return "py3", "none", plat
+
+
+setup(
+    ext_modules=[
+        CTypesExtension(
+            "relayrl_tpu._native.relayrl_native",
+            sources=sorted(
+                os.path.join("native", f)
+                for f in ("transport.cc", "codec.cc", "grpc_server.cc")
+            ),
+            include_dirs=["native"],
+            optional=True,
+        )
+    ],
+    cmdclass={"build_ext": build_ctypes_ext,
+              "bdist_wheel": bdist_wheel_ctypes},
+)

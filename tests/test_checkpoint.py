@@ -196,6 +196,21 @@ class TestAlgorithmResume:
         assert fresh.version == algo.version  # state from latest step
         assert len(fresh.buffer) == aux_len  # experience from older step
 
+    def test_cached_manager_upgrades_retention(self, tmp_path, tmp_cwd):
+        """A cached keep-3 manager must be replaced when a later call
+        needs more retention (aux cadence > 3) — silently reusing it
+        would garbage-collect every aux-carrying step."""
+        algo = _algo(tmp_path)
+        algo.receive_trajectory(_episode(4, seed=1))
+        ckpt_dir = str(tmp_path / "ckpt_keep")
+        m1 = checkpoint_algorithm(algo, ckpt_dir, wait=True)
+        assert m1.max_to_keep == 3
+        m2 = checkpoint_algorithm(algo, ckpt_dir, wait=True, max_to_keep=7)
+        assert m2.max_to_keep == 7 and m2 is not m1
+        # and never silently downgrades
+        m3 = checkpoint_algorithm(algo, ckpt_dir, wait=True, max_to_keep=2)
+        assert m3 is m2 and m3.max_to_keep == 7
+
     def test_restore_tolerates_checkpoint_without_aux(self, tmp_path,
                                                       tmp_cwd):
         """On-policy checkpoints (and any pre-aux checkpoint) have no aux
