@@ -100,7 +100,7 @@ def offpolicy_batch(B, obs_dim, act_dim, discrete, rng):
 
 
 def bench_algo(name, make_state_update, batch, flops_per_update=None,
-               detail=None, trials=None):
+               detail=None, trials=None, updates_per_call=1):
     state, update = make_state_update()
     jitted = jax.jit(update)
     device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
@@ -133,16 +133,17 @@ def bench_algo(name, make_state_update, batch, flops_per_update=None,
                         iters=10 if quick() else 30)
            for _ in range(trials)]
     dt = min(dts)
+    k = updates_per_call  # dispatch fusion: one call = k updates
     config = {"algorithm": name, "platform": jax.default_backend(),
               **(detail or {})}
     if trials > 1:
-        config["trials_updates_per_sec"] = [round(1.0 / d, 2) for d in dts]
+        config["trials_updates_per_sec"] = [round(k / d, 2) for d in dts]
     if flops_per_update:
         config["analytic_flops_per_update"] = float(flops_per_update)
         peak = chip_peak_flops()
         if peak:
-            config["mfu"] = round(flops_per_update / dt / peak, 4)
-    emit("learner_update", config, 1.0 / dt, "updates/s")
+            config["mfu"] = round(k * flops_per_update / dt / peak, 4)
+    emit("learner_update", config, k / dt, "updates/s")
 
 
 def main():
@@ -226,6 +227,31 @@ def main():
     bench_algo("SAC", mk_sac, offpolicy_batch(256, OBS, ACT, False, rng),
                detail={"family": "mlp", "batch_size": 256, "obs_dim": OBS,
                        "act_dim": ACT, "hidden_sizes": [128, 128]})
+
+    # Dispatch fusion (updates_per_dispatch=K): tiny off-policy batches
+    # on the chip are dominated by per-dispatch latency (benches/README
+    # learner commentary) — one lax.scan dispatch carrying K sequential
+    # updates amortizes it. Same math as K unfused calls
+    # (tests/test_offpolicy.py::TestDispatchFusion).
+    K = 8
+
+    def mk_dqn_fused():
+        state, update = mk_dqn()
+
+        def fused(s, stacked):
+            s2, ms = jax.lax.scan(lambda ss, b: update(ss, b), s, stacked)
+            # last update's metrics: same output contract as one update
+            # (the harness fences on a scalar leaf)
+            return s2, jax.tree.map(lambda x: x[-1], ms)
+
+        return state, fused
+
+    single = offpolicy_batch(256, OBS, ACT, True, rng)
+    stacked = {key: np.stack([v] * K) for key, v in single.items()}
+    bench_algo("DQN-fused", mk_dqn_fused, stacked, updates_per_call=K,
+               detail={"family": "mlp", "batch_size": 256, "obs_dim": OBS,
+                       "act_dim": ACT, "hidden_sizes": [128, 128],
+                       "updates_per_dispatch": K})
 
     # -- flagship non-MLP families: transformer-flash and CNN-pixel, both
     #    through the IMPALA update (the async-fleet north star for big

@@ -42,6 +42,12 @@ def main():
                     help="lunarlander only: the continuous-action variant "
                          "(needs Gymnasium Box2D) for the DDPG/TD3/SAC "
                          "family")
+    ap.add_argument("--hp", action="append", default=[], metavar="K=V",
+                    help="algorithm hyperparameter overrides, e.g. "
+                         "--hp gamma=0.999 --hp ent_coef=0.01; values parse "
+                         "as JSON with string fallback (parity with "
+                         "train_distributed --hp)")
+    ap.add_argument("--eval-episodes", type=int, default=10)
     args = ap.parse_args()
 
     from relayrl_tpu.envs import make
@@ -60,6 +66,16 @@ def main():
         hp.setdefault("discrete", False)
         hp.setdefault("act_limit", 1.0)
         env_kwargs["continuous"] = True
+    import json
+
+    for kv in args.hp:
+        key, sep, raw = kv.partition("=")
+        if not sep:
+            raise SystemExit(f"--hp expects K=V, got {kv!r}")
+        try:
+            hp[key] = json.loads(raw)
+        except json.JSONDecodeError:
+            hp[key] = raw
 
     env_ids = {"cartpole": "CartPole-v1", "pendulum": "Pendulum-v1",
                "lunarlander": "LunarLander-v3"}
@@ -76,8 +92,8 @@ def main():
             print(f"[local] target {args.target} reached", flush=True)
             break
     # Deterministic probe of the final policy (nothing reaches the learner).
-    eval_result = runner.evaluate(episodes=10)
-    print(f"[local] greedy eval over 10 episodes: "
+    eval_result = runner.evaluate(episodes=args.eval_episodes)
+    print(f"[local] greedy eval over {args.eval_episodes} episodes: "
           f"avg_return={eval_result['avg_return']:.1f}", flush=True)
 
 

@@ -80,6 +80,18 @@ class OffPolicyAlgorithm(AlgorithmBase):
                 "updates run per ingest call; use updates_per_step=0 to "
                 "disable training on ingest)")
         self._update_debt = 0.0
+        # Dispatch fusion: run K sampled-batch updates inside ONE jitted
+        # call (lax.scan over a [K, B, ...] stack). Small per-update
+        # batches on a fast accelerator are dominated by per-dispatch
+        # host->device latency (benches/README.md DQN chip row: a 2x128
+        # MLP at B=256 spends more time on dispatch than math); fusing K
+        # of them amortizes that fixed cost without changing the math —
+        # the scan threads state through the same K sequential updates
+        # the unfused loop would run. Single-host only (the multi-host
+        # broadcast loop ships one batch per collective step).
+        self.updates_per_dispatch = max(
+            1, int(params.get("updates_per_dispatch", 1)))
+        self._update_k = None  # compiled lazily on first fused dispatch
         self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
         seed = int(params.get("seed", 1))
         # Param init is deterministic given the seed (reproducible learners);
@@ -148,8 +160,7 @@ class OffPolicyAlgorithm(AlgorithmBase):
         batches = self.accumulate(actions)
         trained = False
         if batches:
-            for batch in batches:
-                self.train_on_batch(batch)
+            self.train_on_batches(batches)
             trained = True
         if self._traj_since_log >= self.traj_per_epoch:
             self.log_epoch()
@@ -160,8 +171,50 @@ class OffPolicyAlgorithm(AlgorithmBase):
         return self._last_metrics
 
     def _train_batches(self, n: int) -> None:
-        for _ in range(int(n)):
-            self.train_on_batch(self.buffer.sample(self.batch_size))
+        self.train_on_batches(
+            [self.buffer.sample(self.batch_size) for _ in range(int(n))])
+
+    def _fused_update(self):
+        """jit(scan(update)) over a stacked [K, B, ...] batch — one
+        dispatch for K sequential updates (same math as the loop; the
+        inner already-jitted update inlines into the scan trace)."""
+        if self._update_k is None:
+            def run(state, stacked):
+                return jax.lax.scan(
+                    lambda s, b: self._update(s, b), state, stacked)
+
+            self._update_k = jax.jit(run, donate_argnums=0)
+        return self._update_k
+
+    def train_on_batches(self, host_batches: Sequence[Mapping[str, Any]]
+                         ) -> Mapping[str, float]:
+        """Run the due updates, fusing groups of ``updates_per_dispatch``
+        into single jitted dispatches; the remainder (and the K=1 or
+        multi-host cases) go through the per-batch path."""
+        from relayrl_tpu.parallel.distributed import is_coordinator
+
+        k = self.updates_per_dispatch
+        i, n = 0, len(host_batches)
+        # _place is the mesh-aware [B, ...] placement — fused stacks are
+        # [K, B, ...] and multi-host updates are one-batch collectives,
+        # so fusion is single-host only.
+        while k > 1 and self._place is None and n - i >= k:
+            chunk = host_batches[i:i + k]
+            stacked = {key: np.stack([np.asarray(b[key]) for b in chunk])
+                       for key in chunk[0]}
+            self.state, ms = self._fused_update()(
+                self.state, self._to_device(stacked))
+            ms = {key: np.asarray(v) for key, v in ms.items()}
+            self._last_metrics = {key: float(v[-1]) for key, v in ms.items()}
+            if is_coordinator():
+                # keep per-update logger semantics: K rows, not one
+                for j in range(k):
+                    self.logger.store(
+                        **{key: float(v[j]) for key, v in ms.items()})
+            i += k
+        for b in host_batches[i:]:
+            self.train_on_batch(b)
+        return self._last_metrics
 
     def train_on_batch(self, host_batch: Mapping[str, Any]
                        ) -> Mapping[str, float]:
@@ -249,7 +302,8 @@ class OffPolicyAlgorithm(AlgorithmBase):
 
     def warmup(self, should_continue=None) -> int:
         """Replay samples are always ``[batch_size]`` transitions — one
-        compile covers every training batch this family draws."""
+        compile covers every training batch this family draws (two when
+        dispatch fusion is on: the [K, B, ...] scan shape as well)."""
         if self._warmup_is_collective():
             return 0
         if self.batch_size > self.warmup_max_elements:
@@ -257,7 +311,20 @@ class OffPolicyAlgorithm(AlgorithmBase):
         if should_continue is not None and not should_continue():
             return 0
         self._warmup_update(self.mh_zero_batch(self.batch_size, 0))
-        return 1
+        done = 1
+        k = self.updates_per_dispatch
+        if (k > 1 and k * self.batch_size <= self.warmup_max_elements
+                and (should_continue is None or should_continue())):
+            single = self.mh_zero_batch(self.batch_size, 0)
+            stacked = {key: np.stack([v] * k) for key, v in single.items()}
+            state_copy = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                self.state)
+            _, ms = self._fused_update()(state_copy,
+                                         self._to_device(stacked))
+            jax.block_until_ready(ms)
+            done += 1
+        return done
 
     def maybe_log_epoch(self) -> None:
         """Epoch logging is per ``traj_per_epoch`` trajectories, not per

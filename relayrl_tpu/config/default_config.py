@@ -54,6 +54,7 @@ DEFAULT_CONFIG: dict = {
             "buffer_size": 100_000,
             "update_after": 1000,
             "updates_per_step": 1.0,
+            "updates_per_dispatch": 1,
             "polyak": 0.995,
             "double_q": True,
             "epsilon_start": 1.0,
@@ -71,6 +72,7 @@ DEFAULT_CONFIG: dict = {
             "buffer_size": 100_000,
             "update_after": 1000,
             "updates_per_step": 1.0,
+            "updates_per_dispatch": 1,
             "polyak": 0.995,
             "n_atoms": 51,
             "v_min": -10.0,
@@ -91,6 +93,7 @@ DEFAULT_CONFIG: dict = {
             "buffer_size": 100_000,
             "update_after": 1000,
             "updates_per_step": 1.0,
+            "updates_per_dispatch": 1,
             "polyak": 0.995,
             "act_limit": 1.0,
             "act_noise": 0.1,
@@ -107,6 +110,7 @@ DEFAULT_CONFIG: dict = {
             "buffer_size": 100_000,
             "update_after": 1000,
             "updates_per_step": 1.0,
+            "updates_per_dispatch": 1,
             "polyak": 0.995,
             "act_limit": 1.0,
             "act_noise": 0.1,
@@ -141,6 +145,7 @@ DEFAULT_CONFIG: dict = {
             "buffer_size": 100_000,
             "update_after": 1000,
             "updates_per_step": 1.0,
+            "updates_per_dispatch": 1,
             "polyak": 0.995,
             "act_limit": 1.0,
             "traj_per_epoch": 8,
