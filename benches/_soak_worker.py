@@ -73,6 +73,29 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         barrier.wait(timeout=cfg["handshake_timeout_s"] + 30)
     except threading.BrokenBarrierError:
         pass  # a sibling died in construction; run solo rather than hang
+    # Cross-PROCESS start barrier (agent 0 of each worker publishes
+    # readiness; the coordinator releases everyone at once): without it
+    # each process opened its measured window as soon as ITS agents were
+    # up, while sibling processes were still serially importing jax on
+    # the shared core — the committed wall_s ran 2-9x the nominal
+    # duration and the windows barely overlapped (VERDICT r4 weak #3,
+    # the "8-process start-up storm"). Opt-in via cfg (run_soak sets it;
+    # run_churn's phase semantics drive their own timing and must NOT
+    # stall waiting for a go-file nobody writes). The go wait must
+    # OUTLAST the coordinator's ready-wait (it releases at the last
+    # worker's readiness or its own timeout, whichever first) — a fast
+    # worker timing out before a slow sibling's bring-up would reopen
+    # exactly the staggered-window hole this barrier closes.
+    if cfg.get("start_barrier"):
+        if agent_idx == 0:
+            with open(os.path.join(cfg["scratch"],
+                                   f"ready_{cfg['worker_id']}"), "w") as f:
+                f.write(ident)
+        go_path = os.path.join(cfg["scratch"], "go")
+        go_deadline = time.time() + cfg.get("go_timeout_s", 360.0)
+        while not os.path.exists(go_path) and time.time() < go_deadline:
+            time.sleep(0.05)
+    window_start_ns = time.monotonic_ns()
     deadline = time.time() + cfg["duration_s"]
     crashed = None
     try:
@@ -84,10 +107,19 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
                 obs = rng.standard_normal(obs_dim).astype(np.float32)
                 reward = 1.0
                 steps += 1
+                # Deadline check INSIDE the episode: under heavy
+                # oversubscription one 25-step episode can take many
+                # seconds, and finishing it would stretch this agent's
+                # measured window far past the nominal duration (the
+                # committed wall_s >> duration_s artifact). The cut
+                # episode still terminates cleanly on the wire.
+                if time.time() >= deadline:
+                    break
             agent.flag_last_action(reward, terminated=True)
             episodes += 1
     except Exception as e:  # a crashed agent must still reach the barrier
         crashed = repr(e)
+    window_end_ns = time.monotonic_ns()
     # Line up before the grace window (quiet host), but never hang the
     # healthy agents on a crashed sibling: a timeout breaks the barrier,
     # and BrokenBarrierError in the others just starts their grace early.
@@ -123,6 +155,8 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         "final_version": agent.model_version,
         "receipts": receipts,
         "sub_ts": sub_ts,
+        "window_start_ns": window_start_ns,
+        "window_end_ns": window_end_ns,
         # Departure stamp: a publish after this agent stopped listening
         # cannot be received, so the bench excludes such pairs from
         # `expected` (fleet teardown is as staggered as bring-up).
@@ -149,8 +183,14 @@ def main():
     ]
     for t in threads:
         t.start()
+    # The go-file wait (start_barrier) can add up to go_timeout_s before
+    # the window even opens — the join bound must cover it or slow
+    # agents get abandoned and silently vanish from the result file.
+    barrier_s = cfg.get("go_timeout_s", 360.0) if cfg.get(
+        "start_barrier") else 0.0
     for t in threads:
-        t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"] + 120)
+        t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"]
+               + barrier_s + 120)
     with open(cfg["result_path"], "w") as f:
         json.dump({"worker_id": cfg["worker_id"],
                    "agents": list(out.values())}, f)

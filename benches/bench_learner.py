@@ -345,6 +345,29 @@ def main():
                     "obs_shape": list(obs_shape),
                     "conv_spec": [list(s) for s in TPU_CONV], "dense": 512})
 
+        # Batch-scaling lever (docs/parallelism.md CNN roofline: "bigger
+        # frame batch — more M rows per conv" is lever #1 for the
+        # lane-starved Nature shape): same trunk, 4x the frames per
+        # update. MFU here vs the B=16 row isolates how much of the
+        # 4.9% was M-dimension starvation vs the 32-channel lane cap.
+        bench_algo(
+            "IMPALA", lambda: mk_impala_for(c_arch),
+            onpolicy_batch(64, c_T, c_obs, 18, rng),
+            flops_per_update=3 * cnn_fwd_flops(
+                64 * c_T, obs_shape, conv_spec, 512, 18),
+            detail={"family": "cnn_pixel_b64", "B": 64, "T": c_T,
+                    "obs_shape": list(obs_shape),
+                    "conv_spec": [list(s) for s in conv_spec],
+                    "dense": 512})
+        bench_algo(
+            "IMPALA", lambda: mk_impala_for(tpu_cnn_arch),
+            onpolicy_batch(64, c_T, c_obs, 18, rng),
+            flops_per_update=3 * cnn_fwd_flops(
+                64 * c_T, obs_shape, TPU_CONV, 512, 18),
+            detail={"family": "cnn_pixel_tpu_trunk_b64", "B": 64, "T": c_T,
+                    "obs_shape": list(obs_shape),
+                    "conv_spec": [list(s) for s in TPU_CONV], "dense": 512})
+
 
 if __name__ == "__main__":
     main()

@@ -39,5 +39,8 @@ RELAYRL_BENCH_TPU=1 python bench_learner.py | grep '^{' \
     | tee results/.learner_tpu.json.tmp
 mv results/.learner_tpu.json.tmp results/learner_tpu.json
 
+echo "== flash block/head-dim autotune -> results/flash_autotune.json =="
+RELAYRL_BENCH_TPU=1 python bench_flash_autotune.py --write | grep '^{'
+
 echo "== headline (driver-shaped line, not committed) =="
 cd .. && python bench.py

@@ -168,8 +168,9 @@ class AlgorithmBase(abc.ABC):
             return place(dict(host_batch))
         return {k: jnp.asarray(v) for k, v in host_batch.items()}
 
-    def _warmup_update(self, host_batch) -> None:
-        """Run ``self._update`` once on a shape/dtype placeholder batch and
+    def _warmup_update(self, host_batch, update_fn=None) -> None:
+        """Run ``update_fn`` (default ``self._update``) once on a
+        shape/dtype placeholder batch and
         discard every output. The state argument is donated
         (``donate_argnums=0``), so the update consumes a copy — the live
         ``self.state`` buffers, version, metrics, and logger are untouched.
@@ -191,7 +192,8 @@ class AlgorithmBase(abc.ABC):
         state_copy = jax.tree_util.tree_map(
             lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
             live)
-        _, metrics = self._update(state_copy, self._to_device(host_batch))
+        fn = update_fn if update_fn is not None else self._update
+        _, metrics = fn(state_copy, self._to_device(host_batch))
         jax.block_until_ready(metrics)
 
     def _jitted_policy_step(self):

@@ -317,12 +317,8 @@ class OffPolicyAlgorithm(AlgorithmBase):
                 and (should_continue is None or should_continue())):
             single = self.mh_zero_batch(self.batch_size, 0)
             stacked = {key: np.stack([v] * k) for key, v in single.items()}
-            state_copy = jax.tree_util.tree_map(
-                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
-                self.state)
-            _, ms = self._fused_update()(state_copy,
-                                         self._to_device(stacked))
-            jax.block_until_ready(ms)
+            # same copy/donation discipline as the single-shape warmup
+            self._warmup_update(stacked, update_fn=self._fused_update())
             done += 1
         return done
 

@@ -67,20 +67,38 @@ class AtariPreprocessing:
     """
 
     def __init__(self, env, frame_size: int = 84, frame_stack: int = 4,
-                 frame_skip: int = 4, max_pool: bool = True):
+                 frame_skip: int = 4, max_pool: bool = True,
+                 obs_dtype: str = "float32"):
         if frame_skip < 1:
             raise ValueError("frame_skip must be >= 1")
+        if obs_dtype not in ("float32", "uint8"):
+            raise ValueError(f"obs_dtype must be float32|uint8, "
+                             f"got {obs_dtype!r}")
         self.env = env
         self.frame_size = frame_size
         self.frame_stack = frame_stack
         self.frame_skip = frame_skip
         self.max_pool = max_pool
+        # "uint8": ship raw [0,255] bytes — 4x smaller trajectories on
+        # the WIRE (the 84x84x4 north-star step is 28 KB as bytes vs
+        # 113 KB as float32; the off-policy replay ring still stores
+        # float32 — StepReplayBuffer preallocates f32 — so host replay
+        # memory is unchanged). Pair with the CNN trunk's
+        # default scale_obs=True (/255 on-device, models/cnn.py:105) for
+        # unit-range inputs. NOTE the legacy float32 mode ALREADY
+        # pre-normalizes to [0,1]; under scale_obs=True the net then
+        # sees [0, 1/255] — consistent train/serve (the committed pixel
+        # goldens learned in that regime) but not unit-range; uint8 mode
+        # is the clean path.
+        self.obs_dtype = obs_dtype
         self._stack = np.zeros((frame_size, frame_size, frame_stack), np.uint8)
         n = getattr(env.action_space, "n", None)
         self.action_space = env.action_space if n is not None else Discrete(2)
-        self.observation_space = Box(
-            low=0.0, high=1.0,
-            shape=(frame_size * frame_size * frame_stack,), dtype=np.float32)
+        flat = frame_size * frame_size * frame_stack
+        self.observation_space = (
+            Box(low=0, high=255, shape=(flat,), dtype=np.uint8)
+            if obs_dtype == "uint8"
+            else Box(low=0.0, high=1.0, shape=(flat,), dtype=np.float32))
 
     @property
     def obs_shape(self) -> tuple[int, int, int]:
@@ -96,6 +114,8 @@ class AtariPreprocessing:
             [self._stack[:, :, 1:], processed[:, :, None]], axis=2)
 
     def _obs(self) -> np.ndarray:
+        if self.obs_dtype == "uint8":
+            return self._stack.reshape(-1).copy()
         return (self._stack.astype(np.float32) / 255.0).reshape(-1)
 
     def reset(self, seed: int | None = None):
@@ -195,11 +215,13 @@ class SyntheticPixelEnv:
 
 def make_atari(env_id: str = "synthetic", frame_size: int = 84,
                frame_stack: int = 4, frame_skip: int = 4,
+               obs_dtype: str = "float32",
                **env_kwargs) -> AtariPreprocessing:
     """Preprocessed pixel env. ``"synthetic"`` uses the in-repo toy; any
     other id requires a Gymnasium ALE install (``gymnasium[atari]``) and is
     wrapped with the identical pipeline (ALE's own frameskip is disabled so
-    this wrapper owns it)."""
+    this wrapper owns it). ``obs_dtype="uint8"`` ships byte-range frames
+    (4x smaller wire/replay payloads; see AtariPreprocessing)."""
     if env_id == "synthetic":
         raw = SyntheticPixelEnv(**env_kwargs)
     else:
@@ -207,4 +229,5 @@ def make_atari(env_id: str = "synthetic", frame_size: int = 84,
 
         raw = gymnasium.make(env_id, frameskip=1, **env_kwargs)
     return AtariPreprocessing(raw, frame_size=frame_size,
-                              frame_stack=frame_stack, frame_skip=frame_skip)
+                              frame_stack=frame_stack, frame_skip=frame_skip,
+                              obs_dtype=obs_dtype)

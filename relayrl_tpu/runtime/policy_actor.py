@@ -144,7 +144,18 @@ class PolicyActor:
         deliberate departure, SURVEY.md §7.5 spirit. The only reward that
         can be lost is one spanning a capacity-flush chunk boundary (the
         previous record already left the process)."""
-        obs = np.asarray(obs, dtype=np.float32)
+        # Preserve byte frames: a uint8 pixel obs must reach the wire as
+        # uint8 (4x smaller trajectories; the CNN trunk casts + scales
+        # on-device) — an unconditional float32 cast here silently made
+        # every "byte-sized" pixel payload 112,989 B/step instead of
+        # 28,226. Everything else normalizes to float32 as before. The
+        # uint8 branch copies defensively: envs commonly hand out views
+        # of a reused frame buffer, and a stored view would turn every
+        # recorded step into the episode's final frame (28 KB per step —
+        # negligible next to the policy apply).
+        obs = np.asarray(obs)
+        obs = (obs.copy() if obs.dtype == np.uint8
+               else obs.astype(np.float32, copy=False))
         mask_arr = None if mask is None else np.asarray(mask, dtype=np.float32)
         with self._lock:
             if reward and self.trajectory.get_actions():

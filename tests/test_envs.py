@@ -141,6 +141,41 @@ class TestAtariPipeline:
                 break
         assert total == 3.0  # tracked every drop
 
+    def test_uint8_obs_mode(self):
+        """obs_dtype="uint8": byte-range frames on the wire (4x smaller
+        than the legacy float32 mode), preserved through the trajectory
+        codec, and consumable by the CNN policy whose scale_obs handles
+        /255 on-device."""
+        import jax
+
+        from relayrl_tpu.envs import make_atari
+        from relayrl_tpu.models import build_policy
+        from relayrl_tpu.types.action import ActionRecord
+        from relayrl_tpu.types.trajectory import (
+            deserialize_actions,
+            serialize_actions,
+        )
+
+        env = make_atari("synthetic", frame_size=84, frame_stack=4,
+                         obs_dtype="uint8")
+        obs, _ = env.reset(seed=0)
+        assert obs.dtype == np.uint8 and obs.shape == (84 * 84 * 4,)
+        assert obs.max() > 1  # byte range, not normalized
+        # codec round-trip keeps the dtype (byte-sized payload)
+        rec = [ActionRecord(obs=obs, act=np.int64(1), rew=0.0, done=True)]
+        raw = serialize_actions(rec)
+        assert len(raw) < 84 * 84 * 4 + 4096  # ~1 byte/pixel + framing
+        back = deserialize_actions(raw)
+        assert back[0].obs.dtype == np.uint8
+        np.testing.assert_array_equal(back[0].obs, obs)
+        # CNN policy consumes uint8 directly (casts + /255 in-trunk)
+        h, w, c = env.obs_shape
+        policy = build_policy({"kind": "cnn_discrete", "obs_dim": h * w * c,
+                               "act_dim": 3, "obs_shape": [h, w, c]})
+        params = policy.init_params(jax.random.PRNGKey(0))
+        act, aux = policy.step(params, jax.random.PRNGKey(1), obs)
+        assert int(act) in (0, 1, 2)
+
     def test_gymnasium_branch_of_make_atari(self):
         """The real-ALE branch of ``make_atari`` (any non-"synthetic" id)
         goes through ``gymnasium.make(env_id, frameskip=1)``. ale_py isn't
