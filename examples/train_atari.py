@@ -67,6 +67,12 @@ def main():
                     help="conv trunk preset: 'nature' (reference shape) or "
                          "'tpu' (MXU-lane channel widths 64/128/128 — "
                          "higher MFU on chip; docs/parallelism.md)")
+    ap.add_argument("--bytes", action="store_true",
+                    help="uint8 frames end-to-end: byte-range obs from the "
+                         "pipeline (4x smaller trajectories), and for "
+                         "DQN/C51 a uint8 replay ring (4x smaller replay + "
+                         "checkpoints); the conv trunk scales /255 "
+                         "on-device either way")
     args = ap.parse_args()
 
     from relayrl_tpu.envs import make_atari
@@ -80,9 +86,13 @@ def main():
                       "balls": args.balls}
     env = make_atari(args.env, frame_size=args.frame_size,
                      frame_skip=args.frame_skip,
-                     frame_stack=args.frame_stack, **env_kwargs)
+                     frame_stack=args.frame_stack,
+                     obs_dtype="uint8" if args.bytes else "float32",
+                     **env_kwargs)
     h, w, c = env.obs_shape
     hp = {"obs_shape": [h, w, c], "traj_per_epoch": args.traj_per_epoch}
+    if args.bytes and args.algo in ("DQN", "C51"):
+        hp["obs_dtype"] = "uint8"  # byte replay ring to match
     if args.out:
         os.makedirs(args.out, exist_ok=True)
         hp["env_dir"] = args.out

@@ -108,6 +108,10 @@ class OffPolicyAlgorithm(AlgorithmBase):
             capacity=int(buf_size or params.get("buffer_size", 100_000)),
             discrete=bool(params.get("discrete", self.DEFAULT_DISCRETE)),
             seed=seed,
+            # "uint8" for pixel replay (pair with envs obs_dtype="uint8"):
+            # 4x smaller ring/aux-checkpoint/device-transfer; the CNN
+            # q-trunk casts + scales /255 on-device.
+            obs_dtype=str(params.get("obs_dtype", "float32")),
         )
 
         # Subclass: sets self.policy, self.arch, self.state, self._update.
@@ -278,11 +282,12 @@ class OffPolicyAlgorithm(AlgorithmBase):
         slot carries obs_dim instead."""
         act = (np.zeros((b,), np.int32) if self.buffer.discrete
                else np.zeros((b, self.act_dim), np.float32))
+        obs_dt = self.buffer.obs_dtype  # warmup must match the ring dtype
         return {
-            "obs": np.zeros((b, self.obs_dim), np.float32),
+            "obs": np.zeros((b, self.obs_dim), obs_dt),
             "act": act,
             "rew": np.zeros((b,), np.float32),
-            "obs2": np.zeros((b, self.obs_dim), np.float32),
+            "obs2": np.zeros((b, self.obs_dim), obs_dt),
             "mask2": np.ones((b, self.act_dim), np.float32),
             "done": np.zeros((b,), np.float32),
         }

@@ -32,12 +32,23 @@ class StepReplayBuffer:
     """
 
     def __init__(self, obs_dim: int, act_dim: int, capacity: int,
-                 discrete: bool = True, seed: int = 0):
+                 discrete: bool = True, seed: int = 0,
+                 obs_dtype=np.float32):
         self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
         self.capacity = int(capacity)
         self.discrete = bool(discrete)
-        self.obs = np.zeros((capacity, obs_dim), np.float32)
-        self.obs2 = np.zeros((capacity, obs_dim), np.float32)
+        # uint8 rings (pixel replay): 4x less host memory, 4x smaller
+        # checkpoint aux snapshots, and 4x less host->device transfer
+        # per sampled batch — samples keep the stored dtype and the CNN
+        # q-trunk casts + scales /255 on-device (models/cnn.py). Float
+        # observations written into a uint8 ring would truncate; pair
+        # this with the env pipeline's obs_dtype="uint8".
+        self.obs_dtype = np.dtype(obs_dtype)
+        if self.obs_dtype not in (np.dtype(np.float32), np.dtype(np.uint8)):
+            raise ValueError(f"obs_dtype must be float32|uint8, "
+                             f"got {self.obs_dtype}")
+        self.obs = np.zeros((capacity, obs_dim), self.obs_dtype)
+        self.obs2 = np.zeros((capacity, obs_dim), self.obs_dtype)
         if discrete:
             self.act = np.zeros((capacity,), np.int32)
         else:
@@ -53,6 +64,17 @@ class StepReplayBuffer:
 
     def __len__(self) -> int:
         return self.size
+
+    def _check_obs_dtype(self, incoming) -> None:
+        """Fail fast on the documented footgun: float observations into a
+        uint8 ring would silently floor to all-zero (the learner-side
+        obs_dtype knob must be PAIRED with the env pipeline's)."""
+        if (self.obs_dtype == np.uint8
+                and np.issubdtype(np.dtype(incoming), np.floating)):
+            raise ValueError(
+                "float observations fed to a uint8 replay ring — set the "
+                "env pipeline's obs_dtype=\"uint8\" too (envs/atari.py), "
+                "or drop the algorithm's obs_dtype knob")
 
     def _put(self, obs, act, rew, obs2, done, mask2):
         i = self.ptr
@@ -99,14 +121,15 @@ class StepReplayBuffer:
         T = dt.n_steps
         if T == 0 or "o" not in cols or "a" not in cols:
             return 0
+        self._check_obs_dtype(cols["o"].dtype)
         obs = cols["o"].reshape(T, -1)[:, : self.obs_dim].astype(
-            np.float32, copy=False)
+            self.obs_dtype, copy=False)
         act = cols["a"]
         rew = cols["r"].astype(np.float32, copy=False)
         done_last = bool(cols["t"][T - 1])
         trunc_last = dt.marker_truncated or bool(cols["x"][T - 1])
 
-        obs2 = np.zeros((T, self.obs_dim), np.float32)
+        obs2 = np.zeros((T, self.obs_dim), self.obs_dtype)
         if T > 1:
             obs2[: T - 1] = obs[1:]
         mask2 = np.ones((T, self.act_dim), np.float32)
@@ -125,8 +148,8 @@ class StepReplayBuffer:
             if dt.final_obs is None:
                 n = T - 1
             else:
-                obs2[T - 1] = np.asarray(dt.final_obs,
-                                         np.float32).reshape(-1)[: self.obs_dim]
+                obs2[T - 1] = np.asarray(
+                    dt.final_obs, self.obs_dtype).reshape(-1)[: self.obs_dim]
                 if dt.final_mask is not None:
                     mask2[T - 1] = np.asarray(
                         dt.final_mask, np.float32).reshape(-1)[: self.act_dim]
@@ -147,6 +170,10 @@ class StepReplayBuffer:
         # bootstrap successor for the final transition — and its action
         # mask, so masked bootstrap targets stay legal.
         steps, final_obs, truncated, final_mask = fold_trailing_markers(actions)
+        for rec in steps:  # one dtype check per episode (uint8 footgun)
+            if rec.obs is not None:
+                self._check_obs_dtype(np.asarray(rec.obs).dtype)
+                break
         stored = 0
         ones = np.ones((self.act_dim,), np.float32)
         for t, rec in enumerate(steps):
@@ -167,18 +194,18 @@ class StepReplayBuffer:
                              .reshape(-1)[: self.act_dim])
                     done = 0.0
                 else:
-                    obs2 = np.zeros((self.obs_dim,), np.float32)
+                    obs2 = np.zeros((self.obs_dim,), self.obs_dtype)
                     mask2 = ones
                     done = 1.0
             else:
                 nxt = steps[t + 1]
                 if nxt.obs is None:
                     continue
-                obs2 = np.asarray(nxt.obs, np.float32).reshape(-1)[: self.obs_dim]
+                obs2 = np.asarray(nxt.obs, self.obs_dtype).reshape(-1)[: self.obs_dim]
                 mask2 = (np.asarray(nxt.mask, np.float32).reshape(-1)[: self.act_dim]
                          if nxt.mask is not None else ones)
                 done = 0.0
-            obs = np.asarray(rec.obs, np.float32).reshape(-1)[: self.obs_dim]
+            obs = np.asarray(rec.obs, self.obs_dtype).reshape(-1)[: self.obs_dim]
             self._put(obs, rec.act, rec.rew, obs2, done, mask2)
             stored += 1
         return stored
@@ -213,6 +240,16 @@ class StepReplayBuffer:
         n = int(d["size"])
         keep = min(n, self.capacity)
         sl = slice(n - keep, n)  # most recent when shrinking
+        saved_obs_dt = np.asarray(d["obs"]).dtype
+        if saved_obs_dt != self.obs_dtype:
+            # A silent cast would corrupt the restored experience
+            # (float [0,1] floors to all-zero bytes; bytes into a float
+            # ring are 255x the live obs scale). Flip the ring dtype to
+            # match the checkpoint, or start fresh.
+            raise ValueError(
+                f"checkpointed replay obs dtype {saved_obs_dt} != ring "
+                f"obs_dtype {self.obs_dtype}; resume with a matching "
+                f"obs_dtype (values are NOT rescalable across the flip)")
         for name in ("obs", "obs2", "act", "mask2", "rew", "done"):
             getattr(self, name)[:keep] = np.asarray(d[name])[sl]
         self.size = keep

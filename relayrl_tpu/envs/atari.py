@@ -80,10 +80,12 @@ class AtariPreprocessing:
         self.frame_skip = frame_skip
         self.max_pool = max_pool
         # "uint8": ship raw [0,255] bytes — 4x smaller trajectories on
-        # the WIRE (the 84x84x4 north-star step is 28 KB as bytes vs
-        # 113 KB as float32; the off-policy replay ring still stores
-        # float32 — StepReplayBuffer preallocates f32 — so host replay
-        # memory is unchanged). Pair with the CNN trunk's
+        # the wire (the 84x84x4 north-star step is 28 KB as bytes vs
+        # 113 KB as float32); off-policy learners can extend the saving
+        # to replay + checkpoints with the algorithm-side
+        # obs_dtype="uint8" knob (StepReplayBuffer's byte ring — the
+        # two must be paired; the ring rejects float obs). Pair with
+        # the CNN trunk's
         # default scale_obs=True (/255 on-device, models/cnn.py:105) for
         # unit-range inputs. NOTE the legacy float32 mode ALREADY
         # pre-normalizes to [0,1]; under scale_obs=True the net then

@@ -108,6 +108,24 @@ class TestColumnarParity:
     def test_no_aux(self, decoder):
         _assert_pad_parity(_mk_steps(5, with_aux=False), decoder)
 
+    def test_uint8_pixel_obs(self, decoder):
+        """The byte-sized pixel wire (envs obs_dtype="uint8"): the C++
+        columnar decoder must carry uint8 obs columns and the padded
+        learner batch must match the Python path bit-for-bit (pixels
+        0..255 upcast once, at batch build)."""
+        rng = np.random.default_rng(7)
+        obs_dim = 12 * 12 * 2  # small pixel-ish frame, byte range
+        steps = [ActionRecord(
+            obs=rng.integers(0, 256, obs_dim, dtype=np.uint8),
+            act=np.int64(rng.integers(3)), rew=float(rng.random()),
+            data={"logp_a": np.float32(-0.3), "v": np.float32(0.1)},
+            done=(i == 7)) for i in range(8)]
+        item = _assert_pad_parity(steps, decoder, obs_dim=obs_dim,
+                                  act_dim=3)
+        # the decoded column itself must still be bytes, not floats
+        assert item.columns["o"].dtype == np.uint8
+        np.testing.assert_array_equal(item.columns["o"][0], steps[0].obs)
+
     def test_terminal_marker(self, decoder):
         steps = _mk_steps(10)
         steps[-1] = ActionRecord(obs=steps[-1].obs, act=steps[-1].act,

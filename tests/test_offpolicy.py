@@ -234,6 +234,87 @@ class TestDiscreteAlgorithms:
         assert "v" in aux
 
 
+class TestUint8Ring:
+    """Pixel replay at 1 byte/pixel: storage, sampling, checkpoint, and a
+    pixel-DQN update all on the uint8 ring (paired with the env
+    pipeline's obs_dtype="uint8"; the conv q-trunk scales /255
+    on-device)."""
+
+    def test_store_sample_dtype(self):
+        buf = StepReplayBuffer(obs_dim=8, act_dim=2, capacity=32, seed=0,
+                               obs_dtype=np.uint8)
+        assert buf.obs.dtype == np.uint8 and buf.obs.nbytes == 32 * 8
+        rng = np.random.default_rng(0)
+        eps = [ActionRecord(obs=rng.integers(0, 256, 8, dtype=np.uint8),
+                            act=np.int64(rng.integers(2)), rew=1.0,
+                            done=(i == 5)) for i in range(6)]
+        buf.add_episode(eps)
+        batch = buf.sample(4)
+        assert batch["obs"].dtype == np.uint8
+        assert batch["obs2"].dtype == np.uint8
+        assert batch["rew"].dtype == np.float32
+
+    def test_checkpoint_roundtrip_keeps_bytes(self):
+        buf = StepReplayBuffer(obs_dim=4, act_dim=2, capacity=16, seed=0,
+                               obs_dtype=np.uint8)
+        for i in range(10):
+            buf._put(np.full(4, i, np.uint8), 1, float(i),
+                     np.full(4, i + 1, np.uint8), 0.0, np.ones(2))
+        state = buf.state_arrays()
+        assert state["obs"].dtype == np.uint8  # aux snapshot is bytes too
+        buf2 = StepReplayBuffer(obs_dim=4, act_dim=2, capacity=16, seed=0,
+                                obs_dtype=np.uint8)
+        buf2.load_state_arrays(state)
+        np.testing.assert_array_equal(buf2.obs[:10], buf.obs[:10])
+        assert buf2.obs.dtype == np.uint8
+
+    def test_rejects_unsupported_dtype(self):
+        with pytest.raises(ValueError):
+            StepReplayBuffer(obs_dim=4, act_dim=2, capacity=8,
+                             obs_dtype=np.int16)
+
+    def test_float_obs_into_uint8_ring_fails_fast(self):
+        """The documented pairing footgun: float [0,1] frames into a byte
+        ring would silently floor to zero — must raise instead."""
+        buf = StepReplayBuffer(obs_dim=4, act_dim=2, capacity=8,
+                               obs_dtype=np.uint8)
+        eps = [ActionRecord(obs=np.random.rand(4).astype(np.float32),
+                            act=np.int64(1), rew=0.0, done=True)]
+        with pytest.raises(ValueError, match="uint8 replay ring"):
+            buf.add_episode(eps)
+
+    def test_resume_rejects_dtype_flip(self):
+        """A float32 checkpoint must not silently cast into a uint8 ring
+        (or vice versa) — restored experience would be garbage."""
+        src = StepReplayBuffer(obs_dim=4, act_dim=2, capacity=8, seed=0)
+        src._put(np.full(4, 0.5, np.float32), 1, 1.0,
+                 np.zeros(4, np.float32), 0.0, np.ones(2))
+        dst = StepReplayBuffer(obs_dim=4, act_dim=2, capacity=8, seed=0,
+                               obs_dtype=np.uint8)
+        with pytest.raises(ValueError, match="obs_dtype"):
+            dst.load_state_arrays(src.state_arrays())
+
+    def test_pixel_dqn_trains_on_uint8_ring(self, tmp_cwd):
+        h = w = 12
+        c = 2
+        obs_dim = h * w * c
+        algo = build_algorithm(
+            "DQN", obs_dim=obs_dim, act_dim=3, obs_shape=[h, w, c],
+            obs_dtype="uint8", batch_size=8, buf_size=128, update_after=16,
+            conv_spec=[[4, 3, 2], [8, 3, 1]], dense=32,
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs_u8dqn")})
+        assert algo.buffer.obs.dtype == np.uint8
+        rng = np.random.default_rng(0)
+        for s in range(4):
+            eps = [ActionRecord(
+                obs=rng.integers(0, 256, obs_dim, dtype=np.uint8),
+                act=np.int64(rng.integers(3)), rew=float(rng.random()),
+                done=(i == 9)) for i in range(10)]
+            algo.receive_trajectory(eps)
+        assert algo.version > 0  # jitted conv update ran on byte batches
+        assert algo.warmup() >= 1  # warmup batch matches the ring dtype
+
+
 class TestDispatchFusion:
     """updates_per_dispatch=K: K sequential updates in ONE jitted
     dispatch (lax.scan over stacked batches) must be numerically
