@@ -42,5 +42,29 @@ mv results/.learner_tpu.json.tmp results/learner_tpu.json
 echo "== flash block/head-dim autotune -> results/flash_autotune.json =="
 RELAYRL_BENCH_TPU=1 python bench_flash_autotune.py --write | grep '^{'
 
-echo "== headline (driver-shaped line, not committed) =="
-cd .. && python bench.py
+echo "== headline (driver-shaped line; persisted as the chip record) =="
+cd .. && python bench.py | tee benches/results/.headline.tmp
+# Persist the live-chip line as the newest headline_chip record so
+# bench.py's degraded fallback cites THIS capture if the tunnel later
+# dies (the citation loads the lexicographically newest headline_chip*).
+python - <<'EOF'
+import json
+line = open("benches/results/.headline.tmp").read().strip().splitlines()[-1]
+rec = json.loads(line)
+if not rec.get("degraded"):
+    import datetime
+    now = datetime.datetime.now(datetime.timezone.utc)
+    rec.setdefault("config", {})["captured_at"] = now.strftime(
+        "%Y-%m-%dT%H:%MZ")
+    rec["config"]["how"] = "python bench.py via benches/refresh_chip.sh"
+    # Date-stamped name (never a hardcoded round): successive refreshes
+    # accumulate instead of clobbering, and bench.py's degraded citation
+    # picks the newest by mtime.
+    out = f"benches/results/headline_chip_{now.strftime('%Y%m%d')}.json"
+    with open(out, "w") as f:
+        json.dump(rec, f)
+    print(f"chip headline persisted -> {out}")
+else:
+    print("headline came back DEGRADED; not persisting a chip record")
+EOF
+rm -f benches/results/.headline.tmp
