@@ -341,3 +341,65 @@ def test_offpolicy_and_async_families_over_sockets(tmp_cwd, algo, hp):
             agent.disable_agent()
     finally:
         server.disable_server()
+
+
+def test_uint8_pixel_frames_cross_the_wire_byte_sized(tmp_cwd):
+    """The byte-sized pixel plane end-to-end (guards what
+    benches/bench_pixel_wire.py measures at full scale): uint8 frames
+    from the Atari pipeline stay uint8 through actor -> codec -> socket
+    -> decode -> CNN learner, with per-step payload ~= obs_dim bytes
+    (a float32 regression would quadruple it — exactly the silent
+    upcast round 5 fixed in policy_actor.py)."""
+    from relayrl_tpu.envs import make_atari
+
+    server_addrs = _zmq_addrs()
+    agent_addrs = _agent_addrs(server_addrs)
+    frame, stack = 16, 2
+    obs_dim = frame * frame * stack
+    server = TrainingServer(
+        "PPO", obs_dim=obs_dim, act_dim=3, server_type="zmq",
+        env_dir=str(tmp_cwd),
+        hyperparams={"model_kind": "cnn_discrete",
+                     "obs_shape": [frame, frame, stack],
+                     "conv_spec": [[4, 3, 2], [8, 3, 1]], "dense": 32,
+                     "traj_per_epoch": 2, "minibatch_count": 1,
+                     "train_iters": 1},
+        **server_addrs)
+    wire = {"bytes": 0, "steps": 0}
+    try:
+        agent = Agent(server_type="zmq", handshake_timeout_s=30,
+                      seed=0, **agent_addrs)
+        inner_send = agent.transport.send_trajectory
+        inner_step = agent.request_for_action
+
+        def counting_send(raw):
+            wire["bytes"] += len(raw)
+            return inner_send(raw)
+
+        agent.transport.send_trajectory = counting_send
+
+        def counting_step(obs, **kw):
+            wire["steps"] += 1
+            return inner_step(obs, **kw)
+
+        agent.request_for_action = counting_step
+        try:
+            env = make_atari("synthetic", frame_size=frame,
+                             frame_stack=stack, frame_skip=2,
+                             obs_dtype="uint8", raw_size=24, balls=1)
+            deadline = time.monotonic() + 90
+            while (server.stats["updates"] < 1
+                   and time.monotonic() < deadline):
+                run_gym_loop(agent, env, episodes=1, max_steps=40)
+            assert server.stats["updates"] >= 1, server.stats
+            assert server.stats["dropped"] == 0
+            bytes_per_step = wire["bytes"] / wire["steps"]
+            # obs_dim byte frame + a small fixed overhead; float32 would
+            # be >= 4 * obs_dim
+            assert obs_dim <= bytes_per_step < 2 * obs_dim, (
+                f"pixel step costs {bytes_per_step:.0f} B on the wire "
+                f"(frame is {obs_dim} B) — uint8 plane regressed")
+        finally:
+            agent.disable_agent()
+    finally:
+        server.disable_server()
