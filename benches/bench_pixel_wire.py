@@ -79,33 +79,19 @@ def run_cell(transport: str, updates: int) -> dict:
             "pi_lr": 1e-3,
         },
         **server_addrs)
-    wire = {"bytes": 0, "sends": 0, "steps": 0}
     t0 = time.monotonic()
     try:
         agent = Agent(server_type=transport, handshake_timeout_s=120,
                       model_path=os.path.join(os.getcwd(),
                                               f"client_{transport}.msgpack"),
                       seed=0, **agent_addrs)
-        # Count the REAL wire payloads (serialized trajectory bytes) by
-        # wrapping the transport's send, and the REAL env steps by
-        # wrapping request_for_action (one call per step) — dividing one
-        # by the other then reports the TRUE per-step wire cost,
-        # framing/scalar overhead included, instead of a byte-derived
-        # step estimate that would be circular.
-        inner_send = agent.transport.send_trajectory
-        inner_step = agent.request_for_action
+        # Shared instrumentation (relayrl_tpu/utils/instrument.py):
+        # real serialized payload bytes + real env steps — their ratio
+        # is the TRUE per-step wire cost, framing/scalar overhead
+        # included (a byte-derived step estimate would be circular).
+        from relayrl_tpu.utils.instrument import instrument_agent
 
-        def counting_send(raw: bytes):
-            wire["bytes"] += len(raw)
-            wire["sends"] += 1
-            return inner_send(raw)
-
-        def counting_step(obs, **kw):
-            wire["steps"] += 1
-            return inner_step(obs, **kw)
-
-        agent.transport.send_trajectory = counting_send
-        agent.request_for_action = counting_step
+        wire = instrument_agent(agent)
         try:
             env = _env()
             while server.stats["updates"] < updates:

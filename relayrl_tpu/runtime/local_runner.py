@@ -9,6 +9,7 @@ in-process transport for integration tests.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Mapping
 
 import numpy as np
@@ -63,6 +64,12 @@ class LocalRunner:
         )
         self.seed = seed
         self.updates = 0
+        # Rolling window across train() calls: per-call windows can be
+        # as short as a handful of episodes for off-policy families
+        # (updates land ~every episode), letting an early-stop target
+        # trigger on a lucky streak. 50 episodes is the SpinningUp-style
+        # smoothing horizon.
+        self._recent_returns: deque[float] = deque(maxlen=50)
 
     def run_episode(self, max_steps: int = 1000) -> tuple[float, int]:
         obs, _ = self.env.reset(seed=None)
@@ -103,11 +110,16 @@ class LocalRunner:
         while self.updates < target_updates:
             ep_ret, _ = self.run_episode(max_steps)
             returns.append(ep_ret)
-        window = returns[-min(len(returns), 50):]
+            self._recent_returns.append(ep_ret)
         return {
             "episodes": len(returns),
             "updates": self.updates,
-            "avg_return_last_window": float(np.mean(window)),
+            # Mean over the PERSISTENT 50-episode window, not just this
+            # call's episodes — a train(epochs=5) chunk may contain only
+            # ~5 episodes for off-policy families, and early-stop
+            # targets read this value (a 5-episode window stops on luck;
+            # the committed SAC golden's first run did exactly that).
+            "avg_return_last_window": float(np.mean(self._recent_returns)),
             "returns": returns,
         }
 

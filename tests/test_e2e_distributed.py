@@ -365,24 +365,12 @@ def test_uint8_pixel_frames_cross_the_wire_byte_sized(tmp_cwd):
                      "traj_per_epoch": 2, "minibatch_count": 1,
                      "train_iters": 1},
         **server_addrs)
-    wire = {"bytes": 0, "steps": 0}
     try:
         agent = Agent(server_type="zmq", handshake_timeout_s=30,
                       seed=0, **agent_addrs)
-        inner_send = agent.transport.send_trajectory
-        inner_step = agent.request_for_action
+        from relayrl_tpu.utils.instrument import instrument_agent
 
-        def counting_send(raw):
-            wire["bytes"] += len(raw)
-            return inner_send(raw)
-
-        agent.transport.send_trajectory = counting_send
-
-        def counting_step(obs, **kw):
-            wire["steps"] += 1
-            return inner_step(obs, **kw)
-
-        agent.request_for_action = counting_step
+        wire = instrument_agent(agent)  # shared with bench_pixel_wire
         try:
             env = make_atari("synthetic", frame_size=frame,
                              frame_stack=stack, frame_skip=2,

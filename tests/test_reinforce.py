@@ -41,6 +41,46 @@ class TestMechanics:
     def test_registry(self):
         assert "REINFORCE" in registered_algorithms()
 
+    def test_train_window_persists_across_calls(self, tmp_cwd):
+        """The early-stop window must span train() calls: a per-call
+        window can be as short as ~5 episodes for off-policy families,
+        and a --target stop on it triggers on a lucky streak (the SAC
+        LunarLander golden's first run did exactly that)."""
+        from relayrl_tpu.runtime import LocalRunner
+
+        from relayrl_tpu.envs.spaces import Box, Discrete
+
+        class FixedReturnEnv:
+            """Each episode returns a scripted total reward."""
+
+            def __init__(self, rewards):
+                self._rewards = list(rewards)
+                self._t = 0
+                self.observation_space = Box(-1.0, 1.0, (4,), np.float32)
+                self.action_space = Discrete(2)
+
+            def reset(self, seed=None):
+                self._t = 0
+                self._r = self._rewards.pop(0)
+                return np.zeros(4, np.float32), {}
+
+            def step(self, action):
+                self._t += 1
+                return (np.zeros(4, np.float32), float(self._r),
+                        self._t >= 1, False, {})
+
+        # 1-step episodes with scripted returns: call 1 sees all-zeros,
+        # call 2 sees all-hundreds. A per-call window would report 100.
+        env = FixedReturnEnv([0.0] * 4 + [100.0] * 4 + [0.0] * 99)
+        runner = LocalRunner(env, algorithm_name="REINFORCE",
+                             traj_per_epoch=1, hidden_sizes=[8],
+                             with_vf_baseline=False, env_dir=str(tmp_cwd))
+        r1 = runner.train(epochs=4)
+        assert r1["avg_return_last_window"] == 0.0
+        r2 = runner.train(epochs=4)
+        # persistent 50-episode window: (4*0 + 4*100) / 8
+        assert r2["avg_return_last_window"] == 50.0
+
     def test_trains_after_traj_per_epoch(self, algo):
         assert algo.receive_trajectory(_episode(5, seed=1)) is False
         assert algo.version == 0
