@@ -28,7 +28,15 @@ import argparse
 import multiprocessing as mp
 import os
 import socket
+import sys
 import time
+
+# Importable as a script from anywhere (parity with train_local.py /
+# train_atari.py); spawn-context actor subprocesses re-execute this
+# module top-level, so they get the same path fix.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def free_port() -> int:
