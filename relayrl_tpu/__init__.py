@@ -35,7 +35,8 @@ __all__ = [
 def __getattr__(name):
     # Lazy imports for heavyweight submodules so `import relayrl_tpu` stays
     # cheap in actor processes that only need types + config.
-    if name in ("TrainingServer", "Agent", "LocalRunner"):
+    if name in ("TrainingServer", "Agent", "LocalRunner",
+                "ApplicationAbstract"):
         from relayrl_tpu import runtime
 
         return getattr(runtime, name)

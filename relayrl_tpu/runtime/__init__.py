@@ -1,9 +1,10 @@
 """Runtime processes: actors, local runner, training server."""
 
+from relayrl_tpu.runtime.application import ApplicationAbstract
 from relayrl_tpu.runtime.policy_actor import PolicyActor
 from relayrl_tpu.runtime.local_runner import LocalRunner
 
-__all__ = ["PolicyActor", "LocalRunner"]
+__all__ = ["ApplicationAbstract", "PolicyActor", "LocalRunner"]
 
 
 def __getattr__(name):

@@ -1,0 +1,135 @@
+"""ApplicationAbstract: the user-app contract + its canonical loop.
+
+Reference parity: _common/_examples/BaseApplication.py:4-31 defines the
+three-method contract its examples subclass; ours additionally ships the
+loop (drive_episode), so these tests pin the loop's wire-visible
+behavior — reward credit, terminal flags, truncation bootstrapping, and
+mask routing — against the actual serialized trajectory.
+"""
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.runtime import ApplicationAbstract
+from relayrl_tpu.runtime.policy_actor import PolicyActor
+from relayrl_tpu.types.model_bundle import ModelBundle
+from relayrl_tpu.types.trajectory import deserialize_actions
+
+OBS_DIM, ACT_DIM = 3, 2
+
+
+class CountdownEnv:
+    """raw state = steps remaining; reward 1.0 per step; terminates at 0."""
+
+    def __init__(self, n=4):
+        self.n = n
+
+    def reset(self):
+        self.left = self.n
+        return self.left
+
+    def step(self, act):
+        self.left -= 1
+        return self.left, 1.0, self.left == 0, False
+
+
+class EndlessEnv(CountdownEnv):
+    """Never terminates on its own — exercises the max_steps truncation."""
+
+    def step(self, act):
+        self.left -= 1
+        return self.left, 1.0, False, False
+
+
+class CountdownApp(ApplicationAbstract):
+    def __init__(self, agent, terminal_bonus=0.0, with_mask=False):
+        super().__init__(agent)
+        self.terminal_bonus = terminal_bonus
+        self.with_mask = with_mask
+        self.built = 0
+
+    def run_application(self, env, episodes=1, max_steps=None):
+        return [self.drive_episode(env, max_steps=max_steps)
+                for _ in range(episodes)]
+
+    def build_observation(self, raw):
+        self.built += 1
+        obs = np.full(OBS_DIM, float(raw), np.float32)
+        if self.with_mask:
+            return obs, np.ones(ACT_DIM, np.float32)
+        return obs
+
+    def calculate_performance_return(self, last_reward, *, terminated,
+                                     truncated):
+        return last_reward + (self.terminal_bonus if terminated else 0.0)
+
+
+@pytest.fixture
+def actor():
+    import jax
+
+    from relayrl_tpu.models import build_policy
+
+    arch = {"kind": "mlp_discrete", "obs_dim": OBS_DIM, "act_dim": ACT_DIM,
+            "hidden_sizes": [8]}
+    policy = build_policy(arch)
+    params = policy.init_params(jax.random.PRNGKey(0))
+    sent: list[bytes] = []
+    a = PolicyActor(ModelBundle(version=1, arch=arch, params=params),
+                    max_traj_length=100, on_send=sent.append, seed=0)
+    a._sent = sent
+    return a
+
+
+def _records(actor):
+    assert len(actor._sent) == 1, "episode should send exactly one trajectory"
+    return deserialize_actions(actor._sent[0])
+
+
+def test_contract_is_abstract():
+    with pytest.raises(TypeError):
+        ApplicationAbstract(agent=None)  # all three methods abstract
+
+
+def test_episode_wire_shape_and_reward_credit(actor):
+    app = CountdownApp(actor)
+    (total,) = app.run_application(CountdownEnv(4), episodes=1)
+    assert total == 4.0
+    recs = _records(actor)
+    # 4 acting records + terminal marker
+    assert len(recs) == 5 and recs[-1].done and not recs[-1].truncated
+    # rewards for actions 1..3 are back-attached on the next request; the
+    # LAST action's reward rides the terminal marker (the learner's fold
+    # credits it back — the same wire convention test_reward_alignment pins)
+    assert [float(r.rew) for r in recs] == [1.0, 1.0, 1.0, 0.0, 1.0]
+    # observations follow the raw countdown 4,3,2,1
+    assert [float(r.obs[0]) for r in recs[:-1]] == [4.0, 3.0, 2.0, 1.0]
+    # genuine terminal: no successor obs forwarded
+    assert recs[-1].obs is None
+
+
+def test_terminal_shaping_reaches_the_wire(actor):
+    app = CountdownApp(actor, terminal_bonus=10.0)
+    app.run_application(CountdownEnv(2), episodes=1)
+    recs = _records(actor)
+    assert float(recs[-1].rew) == 11.0  # last_reward 1.0 + bonus
+
+
+def test_truncation_forwards_final_obs(actor):
+    app = CountdownApp(actor)
+    (total,) = app.run_application(EndlessEnv(10), episodes=1, max_steps=3)
+    assert total == 3.0
+    recs = _records(actor)
+    assert recs[-1].done and recs[-1].truncated
+    # successor state (raw 10-3=7) forwarded for bootstrapping
+    assert recs[-1].obs is not None and float(recs[-1].obs[0]) == 7.0
+
+
+def test_mask_tuple_routes_to_requests(actor):
+    app = CountdownApp(actor, with_mask=True)
+    app.run_application(CountdownEnv(2), episodes=1)
+    recs = _records(actor)
+    for r in recs[:-1]:
+        assert r.mask is not None and r.mask.shape == (ACT_DIM,)
+    # truncation-free terminal: mask not forwarded either
+    assert recs[-1].mask is None
