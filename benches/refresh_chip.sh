@@ -1,19 +1,29 @@
 #!/usr/bin/env bash
 # Refresh every chip-side benchmark artifact in one pass — run whenever a
-# TPU backend is reachable (the r4 flash/ring/conv work landed while the
-# tunnel was down, so attention.json + learner_tpu.json predate it).
+# TPU backend is reachable.
 #
 #   bash benches/refresh_chip.sh            # full refresh
+#   bash benches/refresh_chip.sh headline   # headline capture only
+#
+# ORDERING MATTERS: the tunnel dies without warning mid-run (it killed
+# the r5 autotune sweep twice in one day), so steps run most-important
+# first — the bench.py headline is the official perf record and goes
+# before the shootouts; the 64-cell autotune sweep is the longest and
+# flakiest and goes last. Every artifact is written temp+mv so a
+# mid-run death can't clobber good committed numbers with a partial
+# file.
 #
 # Produces/updates (committed artifacts):
+#   benches/results/headline_chip_<date>.json  the bench.py chip record
+#                                     (cited by bench.py's degraded
+#                                     fallback when the tunnel is down)
 #   benches/results/attention.json    flash vs dense vs blockwise vs
 #                                     flash_chunked{2,4} (ring cost model)
 #   benches/results/learner_tpu.json  per-family updates/s + MFU rows,
 #                                     incl. cnn_pixel_tpu_trunk (the
 #                                     conv_spec="tpu" lift) and the
 #                                     reworked-flash transformer rows
-#   plus a bench.py headline line on stdout (the driver records its own
-#   BENCH_r*.json; compare against benches/results/headline_chip_r4.json).
+#   benches/results/flash_autotune.json  (block_q, block_kv) sweep
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -25,11 +35,36 @@ assert d and d[0].platform != "cpu", f"no accelerator: {d}"
 print("devices:", d)
 EOF
 
-# emit() prints JSON lines to stdout; the committed artifacts are those
-# lines captured (grep guards against stray non-JSON stdout). Write to a
-# temp file and mv only on success: this script exists BECAUSE the
-# tunnel is flaky, and a mid-run death must not clobber the good
-# committed numbers with a partial file.
+echo "== headline (driver-shaped line; persisted as the chip record) =="
+( cd .. && python bench.py ) | tee results/.headline.tmp
+# Persist the live-chip line as the newest headline_chip record so
+# bench.py's degraded fallback cites THIS capture if the tunnel later
+# dies (the citation loads the newest headline_chip* by mtime).
+python - <<'EOF'
+import json
+line = open("results/.headline.tmp").read().strip().splitlines()[-1]
+rec = json.loads(line)
+if not rec.get("degraded"):
+    import datetime
+    now = datetime.datetime.now(datetime.timezone.utc)
+    rec.setdefault("config", {})["captured_at"] = now.strftime(
+        "%Y-%m-%dT%H:%MZ")
+    rec["config"]["how"] = "python bench.py via benches/refresh_chip.sh"
+    # Date-stamped name (never a hardcoded round): successive refreshes
+    # accumulate instead of clobbering, and bench.py's degraded citation
+    # picks the newest by mtime.
+    out = f"results/headline_chip_{now.strftime('%Y%m%d')}.json"
+    with open(out, "w") as f:
+        json.dump(rec, f)
+    print(f"chip headline persisted -> {out}")
+else:
+    print("headline came back DEGRADED; not persisting a chip record")
+EOF
+rm -f results/.headline.tmp
+if [[ "${1:-}" == "headline" ]]; then
+    exit 0
+fi
+
 echo "== attention shootout -> results/attention.json =="
 python bench_attention.py | grep '^{' | tee results/.attention.json.tmp
 mv results/.attention.json.tmp results/attention.json
@@ -41,30 +76,3 @@ mv results/.learner_tpu.json.tmp results/learner_tpu.json
 
 echo "== flash block/head-dim autotune -> results/flash_autotune.json =="
 RELAYRL_BENCH_TPU=1 python bench_flash_autotune.py --write | grep '^{'
-
-echo "== headline (driver-shaped line; persisted as the chip record) =="
-cd .. && python bench.py | tee benches/results/.headline.tmp
-# Persist the live-chip line as the newest headline_chip record so
-# bench.py's degraded fallback cites THIS capture if the tunnel later
-# dies (the citation loads the lexicographically newest headline_chip*).
-python - <<'EOF'
-import json
-line = open("benches/results/.headline.tmp").read().strip().splitlines()[-1]
-rec = json.loads(line)
-if not rec.get("degraded"):
-    import datetime
-    now = datetime.datetime.now(datetime.timezone.utc)
-    rec.setdefault("config", {})["captured_at"] = now.strftime(
-        "%Y-%m-%dT%H:%MZ")
-    rec["config"]["how"] = "python bench.py via benches/refresh_chip.sh"
-    # Date-stamped name (never a hardcoded round): successive refreshes
-    # accumulate instead of clobbering, and bench.py's degraded citation
-    # picks the newest by mtime.
-    out = f"benches/results/headline_chip_{now.strftime('%Y%m%d')}.json"
-    with open(out, "w") as f:
-        json.dump(rec, f)
-    print(f"chip headline persisted -> {out}")
-else:
-    print("headline came back DEGRADED; not persisting a chip record")
-EOF
-rm -f benches/results/.headline.tmp
