@@ -33,7 +33,7 @@ class LocalRunner:
         algorithm_name: str = "REINFORCE",
         config_path: str | None = None,
         env_dir: str | None = None,
-        seed: int = 0,
+        seed: int | None = None,
         **hyperparams,
     ):
         self.env = env
@@ -43,6 +43,16 @@ class LocalRunner:
             if hasattr(env.action_space, "n")
             else int(np.prod(env.action_space.shape))
         )
+        # An explicit seed seeds BOTH sides: the actor's sampling stream
+        # below and the learner's init/update stream (as the algorithm
+        # `seed` hyperparam, unless one was passed separately) — so
+        # `--hp seed=N` runs land in `..._sN` log dirs and vary the whole
+        # pipeline, not just action sampling. The learner additionally
+        # folds in a per-process salt (base.py: `seed_salt`, default pid,
+        # mirroring the reference's `seed + 10000*pid`), so two runs at
+        # the same seed are independent unless seed_salt is pinned too.
+        if seed is not None:
+            hyperparams.setdefault("seed", seed)
         self.algorithm = build_algorithm(
             algorithm_name,
             env_dir=env_dir,
@@ -60,7 +70,7 @@ class LocalRunner:
             self.algorithm.bundle(),
             max_traj_length=buckets[-1] if buckets else 1000,
             on_send=self._episode_bytes.append,
-            seed=seed,
+            seed=0 if seed is None else seed,
         )
         self.seed = seed
         self.updates = 0

@@ -81,6 +81,45 @@ class TestMechanics:
         # persistent 50-episode window: (4*0 + 4*100) / 8
         assert r2["avg_return_last_window"] == 50.0
 
+    def test_runner_seed_reaches_the_learner(self, tmp_cwd):
+        """An explicit LocalRunner seed must seed BOTH sides of the
+        pipeline. Historically `--hp seed=N` was swallowed by the
+        runner's own `seed` kwarg and only varied actor-side action
+        sampling: the learner stayed at its default seed (the logs of
+        two 'seed' runs both landing in `..._s1` dirs was the tell)."""
+        import json
+        import os.path as osp
+
+        from relayrl_tpu.envs.spaces import Box, Discrete
+        from relayrl_tpu.runtime import LocalRunner
+
+        class OneStepEnv:
+            observation_space = Box(-1.0, 1.0, (4,), np.float32)
+            action_space = Discrete(2)
+
+            def reset(self, seed=None):
+                return np.zeros(4, np.float32), {}
+
+            def step(self, action):
+                return np.zeros(4, np.float32), 0.0, True, False, {}
+
+        runner = LocalRunner(OneStepEnv(), "REINFORCE", seed=7,
+                             traj_per_epoch=1, hidden_sizes=[8],
+                             with_vf_baseline=False, env_dir=str(tmp_cwd))
+        out = runner.algorithm.logger.output_dir
+        assert osp.basename(out).endswith("_s7"), out
+        cfg = json.load(open(osp.join(out, "config.json")))
+        assert cfg["seed"] == 7
+        # An explicit algorithm-level seed hyperparam still wins.
+        runner2 = LocalRunner(OneStepEnv(), "REINFORCE", seed=7, seed_salt=0,
+                              traj_per_epoch=1, hidden_sizes=[8],
+                              with_vf_baseline=False, env_dir=str(tmp_cwd),
+                              logger_kwargs={
+                                  "output_dir": str(tmp_cwd / "lg2")})
+        cfg2 = json.load(open(osp.join(
+            runner2.algorithm.logger.output_dir, "config.json")))
+        assert cfg2["seed"] == 7 and cfg2["seed_salt"] == 0
+
     def test_trains_after_traj_per_epoch(self, algo):
         assert algo.receive_trajectory(_episode(5, seed=1)) is False
         assert algo.version == 0
