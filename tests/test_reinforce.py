@@ -120,6 +120,28 @@ class TestMechanics:
             runner2.algorithm.logger.output_dir, "config.json")))
         assert cfg2["seed"] == 7 and cfg2["seed_salt"] == 0
 
+    def test_pinned_seed_is_bit_deterministic(self, tmp_cwd):
+        """seed + seed_salt pin the learner init exactly (base.py
+        promises identical seeds give identical initial state); a
+        different seed must actually move the params."""
+        import jax
+        import jax.numpy as jnp
+
+        def build(seed, tag):
+            return build_algorithm(
+                "REINFORCE", obs_dim=4, act_dim=2, traj_per_epoch=1,
+                hidden_sizes=[8], with_vf_baseline=False,
+                seed=seed, seed_salt=0,
+                logger_kwargs={"output_dir": str(tmp_cwd / tag)})
+
+        a, b, c = build(7, "a"), build(7, "b"), build(8, "c")
+        flat_a = jax.tree_util.tree_leaves(a.state.params)
+        flat_b = jax.tree_util.tree_leaves(b.state.params)
+        flat_c = jax.tree_util.tree_leaves(c.state.params)
+        assert all(jnp.array_equal(x, y) for x, y in zip(flat_a, flat_b))
+        assert any(not jnp.array_equal(x, y)
+                   for x, y in zip(flat_a, flat_c))
+
     def test_trains_after_traj_per_epoch(self, algo):
         assert algo.receive_trajectory(_episode(5, seed=1)) is False
         assert algo.version == 0
