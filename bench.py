@@ -226,8 +226,12 @@ def bench_transformer(warmup: int = 2, iters: int = 8) -> dict | None:
     tx = optax.chain(optax.clip_by_global_norm(40.0), optax.adam(3e-4))
     state = ImpalaState(params=params, opt_state=tx.init(params),
                         rng=jax.random.PRNGKey(1), step=jnp.int32(0))
+    # donate_argnums=0 matches the MLP headline jit above and the
+    # production jit in algorithms/impala.py — without it XLA keeps the
+    # old transformer state alive across every update (jaxlint JAX05).
     update = jax.jit(
-        make_impala_update(policy, 3e-4, 0.99, 0.5, 0.01, 1.0, 1.0, 40.0))
+        make_impala_update(policy, 3e-4, 0.99, 0.5, 0.01, 1.0, 1.0, 40.0),
+        donate_argnums=0)
 
     rng = np.random.default_rng(0)
     batch = {

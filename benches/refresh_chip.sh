@@ -39,7 +39,8 @@ echo "== headline (driver-shaped line; persisted as the chip record) =="
 ( cd .. && python bench.py ) | tee results/.headline.tmp
 # Persist the live-chip line as the newest headline_chip record so
 # bench.py's degraded fallback cites THIS capture if the tunnel later
-# dies (the citation loads the newest headline_chip* by mtime).
+# dies (the citation picks the headline_chip* record with the newest
+# embedded captured_at stamp — mtime is meaningless on fresh clones).
 python - <<'EOF'
 import json
 line = open("results/.headline.tmp").read().strip().splitlines()[-1]
@@ -52,7 +53,8 @@ if not rec.get("degraded"):
     rec["config"]["how"] = "python bench.py via benches/refresh_chip.sh"
     # Date-stamped name (never a hardcoded round): successive refreshes
     # accumulate instead of clobbering, and bench.py's degraded citation
-    # picks the newest by mtime.
+    # sorts the records by their embedded captured_at stamp (written
+    # above) and cites the newest.
     out = f"results/headline_chip_{now.strftime('%Y%m%d')}.json"
     with open(out, "w") as f:
         json.dump(rec, f)

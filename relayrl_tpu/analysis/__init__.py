@@ -1,0 +1,49 @@
+"""relayrl_tpu.analysis — jaxlint, a JAX-aware static-analysis pass.
+
+The reference prototype shipped with zero correctness tooling; this
+framework's hot paths are exactly the JAX surface where silent hazards
+(PRNG key reuse, host syncs under jit, retrace storms, un-donated update
+buffers) degrade into throughput cliffs that benchmarks only catch after
+the fact. jaxlint is the CI gate that catches them at review time.
+
+Usage::
+
+    python -m relayrl_tpu.analysis                 # lint the framework
+    python -m relayrl_tpu.analysis path/ --no-baseline
+    python -m relayrl_tpu.analysis --list-rules
+
+Suppress one line with ``# jaxlint: disable=JAX01`` (same line or the
+line above); grandfathered findings live in ``baseline.json`` next to
+this file. See ``docs/static_analysis.md`` for the rule catalog.
+
+The analyzer itself is stdlib-only and never imports jax, so the gate
+runs on accelerator-free CI hosts; importing it as a subpackage pulls
+only the framework's lightweight types/config layer (numpy + msgpack).
+"""
+
+from relayrl_tpu.analysis.cli import main  # noqa: F401
+from relayrl_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Rule,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from relayrl_tpu.analysis.rules import all_rules, rules_by_code  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "analyze_source",
+    "analyze_file",
+    "analyze_paths",
+    "load_baseline",
+    "write_baseline",
+    "apply_baseline",
+    "all_rules",
+    "rules_by_code",
+    "main",
+]

@@ -1,0 +1,8 @@
+"""``python -m relayrl_tpu.analysis`` — the jaxlint CLI entry point."""
+
+import sys
+
+from relayrl_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

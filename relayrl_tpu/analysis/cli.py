@@ -1,0 +1,152 @@
+"""jaxlint command line.
+
+    python -m relayrl_tpu.analysis [paths...] [options]
+
+Exit codes: 0 = clean (every finding baselined or none), 1 = new
+findings, 2 = bad invocation. The default baseline is the committed
+``relayrl_tpu/analysis/baseline.json``; CI runs the bare default
+invocation and any *new* finding fails the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+from relayrl_tpu.analysis.engine import (
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from relayrl_tpu.analysis.rules import all_rules
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def _default_scan_root() -> str:
+    """The installed relayrl_tpu package — so a bare ``python -m
+    relayrl_tpu.analysis`` lints the framework itself from any cwd."""
+    import relayrl_tpu
+
+    return os.path.dirname(os.path.abspath(relayrl_tpu.__file__))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m relayrl_tpu.analysis",
+        description="jaxlint: JAX-aware static analysis for relayrl_tpu",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "installed relayrl_tpu package)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON of grandfathered findings "
+                        f"(default: {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring any baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline file "
+                        "and exit 0 (requires an explicit --baseline "
+                        "PATH — never overwrites the default silently)")
+    p.add_argument("--select", default=None, metavar="CODES",
+                   help="comma-separated rule codes to run (default all)")
+    p.add_argument("--ignore", default=None, metavar="CODES",
+                   help="comma-separated rule codes to skip")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def _pick_rules(select: str | None, ignore: str | None):
+    rules = all_rules()
+    if select:
+        wanted = {c.strip().upper() for c in select.split(",") if c.strip()}
+        unknown = wanted - {r.code for r in rules}
+        if unknown:
+            raise SystemExit(
+                f"unknown rule code(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.code in wanted]
+    if ignore:
+        dropped = {c.strip().upper() for c in ignore.split(",") if c.strip()}
+        rules = [r for r in rules if r.code not in dropped]
+    return rules
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.code}  {rule.name}: {rule.description}")
+        return 0
+
+    try:
+        rules = _pick_rules(args.select, args.ignore)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    paths = args.paths or [_default_scan_root()]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"no such path: {path}", file=sys.stderr)
+            return 2
+
+    findings = analyze_paths(paths, rules=rules)
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        if args.baseline is None:
+            # Any scan (bare default included) sees only its own slice of
+            # the gate's coverage; writing it to the shared default
+            # baseline would silently drop grandfathered entries from
+            # every path outside this scan. Rewriting the committed
+            # baseline must name it explicitly.
+            print("--write-baseline requires an explicit --baseline PATH "
+                  "(refusing to overwrite the shared default baseline "
+                  "with this scan's findings)", file=sys.stderr)
+            return 2
+        write_baseline(baseline_path, findings)
+        if not args.quiet:
+            print(f"baseline: wrote {len(findings)} finding(s) to "
+                  f"{baseline_path}")
+        return 0
+
+    baseline = {}
+    if not args.no_baseline and os.path.isfile(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, OSError, KeyError, TypeError) as e:
+            # exit 2 = bad invocation; 1 is reserved for "new findings"
+            print(f"cannot read baseline {baseline_path}: {e!r} — fix or "
+                  f"regenerate it with --write-baseline", file=sys.stderr)
+            return 2
+    new, matched, stale = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": matched,
+            "stale_baseline_entries": [list(k) for k in stale],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        if not args.quiet:
+            for rule, path, snippet in stale:
+                print(f"note: stale baseline entry {rule} @ {path} "
+                      f"({snippet[:60]!r}) — fixed code, prune it with "
+                      f"--write-baseline")
+            print(f"jaxlint: {len(new)} new finding(s), {matched} "
+                  f"baselined, {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}, "
+                  f"{len(rules)} rule(s) active")
+    return 1 if new else 0

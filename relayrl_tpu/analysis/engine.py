@@ -1,0 +1,471 @@
+"""jaxlint rule engine: AST module model, finding type, suppression,
+baseline matching, and the file/directory driver.
+
+This module is pure stdlib (``ast`` + ``json``) on purpose: linting must
+never require jax — CI can gate a PR on hosts with no accelerator stack.
+(Reaching it as ``relayrl_tpu.analysis`` still executes the package root,
+which imports the lightweight types/config layer: numpy + msgpack, the
+package's base deps — but never jax/flax/optax.)
+
+The unit of identity for a finding is ``(rule, path, stripped source
+line)`` — NOT the line number. Line numbers churn on every unrelated
+edit; the snippet-keyed baseline survives code motion the way
+pylint/ruff per-line suppression cannot (idea borrowed from
+mypy/ruff ``--add-noqa`` baselines and Google's Tricorder).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Iterator, Mapping, Sequence
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "ModuleInfo",
+    "analyze_source",
+    "analyze_file",
+    "analyze_paths",
+    "load_baseline",
+    "write_baseline",
+    "apply_baseline",
+    "qualname",
+]
+
+# Calls that wrap a python function into a traced/compiled one.
+JIT_WRAPPERS = frozenset({
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.named_call",
+})
+
+# Control-flow primitives whose function arguments are traced bodies.
+TRACED_HOF = frozenset({
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+})
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``snippet`` (the stripped source line) is part of
+    the identity so baselines survive line-number churn."""
+
+    rule: str       # stable code, e.g. "JAX01"
+    name: str       # human slug, e.g. "prng-key-reuse"
+    path: str       # posix-style path as reported (relative when possible)
+    line: int
+    col: int
+    message: str
+    snippet: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}[{self.name}] {self.message}")
+
+
+class Rule:
+    """Base class. Subclasses set ``code``/``name``/``description`` and
+    yield ``(node, message)`` from :meth:`check`; the engine attaches
+    location, snippet and suppression handling."""
+
+    code: str = "XXX00"
+    name: str = "abstract-rule"
+    description: str = ""
+
+    def check(self, module: "ModuleInfo") -> Iterator[tuple[ast.AST, str]]:
+        raise NotImplementedError
+
+    def finding(self, module: "ModuleInfo", node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        snippet = ""
+        if 1 <= line <= len(module.lines):
+            snippet = module.lines[line - 1].strip()
+        return Finding(rule=self.code, name=self.name, path=module.path,
+                       line=line, col=col, message=message, snippet=snippet)
+
+
+def walk_skip_nested_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a node's subtree without descending into nested
+    def/lambda/class bodies (they execute in a different context). The
+    shared helper for every rule that reasons about "what runs here"."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        yield from walk_skip_nested_functions(child)
+
+
+def qualname(node: ast.AST) -> str | None:
+    """Dotted name of a Name/Attribute chain (``self.x.y`` -> "self.x.y"),
+    or None for anything not expressible as one."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    """Parsed module plus the cross-rule facts every rule needs:
+    import aliases, which function names are jit-wrapped, and which
+    FunctionDef nodes execute under a trace."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.aliases = self._collect_aliases(tree)
+        # Function NAMES wrapped by jax.jit(...) somewhere in the module
+        # (``self._update = jax.jit(update, ...)`` records "update").
+        self.jit_wrapped_names: set[str] = set()
+        # Dotted names of jit-compiled CALLABLES — the assignment targets
+        # (``self._update``, ``fn``) — consumed by the timing rule.
+        self.jitted_callables: set[str] = set()
+        # All jit-wrapper call sites: (call, wrapped_arg, target_qualname).
+        self.jit_calls: list[tuple[ast.Call, ast.AST, str | None]] = []
+        self._collect_jit_facts(tree)
+        self.traced_functions = self._collect_traced_functions(tree)
+
+    # -- import alias resolution --
+    @staticmethod
+    def _collect_aliases(tree: ast.Module) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, dotted: str | None) -> str | None:
+        """Expand the leading segment through the module's import aliases
+        (``jnp.mean`` -> "jax.numpy.mean", ``jit`` -> "jax.jit")."""
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        expanded = self.aliases.get(head, head)
+        return f"{expanded}.{rest}" if rest else expanded
+
+    def resolved_call(self, node: ast.Call) -> str | None:
+        return self.resolve(qualname(node.func))
+
+    # -- jit topology --
+    def _collect_jit_facts(self, tree: ast.Module) -> None:
+        seen: set[int] = set()
+        for node in ast.walk(tree):
+            target: str | None = None
+            call: ast.Call | None = None
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                if len(node.targets) == 1:
+                    target = qualname(node.targets[0])
+            elif isinstance(node, ast.Call):
+                call = node
+            if call is None or self.resolved_call(call) not in JIT_WRAPPERS:
+                continue
+            if id(call) in seen:  # the Assign wrapper already recorded it
+                continue
+            seen.add(id(call))
+            wrapped = call.args[0] if call.args else None
+            if wrapped is None:
+                for kw in call.keywords:
+                    if kw.arg in ("fun", "f"):
+                        wrapped = kw.value
+            if wrapped is None:
+                continue
+            self.jit_calls.append((call, wrapped, target))
+            if target:
+                self.jitted_callables.add(target)
+            if isinstance(wrapped, ast.Name):
+                self.jit_wrapped_names.add(wrapped.id)
+
+    def is_jit_decorator(self, dec: ast.AST) -> bool:
+        name = self.resolve(qualname(dec))
+        if name in JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            inner = self.resolve(qualname(dec.func))
+            if inner in JIT_WRAPPERS:
+                return True
+            # functools.partial(jax.jit, ...) as a decorator factory
+            if inner in ("functools.partial", "partial") and dec.args:
+                return self.resolve(qualname(dec.args[0])) in JIT_WRAPPERS
+        return False
+
+    def jit_decorator_call(self, fn: ast.AST) -> ast.Call | None:
+        """The decorator Call carrying jit kwargs, when present."""
+        for dec in getattr(fn, "decorator_list", []):
+            if isinstance(dec, ast.Call) and self.is_jit_decorator(dec):
+                return dec
+        return None
+
+    def _collect_traced_functions(self, tree: ast.Module) -> set[ast.AST]:
+        """FunctionDefs that execute under jax tracing: jit-decorated,
+        jit-wrapped by name, passed to a lax control-flow primitive, or
+        lexically nested inside any of those."""
+        hof_arg_names: set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and self.resolved_call(node) in TRACED_HOF):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        hof_arg_names.add(arg.id)
+
+        traced: set[ast.AST] = set()
+
+        def visit(node: ast.AST, inside: bool) -> None:
+            here = inside
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                direct = (
+                    node.name in self.jit_wrapped_names
+                    or node.name in hof_arg_names
+                    or any(self.is_jit_decorator(d)
+                           for d in node.decorator_list)
+                )
+                here = inside or direct
+                if here:
+                    traced.add(node)
+            elif isinstance(node, ast.Lambda) and inside:
+                traced.add(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, here)
+
+        visit(tree, False)
+        return traced
+
+
+# -- suppression ---------------------------------------------------------
+
+def _suppressed_rules(lines: Sequence[str], line: int) -> set[str]:
+    """Rule codes/slugs disabled for ``line`` (1-based): an end-of-line
+    ``# jaxlint: disable=...`` comment, or a COMMENT-ONLY preceding line
+    (a trailing disable on the previous code line covers that line only —
+    it must not leak onto the next one). Only the first word of each
+    comma-separated token counts, so a trailing reason
+    (``disable=IMP01 - entry script``) doesn't defeat the suppression."""
+
+    def collect(text: str) -> None:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            for token in m.group(1).split(","):
+                words = token.strip().split()
+                if words:
+                    out.add(words[0].lower())
+
+    out: set[str] = set()
+    if 1 <= line <= len(lines):
+        collect(lines[line - 1])
+    prev = line - 2
+    if 0 <= prev < len(lines) and lines[prev].lstrip().startswith("#"):
+        collect(lines[prev])
+    return out
+
+
+def _is_suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    disabled = _suppressed_rules(lines, finding.line)
+    return bool(disabled & {"all", finding.rule.lower(),
+                            finding.name.lower()})
+
+
+# -- drivers -------------------------------------------------------------
+
+def _default_rules() -> list[Rule]:
+    from relayrl_tpu.analysis.rules import all_rules
+
+    return all_rules()
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Sequence[Rule] | None = None) -> list[Finding]:
+    """Run the rules over one source string. Syntax errors surface as a
+    single ``PARSE`` finding instead of an exception, so one broken file
+    can't hide every other file's findings in a directory scan."""
+    rules = list(rules) if rules is not None else _default_rules()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="PARSE", name="syntax-error", path=path,
+                        line=e.lineno or 1, col=(e.offset or 0) + 1,
+                        message=f"cannot parse: {e.msg}", snippet="")]
+    module = ModuleInfo(path=path, source=source, tree=tree)
+    findings: list[Finding] = []
+    for rule in rules:
+        for node, message in rule.check(module):
+            f = rule.finding(module, node, message)
+            if not _is_suppressed(f, module.lines):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_file(path: str | os.PathLike, display_path: str | None = None,
+                 rules: Sequence[Rule] | None = None) -> list[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    shown = display_path if display_path is not None else str(path)
+    return analyze_source(source, path=shown.replace(os.sep, "/"),
+                          rules=rules)
+
+
+# Directories that never hold first-party source: linting a checkout
+# root must not descend into virtualenvs, build trees, or tool caches
+# (thousands of third-party findings would drown the real ones).
+_PRUNE_DIRS = frozenset({
+    "__pycache__", "build", "dist", "node_modules",
+    ".venv", "venv", "env", ".eggs",
+})
+
+
+def iter_python_files(root: str | os.PathLike) -> Iterator[str]:
+    root = str(root)
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        # prune hidden dirs (.git, .tox, .mypy_cache, .claude, ...) and
+        # the well-known non-source trees; an explicitly passed root is
+        # unaffected (pruning applies to children only)
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not d.startswith(".") and d not in _PRUNE_DIRS)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+_REPO_MARKERS = (".git", "pyproject.toml", "setup.py")
+
+
+def _enclosing_repo_root(path: str) -> str | None:
+    """Nearest ancestor directory carrying a repo marker, or None."""
+    cur = path if os.path.isdir(path) else os.path.dirname(path)
+    while True:
+        if any(os.path.exists(os.path.join(cur, m)) for m in _REPO_MARKERS):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def analyze_paths(paths: Sequence[str | os.PathLike],
+                  rules: Sequence[Rule] | None = None) -> list[Finding]:
+    """Scan files/directories. Baseline keys must come out identical no
+    matter how — or from where — the same file is reached, so reported
+    paths are anchored at the enclosing REPO root (nearest ancestor with
+    a ``.git``/``pyproject.toml``/``setup.py`` marker): ``relayrl_tpu/``,
+    ``.``, and ``tests/x.py`` all key ``tests/x.py`` whether the scan
+    runs from the repo root or a subdirectory. Outside any repo, a root
+    under the cwd anchors at the cwd, and anything else falls back to its
+    own parent directory (stable across checkouts, though same-named
+    loose files from different out-of-tree parents can collide — scan
+    the directory if that matters)."""
+    rules = list(rules) if rules is not None else _default_rules()
+    findings: list[Finding] = []
+    cwd = os.getcwd()
+    for root in paths:
+        root_abs = os.path.abspath(str(root))
+        base = _enclosing_repo_root(root_abs)
+        if base is None:
+            if root_abs == cwd or root_abs.startswith(cwd + os.sep):
+                base = cwd
+            else:
+                base = os.path.dirname(root_abs)
+        for file in iter_python_files(root_abs):
+            display = os.path.relpath(file, base)
+            findings.extend(analyze_file(file, display_path=display,
+                                         rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# -- baseline ------------------------------------------------------------
+
+def load_baseline(path: str | os.PathLike) -> dict[tuple[str, str, str], int]:
+    """Baseline file -> multiset of finding keys ({key: count})."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: dict[tuple[str, str, str], int] = {}
+    for entry in data.get("findings", []):
+        key = (str(entry["rule"]), str(entry["path"]),
+               str(entry["snippet"]))
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def write_baseline(path: str | os.PathLike,
+                   findings: Iterable[Finding]) -> None:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"rule": rule, "path": p, "snippet": snippet, "count": n}
+        for (rule, p, snippet), n in sorted(counts.items())
+    ]
+    payload = {
+        "version": 1,
+        "tool": "jaxlint",
+        "comment": ("Grandfathered findings. Entries are keyed by "
+                    "(rule, path, stripped source line) so they survive "
+                    "line-number churn; regenerate with --write-baseline "
+                    "and keep this file shrinking."),
+        "findings": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding],
+    baseline: Mapping[tuple[str, str, str], int],
+) -> tuple[list[Finding], int, list[tuple[str, str, str]]]:
+    """Split findings into (new, matched_count, stale_keys).
+
+    Each baseline entry absorbs up to ``count`` findings with the same
+    key; the remainder are new. Keys present in the baseline but absent
+    from the scan are stale — fixed code whose entry should be pruned.
+    """
+    remaining = dict(baseline)
+    new: list[Finding] = []
+    matched = 0
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0)
+    return new, matched, stale

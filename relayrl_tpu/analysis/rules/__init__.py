@@ -1,0 +1,38 @@
+"""jaxlint rule registry.
+
+Rules are grouped by the layer they police:
+
+* :mod:`jax_rules` — tracing/PRNG/dispatch hazards in jitted code
+  (the throughput cliffs Podracer-class TPU RL stacks die on).
+* :mod:`concurrency_rules` — runtime/transport thread hazards.
+* :mod:`import_rules` — import-time side effects.
+
+Adding a rule: subclass :class:`relayrl_tpu.analysis.engine.Rule` in the
+right module, give it a unique ``code`` + ``name``, yield
+``(ast_node, message)`` pairs from ``check``, append it to that module's
+``RULES`` list, and add a positive + negative snippet to
+``tests/test_jaxlint.py`` (the registry test enforces code uniqueness).
+"""
+
+from __future__ import annotations
+
+from relayrl_tpu.analysis.engine import Rule
+from relayrl_tpu.analysis.rules.concurrency_rules import RULES as _CONC
+from relayrl_tpu.analysis.rules.import_rules import RULES as _IMP
+from relayrl_tpu.analysis.rules.jax_rules import RULES as _JAX
+
+__all__ = ["all_rules", "rules_by_code"]
+
+
+def all_rules() -> list[Rule]:
+    """Fresh instances of every registered rule, stable order."""
+    return [cls() for cls in (*_JAX, *_CONC, *_IMP)]
+
+
+def rules_by_code() -> dict[str, Rule]:
+    out: dict[str, Rule] = {}
+    for rule in all_rules():
+        if rule.code in out:
+            raise ValueError(f"duplicate rule code {rule.code}")
+        out[rule.code] = rule
+    return out

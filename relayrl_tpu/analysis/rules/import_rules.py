@@ -1,0 +1,78 @@
+"""Import-hygiene rules.
+
+``import relayrl_tpu.anything`` must stay side-effect free: actor
+processes import types+config only (the lazy ``__getattr__`` in the
+package root exists for exactly this), and a module-level backend query
+binds the process to a device topology before the runtime has a chance
+to configure it (hostpin.py documents the one sanctioned exception).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from relayrl_tpu.analysis.engine import ModuleInfo, Rule
+
+_DEVICE_CALLS = frozenset({
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.default_backend",
+    "jax.config.update",
+    "jax.distributed.initialize",
+})
+
+# Files whose whole job is import-time environment setup.
+_EXEMPT_BASENAMES = frozenset({"__init__.py", "conftest.py"})
+
+
+class ModuleLevelDeviceTouch(Rule):
+    """``jax.devices()`` / ``jax.config.update`` at module scope runs at
+    import time: it initializes the backend (grabbing the TPU for this
+    process) or mutates global config for every importer. Both belong
+    inside functions, called by whoever owns process setup."""
+
+    code = "IMP01"
+    name = "module-level-device-touch"
+    description = ("module-scope jax.devices()/jax.config mutation "
+                   "outside __init__")
+
+    def check(self, module: ModuleInfo) -> Iterator[tuple[ast.AST, str]]:
+        basename = module.path.rsplit("/", 1)[-1]
+        if basename in _EXEMPT_BASENAMES:
+            return
+        for node in self._module_scope_nodes(module.tree.body):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolved_call(node)
+            if resolved in _DEVICE_CALLS:
+                yield node, (
+                    f"`{resolved}` at module scope runs at import time — "
+                    f"it initializes/binds the jax backend (or mutates "
+                    f"global config) for every importer; move it inside "
+                    f"a function on the process-setup path")
+
+    def _module_scope_nodes(self, stmts) -> Iterator[ast.AST]:
+        """Every node that executes at import time: the module body plus
+        module-level if/try/with/for blocks and class bodies (a
+        class-scope device default is the same hazard) — but nothing
+        inside function or lambda bodies, which run later."""
+
+        def walk(node: ast.AST) -> Iterator[ast.AST]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk(child)
+
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield stmt
+            yield from walk(stmt)
+
+
+RULES = [ModuleLevelDeviceTouch]

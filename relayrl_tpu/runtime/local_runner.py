@@ -44,13 +44,15 @@ class LocalRunner:
             else int(np.prod(env.action_space.shape))
         )
         # An explicit seed seeds BOTH sides: the actor's sampling stream
-        # below and the learner's init/update stream (as the algorithm
-        # `seed` hyperparam, unless one was passed separately) — so
-        # `--hp seed=N` runs land in `..._sN` log dirs and vary the whole
-        # pipeline, not just action sampling. The learner additionally
-        # folds in a per-process salt (base.py: `seed_salt`, default pid,
-        # mirroring the reference's `seed + 10000*pid`), so two runs at
-        # the same seed are independent unless seed_salt is pinned too.
+        # below and the learner's init/update stream (forwarded as the
+        # algorithm `seed` hyperparam, which trumps any config-file seed
+        # — explicit overrides always win over config params in
+        # build_algorithm) — so `--hp seed=N` runs land in `..._sN` log
+        # dirs and vary the whole pipeline, not just action sampling.
+        # Only `seed_salt` is independent of this seed: the learner folds
+        # in that per-process salt (default pid, mirroring the
+        # reference's `seed + 10000*pid`), so two runs at the same seed
+        # are independent unless seed_salt is pinned too.
         if seed is not None:
             hyperparams.setdefault("seed", seed)
         self.algorithm = build_algorithm(

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-checkout entry point for jaxlint (no install required).
+
+    python scripts/jaxlint.py [paths...] [options]
+
+Equivalent to ``python -m relayrl_tpu.analysis`` from the repo root;
+see that module (and docs/static_analysis.md) for the rule catalog,
+suppression syntax, and baseline workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from relayrl_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

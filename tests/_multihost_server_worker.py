@@ -60,6 +60,9 @@ os.environ["RELAYRL_PROCESS_ID"] = str(rank)
 
 import jax  # noqa: E402
 
+# Entry script (never imported): the CPU pin must land at module scope,
+# before anything touches the backend.
+# jaxlint: disable=IMP01
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
@@ -257,6 +260,7 @@ assert server.distributed_info == {
     "multi_host": True, "process_id": rank,
     "num_processes": NUM_PROCS}, server.distributed_info
 assert (server.transport is not None) == (rank == 0)
+# jaxlint: disable=IMP01 — entry script, backend is already initialized
 assert jax.device_count() == 4 * NUM_PROCS
 
 p1 = -1.0

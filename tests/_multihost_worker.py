@@ -29,9 +29,12 @@ os.environ["XLA_FLAGS"] = (
     + os.environ.get("XLA_FLAGS", ""))
 
 # A sitecustomize may have imported jax (snapshotting the platform) before
-# this script ran; force the live config too.
+# this script ran; force the live config too. Module-scope on purpose:
+# this file is a subprocess ENTRY SCRIPT, never imported, and the config
+# must land before anything touches the backend.
 import jax  # noqa: E402
 
+# jaxlint: disable=IMP01
 jax.config.update("jax_platforms", "cpu")
 
 from relayrl_tpu.parallel import (  # noqa: E402
@@ -54,7 +57,10 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 assert jax.process_count() == 2
+# Entry script: querying the freshly-initialized backend here IS the test.
+# jaxlint: disable=IMP01
 assert len(jax.devices()) == 8, jax.devices()
+# jaxlint: disable=IMP01
 assert len(jax.local_devices()) == 4
 
 from relayrl_tpu.algorithms.reinforce import (  # noqa: E402

@@ -115,7 +115,10 @@ def test_sharded_update_runs_and_matches_single_device(mesh_spec):
         "ret": rng.standard_normal((8, 5)).astype(np.float32),
     }
 
-    # single-device reference
+    # single-device reference; no donation — `state` is placed on the mesh
+    # below and must survive this call (the sharded side also runs
+    # donate_state=False for the same reason).
+    # jaxlint: disable=JAX05
     ref_state, ref_metrics = jax.jit(update)(state, {k: jnp.asarray(v) for k, v in batch.items()})
 
     mesh = make_mesh(mesh_spec)

@@ -63,7 +63,10 @@ class TestPPOUpdate:
         # γ=0 → adv = r - V(s): a clean per-step signal (γ>0 with last_val=0
         # injects truncation-bootstrap bias that swamps the action signal on
         # this synthetic fixed batch); no KL early stop.
-        update = jax.jit(self._update(target_kl=10.0, gamma=0.0))
+        # donate_argnums=0 mirrors the production jit (algorithms/ppo.py),
+        # so the 15-update chain exercises the donated-buffer path too.
+        update = jax.jit(self._update(target_kl=10.0, gamma=0.0),
+                         donate_argnums=0)
         batch = {k: jnp.asarray(v) for k, v in _batch(self.policy).items()}
         state = self.state
         evaluate = jax.jit(self.policy.evaluate)
@@ -85,7 +88,7 @@ class TestPPOUpdate:
         assert int(state.step) == 15
 
     def test_metrics_shape_and_finiteness(self):
-        update = jax.jit(self._update())
+        update = jax.jit(self._update(), donate_argnums=0)
         batch = {k: jnp.asarray(v) for k, v in _batch(self.policy).items()}
         _, metrics = update(self.state, batch)
         for key in ("LossPi", "LossV", "KL", "Entropy", "ClipFrac",
@@ -95,11 +98,14 @@ class TestPPOUpdate:
 
     def test_kl_early_stop_freezes_policy(self):
         # target_kl=-1 → KL > 1.5*target_kl is true from the FIRST minibatch,
-        # so pi params must be bitwise-frozen after minibatch 1 while vf
-        # keeps training. minibatch_count=1 makes every minibatch the full
+        # so pi params must be frozen after minibatch 1 while vf keeps
+        # training. minibatch_count=1 makes every minibatch the full
         # batch (permutation-invariant), so a 4-iter run and a 1-iter run
         # share minibatch 1 exactly: identical pi subtrees ⇔ no post-stop
-        # movement (Adam momentum must NOT keep moving them).
+        # movement (Adam momentum must NOT keep moving them). "Identical"
+        # is up to reduction-order noise (~1 ULP on some builds) — a real
+        # post-stop Adam step at lr=1e-2 moves params ~1e-4, four orders
+        # above the tolerance below.
         batch = {k: jnp.asarray(v) for k, v in _batch(self.policy).items()}
 
         state_a, metrics = jax.jit(
@@ -119,7 +125,8 @@ class TestPPOUpdate:
         a = jax.tree.leaves(pi_leaves(state_a.params))
         b = jax.tree.leaves(pi_leaves(state_b.params))
         for x, y in zip(a, b):
-            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6, atol=1e-9)
         # vf params must differ — value training continued past the stop
         va = jax.tree.leaves({k: v for k, v in state_a.params["params"].items()
                               if k.startswith("vf")})
@@ -130,12 +137,15 @@ class TestPPOUpdate:
 
     def test_tiny_clip_bounds_update(self):
         update = jax.jit(self._update(clip_ratio=1e-8, train_iters=1,
-                                      minibatch_count=1))
+                                      minibatch_count=1), donate_argnums=0)
         batch = {k: jnp.asarray(v) for k, v in _batch(self.policy).items()}
         state1, _ = update(self.state, batch)
         # With ratio clipped to ~1 the surrogate has (near-)zero gradient
         # beyond the first-order term; policy change should be minuscule
         # compared to an unclipped step.
+        # `base` below reads self.state.params AFTER this call, so the
+        # input buffers must stay alive — donation would invalidate them.
+        # jaxlint: disable=JAX05
         update_free = jax.jit(self._update(clip_ratio=10.0, train_iters=1,
                                            minibatch_count=1))
         self.setup_method()

@@ -110,7 +110,10 @@ class TestMechanics:
         assert osp.basename(out).endswith("_s7"), out
         cfg = json.load(open(osp.join(out, "config.json")))
         assert cfg["seed"] == 7
-        # An explicit algorithm-level seed hyperparam still wins.
+        # seed_salt rides through independently of the runner seed: the
+        # salt is pinned while the same seed still reaches the learner.
+        # (There is no separate algorithm-level seed path to exercise —
+        # LocalRunner's own `seed` kwarg IS the override it forwards.)
         runner2 = LocalRunner(OneStepEnv(), "REINFORCE", seed=7, seed_salt=0,
                               traj_per_epoch=1, hidden_sizes=[8],
                               with_vf_baseline=False, env_dir=str(tmp_cwd),
