@@ -321,6 +321,78 @@ def bench_torch_reference(iters: int = 3, trials: int = 3) -> float:
     return best_of(trials, one_trial)
 
 
+def profile_stages(epochs: int = 6) -> dict:
+    """Per-stage timing breakdown of the pipelined learner hot path
+    (``--profile``): decode → assemble → H2D → device → publish, seconds
+    per epoch, appended to the bench JSON so BENCH_r* trajectories can
+    attribute a headline regression to a stage instead of re-deriving it
+    from scratch. Each stage is timed in isolation with an explicit
+    fence where the work is asynchronous (device dispatch, H2D), so the
+    numbers are attributable even though the production path overlaps
+    them on purpose."""
+    import tempfile
+
+    import jax
+
+    from relayrl_tpu.algorithms import build_algorithm
+    from relayrl_tpu.types.action import ActionRecord
+    from relayrl_tpu.types.trajectory import (
+        deserialize_actions,
+        serialize_actions,
+    )
+
+    obs_dim, act_dim, tpe, ep_len = 32, 8, 8, 128
+    rng = np.random.default_rng(0)
+    payloads = []
+    for s in range(epochs * tpe):
+        payloads.append(serialize_actions([
+            ActionRecord(
+                obs=rng.standard_normal(obs_dim).astype(np.float32),
+                act=np.int64(rng.integers(act_dim)), rew=float(rng.random()),
+                data={"logp_a": np.float32(-0.69), "v": np.float32(0.0)},
+                done=(i == ep_len - 1))
+            for i in range(ep_len)]))
+
+    algo = build_algorithm(
+        "REINFORCE", obs_dim=obs_dim, act_dim=act_dim, traj_per_epoch=tpe,
+        hidden_sizes=[128, 128], with_vf_baseline=True, seed_salt=0,
+        logger_kwargs={"output_dir": tempfile.mkdtemp()})
+    algo.warmup()
+
+    stages = {"decode_s": 0.0, "assemble_s": 0.0, "h2d_s": 0.0,
+              "device_s": 0.0, "publish_s": 0.0}
+    for raw in payloads:
+        t0 = time.perf_counter()
+        episode = deserialize_actions(raw)
+        stages["decode_s"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        batch = algo.accumulate(episode)
+        stages["assemble_s"] += time.perf_counter() - t0
+        if batch is None:
+            continue
+
+        t0 = time.perf_counter()
+        staged = jax.block_until_ready(algo.stage_batch(batch))
+        stages["h2d_s"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        metrics = algo.train_on_batch(staged)
+        jax.block_until_ready(metrics.device)
+        stages["device_s"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        algo.snapshot_for_publish().to_bundle().to_bytes()
+        stages["publish_s"] += time.perf_counter() - t0
+
+    return {
+        "epochs": epochs, "traj_per_epoch": tpe, "episode_len": ep_len,
+        "obs_dim": obs_dim, "act_dim": act_dim,
+        "per_epoch_ms": {k[:-2]: round(v / epochs * 1e3, 3)
+                         for k, v in stages.items()},
+    }
+
+
 def main():
     platform = _ensure_live_backend()
     degraded = platform == "cpu"
@@ -402,6 +474,16 @@ def main():
                 result["transformer_flash"] = t
         except Exception as exc:  # never block the headline
             print(f"bench: transformer secondary failed ({exc!r})",
+                  file=sys.stderr, flush=True)
+    if "--profile" in sys.argv:
+        # Per-stage breakdown (decode/assemble/H2D/device/publish) rides
+        # in the same JSON line so a headline regression in a future
+        # round points at a stage, not just a number.
+        try:
+            result["stage_profile"] = profile_stages(
+                epochs=3 if degraded else 6)
+        except Exception as exc:  # never block the headline
+            print(f"bench: stage profile failed ({exc!r})",
                   file=sys.stderr, flush=True)
     print(json.dumps(result))
 

@@ -75,6 +75,19 @@ class AlgorithmBase(abc.ABC):
     # first increment materializes the instance counter.
     dropped_nonfinite = 0
 
+    # Bounded async-dispatch window (runtime/pipeline.InflightWindow);
+    # class defaults so pre-existing subclasses/tests that never touch
+    # the pipeline keep working. max_inflight_updates=0 restores the
+    # fully synchronous fence-every-dispatch behavior.
+    max_inflight_updates = 2
+    _inflight = None
+    # Host-side mirror of state.step: once updates dispatch async,
+    # reading int(state.step) fences the whole in-flight window, so the
+    # publish path needs a version that never touches the device. None
+    # until the first dispatch (or after a checkpoint restore) — it
+    # re-syncs from the (then resolved) device step before dispatching.
+    _dispatched_updates = None
+
     def _drop_nonfinite(self) -> None:
         """Count + log one trajectory rejected by the finite-value guard —
         the single owner of the drop policy for both algorithm families
@@ -154,6 +167,90 @@ class AlgorithmBase(abc.ABC):
         import jax
 
         return jax.process_count() > 1
+
+    @property
+    def inflight(self) -> "InflightWindow":
+        """The dispatched-but-unfenced update window, created lazily so
+        algorithms built before any training pay nothing. One per
+        instance: every family's ``train_on_batch`` pushes its update's
+        metric leaves here, which (a) bounds how far the host runs ahead
+        of the device and (b) is the fence ledger the server's
+        ``drain()`` and the staging-buffer reuse proof rely on."""
+        if self._inflight is None:
+            from relayrl_tpu.runtime.pipeline import InflightWindow
+
+            self._inflight = InflightWindow(self.max_inflight_updates)
+        return self._inflight
+
+    def _sync_version_mirror(self) -> None:
+        """Initialize the host-side step mirror BEFORE the first async
+        dispatch — at that point ``state.step`` is resolved (construction
+        or checkpoint restore both finish synchronously), so the one
+        ``int()`` here is free; after dispatching it would fence."""
+        if self._dispatched_updates is None:
+            self._dispatched_updates = int(self.version)
+
+    @property
+    def dispatched_version(self) -> int:
+        """Model version including dispatched-but-unfenced updates —
+        what an async publish stamps on its snapshot (``version`` reads
+        the device and would fence the in-flight window)."""
+        if self._dispatched_updates is not None:
+            return self._dispatched_updates
+        return int(self.version)
+
+    def snapshot_for_publish(self):
+        """Cheap, non-blocking publish handoff: a device-to-device copy
+        of the publishable params (dispatched async — the copy runs
+        after the last queued update, so it observes it) stamped with
+        the host-side version mirror. The publisher thread turns it into
+        a :class:`~relayrl_tpu.types.ModelBundle` with the blocking
+        ``device_get`` off the learner thread. Single-host only:
+        multi-host publish is a collective ``bundle()`` on every rank.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from relayrl_tpu.runtime.pipeline import PublishSnapshot
+
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            self._publish_params())
+        return PublishSnapshot(version=self.dispatched_version,
+                               arch=self._publish_arch(), params=params)
+
+    def _publish_params(self):
+        """The param slice a published bundle carries (on-policy: full
+        policy params; off-policy: the actor slice)."""
+        raise NotImplementedError
+
+    def _publish_arch(self) -> dict:
+        """Arch shipped with the bundle (hook for annealing knobs)."""
+        return self.arch
+
+    def capture_epoch_stats(self, updated: bool):
+        """Snapshot-and-reset the host counters an epoch log needs, at
+        DISPATCH time — when the server defers ``log_epoch`` behind the
+        in-flight window, episodes arriving for the *next* epoch must
+        not leak into this epoch's row. Returns an opaque payload for
+        ``log_epoch(stats=...)``, or None when no log is due."""
+        return None
+
+    def stage_batch(self, host_batch) -> dict:
+        """Prefetch an assembled host batch to the device ahead of
+        dispatch. ``jax.device_put`` enqueues the H2D copy without
+        waiting, so a batch staged while the previous update still runs
+        overlaps its transfer with device compute instead of paying it
+        inside the (window-fenced) dispatch path. ``_to_device`` passes
+        already-placed arrays through untouched, so a staged batch and a
+        host batch are interchangeable downstream. Single-host only —
+        mesh placement (``_place``) already owns multihost batches."""
+        import jax
+
+        place = getattr(self, "_place", None)
+        if place is not None:
+            return place(dict(host_batch))
+        return jax.device_put(dict(host_batch))
 
     def _to_device(self, host_batch) -> dict:
         """The single owner of host-batch → device-batch placement

@@ -92,6 +92,19 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self.updates_per_dispatch = max(
             1, int(params.get("updates_per_dispatch", 1)))
         self._update_k = None  # compiled lazily on first fused dispatch
+        # Async-dispatch window (runtime/pipeline): how many updates may
+        # be dispatched-but-unfenced. 0 = fence every dispatch.
+        self.max_inflight_updates = int(params.get(
+            "max_inflight_updates",
+            learner.get("max_inflight_updates", 2)))
+        # Persistent sample staging (zero-alloc steady state): sampled
+        # batches write into a ring of reusable host buffers instead of
+        # eight fresh fancy-index allocations per draw. Ring slots are
+        # reused only after (round + window + 1) further draws — by then
+        # the update that consumed the slot has been fenced by the
+        # in-flight window (same proof as EpochBuffer's staging slabs).
+        self._sample_ring: list[dict] = []
+        self._sample_slot = 0
         self.traj_per_epoch = int(params.get("traj_per_epoch", 8))
         seed = int(params.get("seed", 1))
         # Param init is deterministic given the seed (reproducible learners);
@@ -195,26 +208,34 @@ class OffPolicyAlgorithm(AlgorithmBase):
         """Run the due updates, fusing groups of ``updates_per_dispatch``
         into single jitted dispatches; the remainder (and the K=1 or
         multi-host cases) go through the per-batch path."""
-        from relayrl_tpu.parallel.distributed import is_coordinator
-
         k = self.updates_per_dispatch
         i, n = 0, len(host_batches)
         # _place is the mesh-aware [B, ...] placement — fused stacks are
         # [K, B, ...] and multi-host updates are one-batch collectives,
         # so fusion is single-host only.
         while k > 1 and self._place is None and n - i >= k:
+            from relayrl_tpu.runtime.pipeline import LazyMetrics
+
             chunk = host_batches[i:i + k]
-            stacked = {key: np.stack([np.asarray(b[key]) for b in chunk])
-                       for key in chunk[0]}
+            # Device-prefetched batches stack ON DEVICE (async dispatch):
+            # np.stack on a just-uploaded jax.Array would block on the
+            # H2D, read it back, and re-upload the stack — a fence on the
+            # dispatch-only thread.
+            stacked = {
+                key: (jnp.stack([b[key] for b in chunk])
+                      if isinstance(chunk[0][key], jax.Array)
+                      else np.stack([np.asarray(b[key]) for b in chunk]))
+                for key in chunk[0]}
+            self._sync_version_mirror()
             self.state, ms = self._fused_update()(
                 self.state, self._to_device(stacked))
-            ms = {key: np.asarray(v) for key, v in ms.items()}
-            self._last_metrics = {key: float(v[-1]) for key, v in ms.items()}
-            if is_coordinator():
-                # keep per-update logger semantics: K rows, not one
-                for j in range(k):
-                    self.logger.store(
-                        **{key: float(v[j]) for key, v in ms.items()})
+            self._dispatched_updates += k
+            # Per-row device slices dispatch lazily — no host readback on
+            # the dispatch path; resolution happens where the values are
+            # read (log_epoch / a test's _last_metrics access).
+            self._last_metrics = LazyMetrics(
+                {key: v[-1] for key, v in ms.items()})
+            self.inflight.push(ms)
             i += k
         for b in host_batches[i:]:
             self.train_on_batch(b)
@@ -222,18 +243,23 @@ class OffPolicyAlgorithm(AlgorithmBase):
 
     def train_on_batch(self, host_batch: Mapping[str, Any]
                        ) -> Mapping[str, float]:
-        """One jitted update on a sampled transition batch. Multi-host:
-        every process calls this with the same (broadcast) batch — the
-        replay buffer itself stays coordinator-side."""
+        """One jitted update on a sampled transition batch, dispatched
+        asynchronously (metrics resolve lazily; the in-flight window
+        bounds outstanding updates). Multi-host: every process calls
+        this with the same (broadcast) batch — the replay buffer itself
+        stays coordinator-side."""
+        from relayrl_tpu.runtime.pipeline import LazyMetrics
+
+        self._sync_version_mirror()
         self.state, metrics = self._update(self.state,
                                            self._to_device(host_batch))
-        self._last_metrics = {k: float(v) for k, v in metrics.items()}
-        from relayrl_tpu.parallel.distributed import is_coordinator
-
-        if is_coordinator():
-            # Non-coordinators never dump_tabular, so storing there would
-            # only accumulate unread rows.
-            self.logger.store(**self._last_metrics)
+        self._dispatched_updates += 1
+        self._last_metrics = LazyMetrics(metrics)
+        self.inflight.push(metrics)
+        # No logger.store here (the old per-update rows were never
+        # consumed: log_epoch passes explicit values to log_tabular, so
+        # the stored lists only grew for the life of the process — and as
+        # device scalars they would also pin XLA buffers).
         return self._last_metrics
 
     # -- multi-host contract (server broadcast loop; SURVEY §7.4 item 5) --
@@ -272,7 +298,28 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._update_debt += stored * self.updates_per_step
         n = min(self.max_updates_per_ingest, max(1, int(self._update_debt)))
         self._update_debt = max(0.0, self._update_debt - n)
-        return [self.buffer.sample(self.batch_size) for _ in range(n)]
+        return [self._sample_staged(n) for _ in range(n)]
+
+    def _sample_staged(self, round_size: int) -> dict:
+        """One sampled batch written into a reusable staging slot (no
+        per-draw allocation). Falls back to fresh allocations on a
+        multi-process mesh, where the broadcast loop may queue batches
+        (``_mh_ready``) long enough for the ring to lap them."""
+        if self._place is not None or self._sample_ring is None:
+            return self.buffer.sample(self.batch_size)
+        # One in-flight WINDOW ENTRY covers up to updates_per_dispatch
+        # batches (a fused dispatch pushes once for k consumed batches),
+        # so the reuse distance must count batches, not dispatches:
+        # while W entries are unfenced, W*k slots may still be feeding
+        # async H2D transfers.
+        need = (round_size
+                + self.max_inflight_updates * self.updates_per_dispatch + 1)
+        while len(self._sample_ring) < need:
+            self._sample_ring.append(
+                self.buffer.make_sample_out(self.batch_size))
+        self._sample_slot = (self._sample_slot + 1) % len(self._sample_ring)
+        return self.buffer.sample(self.batch_size,
+                                  out=self._sample_ring[self._sample_slot])
 
     def mh_zero_batch(self, b: int, t: int) -> dict:
         """Placeholder transition batch matching :meth:`StepReplayBuffer.
@@ -334,6 +381,19 @@ class OffPolicyAlgorithm(AlgorithmBase):
         if self._traj_since_log >= self.traj_per_epoch:
             self.log_epoch()
 
+    def capture_epoch_stats(self, updated: bool):
+        """A log is due on trajectory cadence — even without an update
+        (pre-``update_after`` warmup still logs). Pops the episode
+        counters NOW so the deferred log row matches what the old
+        synchronous path would have printed."""
+        if self._traj_since_log < self.traj_per_epoch:
+            return None
+        stats = (self._ep_returns or [0.0], self._ep_lengths or [0],
+                 self.buffer.total_steps)
+        self._ep_returns, self._ep_lengths = [], []
+        self._traj_since_log = 0
+        return stats
+
     def enable_multihost(self, mesh) -> None:
         """Re-compile the update over a (possibly multi-process) mesh and
         place the state on it; see OnPolicyAlgorithm.enable_multihost."""
@@ -350,23 +410,41 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._place = lambda b: place_batch(b, mesh)
         self._gather_params = jax.jit(lambda p: p,
                                       out_shardings=replicated(mesh))
+        # Collective steps fence every rank anyway; and _mh_ready may
+        # hold sampled batches unboundedly, so staging reuse is unsafe.
+        self.max_inflight_updates = 0
+        self._inflight = None  # rebuilt (sync) on next use
+        self._sample_ring = None
 
-    def log_epoch(self) -> None:
+    def log_epoch(self, stats=None, metrics=None) -> None:
+        """``stats``/``metrics`` are deferred :meth:`capture_epoch_stats`
+        payloads (the pipelined server logs an epoch only after its
+        update's fence, by which time ``_last_metrics`` may already
+        belong to a newer update); without them the counters pop here
+        and the latest metrics apply (the direct/synchronous path)."""
+        if stats is None:
+            stats = (self._ep_returns or [0.0], self._ep_lengths or [0],
+                     self.buffer.total_steps)
+            self._ep_returns, self._ep_lengths = [], []
+            self._traj_since_log = 0
+        if metrics is None:
+            metrics = self._last_metrics
+        rets, lens, total_steps = stats
         self.epoch += 1
-        self._traj_since_log = 0
-        self.logger.store(EpRet=self._ep_returns or [0.0],
-                          EpLen=self._ep_lengths or [0])
-        self._ep_returns, self._ep_lengths = [], []
+        self.logger.store(EpRet=rets, EpLen=lens)
         self.logger.log_tabular("Epoch", self.epoch)
         self.logger.log_tabular("EpRet", with_min_and_max=True)
         self.logger.log_tabular("EpLen", average_only=True)
-        self.logger.log_tabular("TotalEnvInteracts", self.buffer.total_steps)
+        self.logger.log_tabular("TotalEnvInteracts", total_steps)
         for key in self._metric_keys():
-            self.logger.log_tabular(key, self._last_metrics.get(key, 0.0))
+            self.logger.log_tabular(key, metrics.get(key, 0.0))
         self.logger.dump_tabular()
 
     def save(self, path=None) -> None:
         self.bundle().save(path or self.server_model_path)
+
+    def _publish_params(self):
+        return self._actor_params()
 
     def bundle(self) -> ModelBundle:
         """Multi-host: params may be sharded across processes; the jitted

@@ -63,6 +63,13 @@ class OnPolicyAlgorithm(AlgorithmBase):
         # Subclass: sets self.arch, self.policy, self.state, self._update.
         self._setup(params, learner, rng)
 
+        # Async-dispatch window (runtime/pipeline): how many updates may
+        # be dispatched-but-unfenced. 0 = fence every dispatch (the old
+        # synchronous behavior).
+        self.max_inflight_updates = int(params.get(
+            "max_inflight_updates",
+            learner.get("max_inflight_updates", 2)))
+
         self.buffer = EpochBuffer(
             obs_dim=self.obs_dim,
             act_dim=self.act_dim,
@@ -75,6 +82,10 @@ class OnPolicyAlgorithm(AlgorithmBase):
                 "bucket_lengths",
                 learner.get("bucket_lengths", (64, 256, 1000))),
             max_traj_length=loader.get_max_traj_length(),
+            # Staging slabs are reused after (window + 1) drains — by
+            # then the window has fenced the update that consumed the
+            # slab (see EpochBuffer.drain's reuse contract).
+            staging_slots=self.max_inflight_updates + 1,
         )
 
         lk = dict(logger_kwargs) if logger_kwargs else setup_logger_kwargs(
@@ -141,11 +152,20 @@ class OnPolicyAlgorithm(AlgorithmBase):
 
     def train_on_batch(self, host_batch: Mapping[str, Any]) -> Mapping[str, float]:
         """One jitted update on an assembled batch dict (host or device
-        arrays). Multi-host: every process must call this with the same
-        batch (see the server's broadcast loop)."""
+        arrays), dispatched asynchronously: metrics come back as a
+        :class:`~relayrl_tpu.runtime.pipeline.LazyMetrics` that fences
+        only when read (``log_epoch``/``stats``), and the in-flight
+        window bounds how far dispatch runs ahead of the device.
+        Multi-host: every process must call this with the same batch
+        (see the server's broadcast loop)."""
+        from relayrl_tpu.runtime.pipeline import LazyMetrics
+
+        self._sync_version_mirror()
         self.state, metrics = self._update(self.state,
                                            self._to_device(host_batch))
-        self._last_metrics = {k: float(v) for k, v in metrics.items()}
+        self._dispatched_updates += 1
+        self._last_metrics = LazyMetrics(metrics)
+        self.inflight.push(metrics)
         return self._last_metrics
 
     def train_model(self) -> Mapping[str, float]:
@@ -198,6 +218,13 @@ class OnPolicyAlgorithm(AlgorithmBase):
         self._update = make_sharded_update(self._update, mesh, self.state)
         self.state = place_state(self.state, mesh)
         self._place = lambda b: place_batch(b, mesh)
+        # The broadcast loop queues assembled batches (_mh_ready) for an
+        # unbounded time before training them — staging-slab reuse would
+        # corrupt them — and its step is a collective that fences every
+        # rank anyway, so async dispatch buys nothing there.
+        self.buffer.disable_staging()
+        self.max_inflight_updates = 0
+        self._inflight = None  # rebuilt (sync) on next use
         # One jitted params gather, reused by every bundle() call (a fresh
         # lambda per call would retrace + recompile the all-gather each
         # publish).
@@ -206,19 +233,40 @@ class OnPolicyAlgorithm(AlgorithmBase):
         self._gather_params = jax.jit(lambda p: p,
                                       out_shardings=replicated(mesh))
 
-    def log_epoch(self) -> None:
-        rets, lens = self.buffer.pop_episode_stats()
+    def capture_epoch_stats(self, updated: bool):
+        """One update == one epoch for this family: a log is due exactly
+        when an update dispatched. Pops the episode stats NOW so
+        episodes arriving while the update is still in flight land in
+        the next epoch's row, not this one's."""
+        if not updated:
+            return None
+        return self.buffer.pop_episode_stats()
+
+    def log_epoch(self, stats=None, metrics=None) -> None:
+        """``stats``/``metrics`` are deferred :meth:`capture_epoch_stats`
+        payloads (the pipelined server logs an epoch only after its
+        update's fence, by which time ``_last_metrics`` may already
+        belong to a newer update); without them the episode stats pop
+        here and the latest metrics apply (the direct/synchronous
+        path). Reading the metrics is what fences the update."""
+        rets, lens = (self.buffer.pop_episode_stats() if stats is None
+                      else stats)
+        if metrics is None:
+            metrics = self._last_metrics
         self.epoch += 1
         self.logger.store(EpRet=rets or [0.0], EpLen=lens or [0])
         self.logger.log_tabular("Epoch", self.epoch)
         self.logger.log_tabular("EpRet", with_min_and_max=True)
         self.logger.log_tabular("EpLen", average_only=True)
         for key in self._log_keys():
-            self.logger.log_tabular(key, self._last_metrics.get(key, 0.0))
+            self.logger.log_tabular(key, metrics.get(key, 0.0))
         self.logger.dump_tabular()
 
     def save(self, path=None) -> None:
         self.bundle().save(path or self.server_model_path)
+
+    def _publish_params(self):
+        return self.state.params
 
     def bundle(self) -> ModelBundle:
         """Serialize the current policy for actors.

@@ -188,6 +188,9 @@ def restore_algorithm(algo, directory: str | None = None,
             f"checkpoint arch {extra.get('arch')} != algorithm arch {algo.arch}")
     algo.state = jax.device_put(state)
     algo.epoch = int(extra.get("epoch", 0))
+    # The async-publish version mirror (base.py _dispatched_updates)
+    # re-syncs from the restored step before the next dispatch.
+    algo._dispatched_updates = None
     if aux is not None:
         algo.restore_aux(aux)
     mgr.close()

@@ -182,6 +182,21 @@ DEFAULT_CONFIG: dict = {
         # transition_bytes per save — raise this for big buffers so only
         # every Nth periodic checkpoint carries experience.
         "checkpoint_aux_every": 1,
+        # -- pipelined learner hot path (docs/architecture.md) --
+        # Dispatched-but-unfenced updates the learner thread may run
+        # ahead of the device; 0 restores the synchronous fence-every-
+        # update behavior (and shrinks the staging-slab ring to 1).
+        "max_inflight_updates": 2,
+        # Model publish (params gather + serialize + socket + artifact
+        # write) on a dedicated latest-wins thread; false publishes
+        # synchronously on the learner thread.
+        "async_publish": True,
+        # jax.device_put assembled batches at dispatch time so the H2D
+        # copy overlaps in-flight device compute.
+        "device_prefetch": True,
+        # Ingest decode workers feeding the learner thread (the native
+        # decoder drops the GIL, so extra workers scale on real cores).
+        "ingest_staging_threads": 1,
         # multi-host learner bring-up (jax.distributed); single-process when
         # coordinator is null. Env overrides: RELAYRL_COORDINATOR,
         # RELAYRL_NUM_PROCESSES. The per-host rank is deliberately NOT a

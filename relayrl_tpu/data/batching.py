@@ -57,7 +57,12 @@ class TrajectoryBatch:
         return self.obs.shape[1]
 
     def as_dict(self) -> dict[str, np.ndarray]:
-        return dataclasses.asdict(self)
+        # Shallow on purpose: dataclasses.asdict would deep-copy every
+        # array, silently undoing the staging-slab zero-alloc path (the
+        # batch must stay a VIEW of the persistent buffers all the way
+        # to device placement). Consumers only read.
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
 
     @classmethod
     def zeros(cls, batch_size: int, horizon: int, obs_dim: int, act_dim: int,
@@ -119,11 +124,21 @@ def fold_trailing_markers(
 
 
 def pick_bucket(length: int, buckets: Sequence[int]) -> int:
-    """Smallest bucket ≥ length (lengths above the last bucket clamp to it)."""
-    for b in sorted(buckets):
-        if length <= b:
-            return int(b)
-    return int(max(buckets))
+    """Smallest bucket ≥ length (lengths above the largest clamp to it).
+
+    One scan, no per-call ``sorted()`` — this runs once per ingested
+    trajectory and the old re-sort was pure hot-path overhead
+    (:class:`~relayrl_tpu.data.EpochBuffer` sorts its buckets once at
+    construction; the scan keeps the public API order-independent for
+    any other caller)."""
+    best = largest = None
+    for b in buckets:
+        b = int(b)
+        if length <= b and (best is None or b < best):
+            best = b
+        if largest is None or b > largest:
+            largest = b
+    return best if best is not None else largest
 
 
 def pad_trajectory(
@@ -261,21 +276,84 @@ def pad_decoded(
     )
 
 
-def stack_trajectories(trajs: Sequence[PaddedTrajectory]) -> TrajectoryBatch:
-    """Same-horizon padded episodes → one ``[B, T, ...]`` batch."""
-    horizons = {t.obs.shape[0] for t in trajs}
-    if len(horizons) != 1:
-        raise ValueError(f"mixed horizons in batch: {sorted(horizons)}")
-    return TrajectoryBatch(
-        obs=np.stack([t.obs for t in trajs]),
-        act=np.stack([t.act for t in trajs]),
-        act_mask=np.stack([t.act_mask for t in trajs]),
-        rew=np.stack([t.rew for t in trajs]),
-        val=np.stack([t.val for t in trajs]),
-        logp=np.stack([t.logp for t in trajs]),
-        valid=np.stack([t.valid for t in trajs]),
-        last_val=np.asarray([t.last_val for t in trajs], dtype=np.float32),
-    )
+_BATCH_FIELDS = ("obs", "act", "act_mask", "rew", "val", "logp", "valid")
+
+
+def stack_trajectories(
+    trajs: Sequence[PaddedTrajectory],
+    out: dict[str, np.ndarray] | None = None,
+) -> TrajectoryBatch:
+    """Padded episodes → one ``[B, T, ...]`` batch.
+
+    Without ``out`` this is the original allocate-per-call path (eight
+    fresh ``np.stack``/``asarray`` allocations; requires same-horizon
+    inputs). With ``out`` — a persistent staging dict from
+    :class:`BatchStaging` — every row writes in place (shorter episodes
+    zero-fill their tail, subsuming :func:`repad_trajectory`), and the
+    returned batch VIEWS the staging arrays: it is valid until the
+    staging slot is reused (see :meth:`EpochBuffer.drain`'s contract).
+    """
+    if out is None:
+        horizons = {t.obs.shape[0] for t in trajs}
+        if len(horizons) != 1:
+            raise ValueError(f"mixed horizons in batch: {sorted(horizons)}")
+        return TrajectoryBatch(
+            obs=np.stack([t.obs for t in trajs]),
+            act=np.stack([t.act for t in trajs]),
+            act_mask=np.stack([t.act_mask for t in trajs]),
+            rew=np.stack([t.rew for t in trajs]),
+            val=np.stack([t.val for t in trajs]),
+            logp=np.stack([t.logp for t in trajs]),
+            valid=np.stack([t.valid for t in trajs]),
+            last_val=np.asarray([t.last_val for t in trajs], dtype=np.float32),
+        )
+    b, horizon = out["obs"].shape[:2]
+    if len(trajs) != b:
+        raise ValueError(f"staging batch is {b} rows, got {len(trajs)} episodes")
+    for i, t in enumerate(trajs):
+        n = t.obs.shape[0]
+        if n > horizon:
+            raise ValueError(f"cannot shrink padded trajectory {n} -> {horizon}")
+        for name in _BATCH_FIELDS:
+            dst, src = out[name][i], getattr(t, name)
+            dst[:n] = src
+            if n < horizon:
+                dst[n:] = 0  # stale rows from the slab's previous epoch
+        out["last_val"][i] = t.last_val
+    return TrajectoryBatch(**{name: out[name] for name in _BATCH_FIELDS},
+                           last_val=out["last_val"])
+
+
+class BatchStaging:
+    """Ring of persistent ``[B, T, ...]`` host staging slabs, one ring
+    per distinct (batch, horizon) shape — the zero-alloc steady state
+    for epoch assembly. A slab is handed out round-robin and REUSED
+    after ``slots`` further acquires of the same shape; the owner must
+    guarantee the slab's previous consumer is done by then (the
+    algorithm in-flight window provides exactly that: with window W and
+    ``slots = W + 1``, the update that read slab k has been fenced
+    before drain k+W+1 overwrites it)."""
+
+    def __init__(self, slots: int, obs_dim: int, act_dim: int,
+                 discrete: bool = True):
+        if slots < 1:
+            raise ValueError("BatchStaging needs at least one slot")
+        self.slots = int(slots)
+        self.obs_dim, self.act_dim = int(obs_dim), int(act_dim)
+        self.discrete = bool(discrete)
+        self._rings: dict[tuple[int, int], list[dict[str, np.ndarray]]] = {}
+        self._next: dict[tuple[int, int], int] = {}
+
+    def acquire(self, batch_size: int, horizon: int) -> dict[str, np.ndarray]:
+        key = (int(batch_size), int(horizon))
+        ring = self._rings.setdefault(key, [])
+        if len(ring) < self.slots:
+            ring.append(TrajectoryBatch.zeros(
+                key[0], key[1], self.obs_dim, self.act_dim, self.discrete))
+            return ring[-1]
+        i = self._next.get(key, 0)
+        self._next[key] = (i + 1) % self.slots
+        return ring[i]
 
 
 def repad_trajectory(traj: PaddedTrajectory, horizon: int) -> PaddedTrajectory:

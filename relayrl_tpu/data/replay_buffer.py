@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from relayrl_tpu.data.batching import (
+    BatchStaging,
     PaddedTrajectory,
     TrajectoryBatch,
     pad_decoded,
@@ -44,19 +45,40 @@ class EpochBuffer:
         discrete: bool = True,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         max_traj_length: int | None = None,
+        staging_slots: int = 3,
     ):
         self.obs_dim = int(obs_dim)
         self.act_dim = int(act_dim)
         self.traj_per_epoch = int(traj_per_epoch)
         self.discrete = bool(discrete)
-        self.buckets = tuple(sorted(int(b) for b in buckets))
+        # Sorted (and deduped) ONCE here; pick_bucket and warmup's
+        # smallest-first early stop rely on ascending order instead of
+        # re-sorting per trajectory on the ingest path.
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
         if max_traj_length is not None:
             self.buckets = tuple(b for b in self.buckets if b <= max_traj_length) or (
                 int(max_traj_length),
             )
+        # Construction-time invariant for every later ascending-order
+        # consumer (guards future edits to the two rebuilds above).
+        assert all(a < b for a, b in zip(self.buckets, self.buckets[1:])), \
+            f"bucket lengths must be strictly ascending: {self.buckets}"
+        # Zero-alloc assembly: drained batches write into a ring of
+        # persistent staging slabs instead of eight np.stack allocations
+        # per epoch. staging_slots=0 disables (every drain allocates —
+        # required when drained batches outlive `slots` further drains,
+        # e.g. the multi-host broadcast queue).
+        self._staging = (BatchStaging(staging_slots, self.obs_dim,
+                                      self.act_dim, self.discrete)
+                         if staging_slots else None)
         self._pending: list[PaddedTrajectory] = []
         self.episode_returns: list[float] = []
         self.episode_lengths: list[int] = []
+
+    def disable_staging(self) -> None:
+        """Switch drain() back to allocate-per-call (consumers that hold
+        drained batches across drains — the multi-host ready queue)."""
+        self._staging = None
 
     def __len__(self) -> int:
         return len(self._pending)
@@ -88,15 +110,25 @@ class EpochBuffer:
         return self.ready
 
     def drain(self) -> TrajectoryBatch:
-        """Emit the epoch batch (and clear). All episodes re-pad to the
-        largest bucket present so the stack is rectangular."""
+        """Emit the epoch batch (and clear). All episodes pad to the
+        largest bucket present so the stack is rectangular.
+
+        With staging enabled (the default), the batch views a persistent
+        slab that is REUSED after ``staging_slots`` further drains of
+        the same shape — valid under the algorithm in-flight window
+        (``slots = window + 1``: the update that consumed this slab is
+        fenced before it can be overwritten), but callers that hold
+        batches longer (multi-host ready queues) must
+        :meth:`disable_staging` first."""
         if not self._pending:
             raise ValueError("drain() on empty buffer")
         take = self._pending[: self.traj_per_epoch]
         self._pending = self._pending[self.traj_per_epoch:]
         horizon = max(t.obs.shape[0] for t in take)
-        batch = stack_trajectories([repad_trajectory(t, horizon) for t in take])
-        return batch
+        if self._staging is not None:
+            return stack_trajectories(
+                take, out=self._staging.acquire(len(take), horizon))
+        return stack_trajectories([repad_trajectory(t, horizon) for t in take])
 
     def pop_episode_stats(self) -> tuple[list[float], list[int]]:
         rets, lens = self.episode_returns, self.episode_lengths

@@ -226,8 +226,11 @@ class StepReplayBuffer:
             "obs": self.obs[order], "obs2": self.obs2[order],
             "act": self.act[order], "mask2": self.mask2[order],
             "rew": self.rew[order], "done": self.done[order],
-            "size": np.int64(s),
-            "total_steps": np.int64(self.total_steps),
+            # 0-d ndarrays, not numpy scalars: orbax's standard handler
+            # rejects np.int64 scalar leaves (Unsupported type) — the
+            # arrays restore through int() identically.
+            "size": np.asarray(s, np.int64),
+            "total_steps": np.asarray(self.total_steps, np.int64),
         }
 
     def load_state_arrays(self, d) -> None:
@@ -258,16 +261,32 @@ class StepReplayBuffer:
         self._rng = np.random.default_rng(
             (self._seed, self.total_steps))
 
-    def sample(self, batch_size: int) -> dict[str, np.ndarray]:
-        """Uniform sample of a fixed-size batch (with replacement)."""
+    _SAMPLE_FIELDS = ("obs", "act", "rew", "obs2", "mask2", "done")
+
+    def make_sample_out(self, batch_size: int) -> dict[str, np.ndarray]:
+        """Allocate one reusable staging dict for :meth:`sample`'s
+        ``out=`` — shaped/dtyped exactly like a fresh sample."""
+        b = int(batch_size)
+        return {name: np.empty((b,) + getattr(self, name).shape[1:],
+                               getattr(self, name).dtype)
+                for name in self._SAMPLE_FIELDS}
+
+    def sample(self, batch_size: int,
+               out: dict[str, np.ndarray] | None = None
+               ) -> dict[str, np.ndarray]:
+        """Uniform sample of a fixed-size batch (with replacement).
+
+        ``out`` (from :meth:`make_sample_out`) gathers in place instead
+        of allocating six fresh arrays per draw — the returned dict IS
+        ``out``, valid until the caller reuses the buffers (the
+        off-policy sample ring sizes itself so reuse trails the
+        in-flight update window)."""
         if self.size == 0:
             raise ValueError("sample() on empty buffer")
         idx = self._rng.integers(0, self.size, size=int(batch_size))
-        return {
-            "obs": self.obs[idx],
-            "act": self.act[idx],
-            "rew": self.rew[idx],
-            "obs2": self.obs2[idx],
-            "mask2": self.mask2[idx],
-            "done": self.done[idx],
-        }
+        if out is None:
+            return {name: getattr(self, name)[idx]
+                    for name in self._SAMPLE_FIELDS}
+        for name in self._SAMPLE_FIELDS:
+            np.take(getattr(self, name), idx, axis=0, out=out[name])
+        return out

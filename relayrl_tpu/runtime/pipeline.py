@@ -1,0 +1,239 @@
+"""Learner hot-path pipelining: async dispatch window + off-thread publish.
+
+The single-host learner thread used to run a fully synchronous chain per
+epoch: assemble → H2D → update → host-sync on metrics (``float(v)``) →
+D2H params gather → serialize → socket publish → disk write — all before
+the next decoded trajectory was dequeued. Podracer's Sebulba split
+(arxiv 2104.06272) gets TPU throughput from exactly the overlaps that
+chain forbids: host data work and model publishing pipelined against
+device compute. This module owns the three host-side pieces of that
+split; the server and the algorithm families wire them together:
+
+* :class:`LazyMetrics` — update metrics stay device arrays until
+  ``log_epoch``/``stats`` actually read them, so ``train_on_batch``
+  returns at dispatch instead of fencing every epoch.
+* :class:`InflightWindow` — bounds how many dispatched-but-unfenced
+  updates may be outstanding (donation-safe: the train state threads
+  through dispatches in program order, so XLA sequences them; the bound
+  only stops the host from running unboundedly ahead and anchors the
+  staging-buffer reuse proof in ``data/batching.py``).
+* :class:`ModelPublisher` — a dedicated thread fed latest-wins: a slow
+  socket or artifact write never stalls training, and back-to-back
+  epochs coalesce into one publish of the newest params.
+* :class:`PublishSnapshot` — the cheap handoff between them: a
+  device-to-device params copy taken on the learner thread (dispatched
+  async, never a host sync) that the publisher gathers and serializes
+  off-thread. The copy is what makes the handoff donation-safe: the
+  live state buffers may be consumed by the very next update while the
+  publisher is still reading the snapshot.
+
+Multi-host is deliberately untouched: its publish is a collective
+(``bundle()`` all-gathers on every rank) and its drain contract is the
+``_mh_busy`` flag — this module extends the same contract to the
+single-host loop (``drain()`` counts dispatched-but-unfenced updates and
+pending publishes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, Mapping
+
+
+class LazyMetrics(Mapping):
+    """Mapping view over a dict of device scalars that resolves to host
+    floats only when read. ``train_on_batch`` returns one of these at
+    dispatch time; the fence happens where the value is consumed
+    (``log_epoch``'s ``dump_tabular``, a test's ``_last_metrics[k]``),
+    not on the learner hot path. Resolution is cached: the first read
+    fences, later reads are free."""
+
+    def __init__(self, device_metrics: Mapping[str, Any]):
+        self._device = dict(device_metrics)
+        self._host: dict[str, float] | None = None
+
+    @property
+    def device(self) -> dict[str, Any]:
+        """The raw device arrays — what :class:`InflightWindow` fences."""
+        return self._device
+
+    def resolve(self) -> dict[str, float]:
+        if self._host is None:
+            self._host = {k: float(v) for k, v in self._device.items()}
+        return self._host
+
+    def __getitem__(self, key: str) -> float:
+        return self.resolve()[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._device)
+
+    def __len__(self) -> int:
+        return len(self._device)
+
+    def __repr__(self) -> str:
+        state = "resolved" if self._host is not None else "in-flight"
+        return f"LazyMetrics({sorted(self._device)}, {state})"
+
+
+class InflightWindow:
+    """Bounded window of dispatched-but-unfenced updates.
+
+    Every dispatch pushes the update's output leaves (its metrics — made
+    by the same XLA program as the new state, so "metrics ready" ⟺
+    "update done"); pushing past ``max_in_flight`` fences the oldest
+    first. ``max_in_flight=0`` degenerates to the old synchronous
+    behavior (every dispatch fenced immediately) — the equivalence-test
+    escape hatch and the operator's kill switch.
+
+    Owned by the learner thread alone: no locks (deliberate — a fence
+    under a lock is exactly the CONC01 stall jaxlint exists to catch).
+    ``device_wait_s`` accumulates the real blocked time so the server's
+    ``timings`` can report the fence separately from dispatch work.
+    """
+
+    def __init__(self, max_in_flight: int = 2):
+        self.max_in_flight = max(0, int(max_in_flight))
+        self._entries: deque[Any] = deque()
+        self.dispatch_count = 0   # total updates ever pushed
+        self.fenced_count = 0     # total updates known complete
+        self.device_wait_s = 0.0
+
+    @property
+    def pending(self) -> int:
+        """Dispatched-but-unfenced updates (the drain() contract)."""
+        return len(self._entries)
+
+    def push(self, fences: Any) -> None:
+        """Record one dispatched update; blocks only when the window is
+        already full (fencing the oldest)."""
+        self._entries.append(fences)
+        self.dispatch_count += 1
+        while len(self._entries) > self.max_in_flight:
+            self._fence_oldest()
+
+    def drain(self) -> None:
+        """Fence every outstanding update (learner idle / shutdown /
+        pre-checkpoint)."""
+        while self._entries:
+            self._fence_oldest()
+
+    def _fence_oldest(self) -> None:
+        import jax
+
+        fences = self._entries.popleft()
+        t0 = time.monotonic()
+        jax.block_until_ready(fences)
+        self.device_wait_s += time.monotonic() - t0
+        self.fenced_count += 1
+
+
+@dataclasses.dataclass
+class PublishSnapshot:
+    """Learner-thread handoff to the publisher: ``params`` are
+    device-to-device copies (async dispatch, no host sync) so the next
+    update's donation cannot invalidate them; ``version`` is the
+    host-side dispatch mirror (reading ``state.step`` would fence)."""
+
+    version: int
+    arch: dict
+    params: Any
+
+    def to_bundle(self):
+        """Gather to host + wrap — the blocking D2H that must run on the
+        publisher thread, never the learner thread."""
+        import jax
+
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        return ModelBundle(version=self.version, arch=self.arch,
+                           params=jax.device_get(self.params))
+
+
+class ModelPublisher:
+    """Dedicated publish thread fed latest-wins.
+
+    ``submit`` replaces any not-yet-started snapshot (the dropped one
+    counts as ``coalesced`` — back-to-back epochs fold into one publish
+    of the newest params); the publish callable runs outside the lock so
+    a slow socket/disk never blocks the submitting learner thread.
+    ``pending`` counts the queued slot plus an in-progress publish, which
+    is what extends the server ``drain()`` contract to "the final publish
+    landed"."""
+
+    def __init__(self, publish_fn: Callable[[PublishSnapshot], None],
+                 name: str = "model-publisher"):
+        self._publish_fn = publish_fn
+        self._cond = threading.Condition()
+        self._slot: PublishSnapshot | None = None
+        self._busy = False
+        self._stop = False
+        self.published = 0
+        self.coalesced = 0
+        self.errors = 0
+        self.publish_s = 0.0
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def pending(self) -> int:
+        with self._cond:
+            return int(self._slot is not None) + int(self._busy)
+
+    def submit(self, snapshot: PublishSnapshot) -> None:
+        with self._cond:
+            if self._stop:
+                return
+            if self._slot is not None:
+                self.coalesced += 1
+            self._slot = snapshot
+            self._cond.notify()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until the queued + in-progress publishes have landed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._slot is not None or self._busy:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def stop(self, timeout: float | None = 30.0) -> None:
+        """Finish the pending publish (if any), then join the thread."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._slot is None and not self._stop:
+                    self._cond.wait()
+                if self._slot is None and self._stop:
+                    return
+                snapshot, self._slot = self._slot, None
+                self._busy = True
+            t0 = time.monotonic()
+            try:
+                self._publish_fn(snapshot)
+                self.published += 1
+            except Exception as e:  # a transient socket/fs error must not
+                self.errors += 1    # kill the publish plane
+                print(f"[ModelPublisher] publish error: {e!r}", flush=True)
+            finally:
+                self.publish_s += time.monotonic() - t0
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+
+__all__ = ["InflightWindow", "LazyMetrics", "ModelPublisher",
+           "PublishSnapshot"]

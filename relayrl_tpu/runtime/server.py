@@ -189,10 +189,33 @@ class TrainingServer:
 
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
-        self._staging_thread: threading.Thread | None = None
+        self._staging_threads: list[threading.Thread] = []
         self._mh_ready: list = []   # assembled-but-untrained epoch batches
         self._mh_busy = False       # a broadcast step is in flight
         self.active = False
+        # Pipelined learner hot path (single-host): the learner thread is
+        # dispatch-only — updates enter the algorithm's bounded in-flight
+        # window unfenced, the publish runs on a dedicated latest-wins
+        # thread, assembled batches prefetch to the device, and epoch
+        # logs defer until their update's fence. Knobs (docs/operations):
+        #   learner.max_inflight_updates  (algorithm-side; 0 = sync)
+        #   learner.async_publish         false = publish on learner thread
+        #   learner.device_prefetch       false = H2D inside the dispatch
+        #   learner.ingest_staging_threads  decode workers (default 1)
+        self._async_publish = bool(learner_cfg.get("async_publish", True))
+        self._prefetch = bool(learner_cfg.get("device_prefetch", True))
+        self._staging_count = max(
+            1, int(learner_cfg.get("ingest_staging_threads", 1)))
+        self._publisher = None
+        # Distance-gate anchors for the model artifact and the periodic
+        # checkpoint — seeded from the (possibly resumed) version so a
+        # resume doesn't immediately re-save what it just restored.
+        self._artifact_version = int(self.algorithm.version)
+        self._ckpt_version = int(self.algorithm.version)
+        from collections import deque
+
+        self._pending_logs: deque = deque()
+        self._timings_lock = threading.Lock()
         # "dropped" counts transport/queue-level losses; the ingest
         # finite-value guard's count is mirrored from the algorithm after
         # each trajectory so operators see poisoning without reaching
@@ -202,11 +225,21 @@ class TrainingServer:
         # Per-thread time ledger (seconds): where the ingest pipeline
         # actually spends its time — the profile evidence that the learner
         # thread waits on the device, not on msgpack (SURVEY §7.4-1).
-        #   decode_s      staging thread inside decode
-        #   learn_s       learner thread inside receive_trajectory/update
+        #   decode_s      staging thread(s) inside decode
+        #   dispatch_s    learner thread enqueueing host work (assemble +
+        #                 async update dispatch + publish handoff)
+        #   device_wait_s learner thread fenced on the device (in-flight
+        #                 window + idle drains) — split from dispatch_s
+        #                 because async dispatch makes a single "learn"
+        #                 bucket meaningless (jaxlint JAX06)
+        #   publish_s     publisher thread inside gather/serialize/send
+        #   learn_s       legacy total: learner thread inside trajectory
+        #                 processing (dispatch + deferred logs + fences
+        #                 that land there); superseded by the split above
         #   learner_idle_s learner thread blocked on an empty queue
         #   warmup_s      learner thread pre-compiling update shapes
-        self.timings = {"decode_s": 0.0, "learn_s": 0.0,
+        self.timings = {"decode_s": 0.0, "learn_s": 0.0, "dispatch_s": 0.0,
+                        "device_wait_s": 0.0, "publish_s": 0.0,
                         "learner_idle_s": 0.0, "warmup_s": 0.0}
         self._warmup_done = threading.Event()
 
@@ -288,11 +321,19 @@ class TrainingServer:
                   "and call disable_server there instead)", flush=True)
 
     # -- transport callbacks (transport threads!) --
+    def _count_dropped(self, n: int = 1) -> None:
+        """stats['dropped'] is written from transport threads AND the N
+        decode workers — an unlocked += loses increments exactly when
+        the operator most needs the counter (docs/operations.md says to
+        watch it to size ingest_staging_threads)."""
+        with self._timings_lock:
+            self.stats["dropped"] += n
+
     def _on_trajectory(self, agent_id: str, payload: bytes) -> None:
         try:
             self._ingest.put_nowait((agent_id, payload))
         except queue.Full:
-            self.stats["dropped"] += 1
+            self._count_dropped()
 
     def _on_trajectory_decoded(self, batch) -> None:
         """Pre-decoded columnar trajectory batch from the native drain —
@@ -300,7 +341,7 @@ class TrainingServer:
         try:
             self._decoded.put_nowait(batch)
         except queue.Full:
-            self.stats["dropped"] += len(batch)
+            self._count_dropped(len(batch))
 
     def _get_model(self) -> tuple[int, bytes]:
         with self._bundle_lock:
@@ -365,13 +406,15 @@ class TrainingServer:
                 else:
                     item = deserialize_actions(payload)
             except Exception:
-                self.stats["dropped"] += 1
-            self.timings["decode_s"] += time.monotonic() - t0
+                self._count_dropped()
+            dt = time.monotonic() - t0
+            with self._timings_lock:  # N decode workers share the ledger
+                self.timings["decode_s"] += dt
             if item is not None:
                 try:
                     self._decoded.put_nowait(item)
                 except queue.Full:
-                    self.stats["dropped"] += 1
+                    self._count_dropped()
             # task_done only after the decoded item is enqueued, so
             # drain()'s two-queue emptiness check never races the handoff
             self._ingest.task_done()
@@ -500,11 +543,9 @@ class TrainingServer:
             # Full-state checkpoint is COLLECTIVE on a multi-host mesh
             # (orbax needs every process to contribute its shards to the
             # shared checkpoint_dir); the due-check derives from the
-            # replicated version, so all ranks agree without extra
-            # coordination.
-            if (self._checkpoint_dir
-                    and bundle.version % self._checkpoint_every == 0):
-                self._periodic_checkpoint()
+            # replicated version and a counter that advances identically
+            # on every rank, so all agree without extra coordination.
+            self._maybe_periodic_checkpoint(bundle.version)
             self._mh_busy = False
 
     # -- learner loop --
@@ -539,6 +580,11 @@ class TrainingServer:
                 item = self._decoded.get(timeout=0.1)
             except queue.Empty:
                 self.timings["learner_idle_s"] += time.monotonic() - t_wait
+                # Idle is fence-for-free: the device has nothing queued
+                # behind the in-flight updates, so resolving them (and
+                # flushing their deferred epoch logs) costs no overlap —
+                # and it is what lets drain() observe pending -> 0.
+                self._pipeline_quiesce()
                 continue
             self.timings["learner_idle_s"] += time.monotonic() - t_wait
             t0 = time.monotonic()
@@ -556,6 +602,9 @@ class TrainingServer:
             finally:
                 self.timings["learn_s"] += time.monotonic() - t0
                 self._decoded.task_done()
+        # Shutdown: fence what was dispatched and flush its logs so
+        # disable_server leaves state/progress.txt consistent.
+        self._pipeline_quiesce()
 
     def _sync_drop_stats(self) -> None:
         """Mirror the algorithm's finite-guard counter into stats — the
@@ -566,7 +615,69 @@ class TrainingServer:
 
     def _process_one(self, item) -> None:
         """``item``: DecodedTrajectory (columnar fast path) or
-        list[ActionRecord] (Python decode)."""
+        list[ActionRecord] (Python decode). Dispatch-only: the update
+        enters the algorithm's in-flight window unfenced, the publish is
+        handed to the latest-wins publisher thread, and the epoch log
+        defers until the update's fence."""
+        algo = self.algorithm
+        if not hasattr(algo, "accumulate"):
+            # Plugin algorithms implementing only the reference contract
+            # (receive_trajectory/train_model/save/log_epoch) keep the
+            # original synchronous path — pipelining needs the family
+            # accumulate/capture split.
+            self._process_one_legacy(item)
+            return
+        self.stats["trajectories"] += 1
+        t0 = time.monotonic()
+        try:
+            got = algo.accumulate(item)
+            updated = got is not None
+            if updated:
+                batches = got if isinstance(got, list) else [got]
+                if self._prefetch:
+                    # Eager H2D: enqueued now, the transfer overlaps the
+                    # in-flight updates instead of running after the
+                    # window fence below.
+                    batches = [algo.stage_batch(b) for b in batches]
+                if isinstance(got, list):
+                    algo.train_on_batches(batches)
+                else:
+                    algo.train_on_batch(batches[0])
+        except Exception as e:  # never kill the loop on one bad batch
+            print(f"[TrainingServer] learner error: {e!r}", flush=True)
+            return
+        finally:
+            self._sync_drop_stats()
+        # Epoch log: captured now (episode counters must not leak across
+        # epochs), dumped once the update it describes is fenced.
+        payload = algo.capture_epoch_stats(updated)
+        if payload is not None:
+            self._pending_logs.append(
+                (algo.inflight.dispatch_count, payload, algo._last_metrics))
+        # dispatch_s ends here: the publish handoff below is a lock'd
+        # slot swap, but a due checkpoint quiesces + saves — seconds of
+        # fence/IO that must not masquerade as host-side enqueue (the
+        # window fence is already accounted in device_wait_s).
+        self.timings["dispatch_s"] += time.monotonic() - t0
+        if updated:
+            self.stats["updates"] += 1
+            try:
+                if self._publisher is not None:
+                    self._publisher.submit(algo.snapshot_for_publish())
+                    # Full-state checkpointing stays on the learner
+                    # thread (orbax save is not publisher-safe); gate on
+                    # the host version mirror — int(state.step) would
+                    # fence the window.
+                    self._maybe_periodic_checkpoint(algo.dispatched_version)
+                else:
+                    self._publish()  # sync escape hatch (async_publish off)
+            except Exception as e:  # transient socket/fs errors must not
+                print(f"[TrainingServer] publish error: {e!r}", flush=True)
+        self._flush_ready_logs()
+
+    def _process_one_legacy(self, item) -> None:
+        """Pre-pipeline path for plugin algorithms: train + log inside
+        receive_trajectory, synchronous publish."""
         self.stats["trajectories"] += 1
         try:
             updated = self.algorithm.receive_trajectory(item)
@@ -588,10 +699,57 @@ class TrainingServer:
                     print(f"[TrainingServer] tensorboard error: {e!r}",
                           flush=True)
 
+    def _flush_ready_logs(self, force: bool = False) -> None:
+        """Dump deferred epoch logs whose update has been fenced by the
+        in-flight window (FIFO — rows land in dispatch order). Runs on
+        the learner thread only."""
+        win = self.algorithm.inflight
+        dumped = False
+        while self._pending_logs:
+            after_dispatch, payload, metrics = self._pending_logs[0]
+            if not force and after_dispatch > win.fenced_count:
+                break
+            self._pending_logs.popleft()
+            try:
+                self.algorithm.log_epoch(stats=payload, metrics=metrics)
+                dumped = True
+            except Exception as e:
+                print(f"[TrainingServer] log error: {e!r}", flush=True)
+        if dumped and self._tb is not None:
+            try:
+                self._tb.poll()
+            except Exception as e:
+                print(f"[TrainingServer] tensorboard error: {e!r}",
+                      flush=True)
+        self.timings["device_wait_s"] = win.device_wait_s
+        if self._publisher is not None:
+            self.timings["publish_s"] = self._publisher.publish_s
+
+    def _pipeline_quiesce(self) -> None:
+        """Fence every in-flight update and flush the deferred logs —
+        called when the learner is idle or exiting (learner thread only)."""
+        win = getattr(self.algorithm, "_inflight", None)
+        if win is not None and win.pending:
+            win.drain()
+        if self._pending_logs:
+            self._flush_ready_logs(force=True)
+
+    def _learner_pending(self) -> int:
+        """Dispatched-but-unfenced updates + deferred logs + queued or
+        in-progress publishes — the single-host half of the drain()
+        contract (the multi-host half is _mh_ready/_mh_busy)."""
+        win = getattr(self.algorithm, "_inflight", None)
+        n = (win.pending if win is not None else 0) + len(self._pending_logs)
+        if self._publisher is not None:
+            n += self._publisher.pending
+        return n
+
     def drain(self, timeout: float = 60.0) -> bool:
         """Block until every trajectory already in the ingest pipeline
-        (raw + decoded queues) has been processed (trained + published).
-        True if drained within timeout.
+        (raw + decoded queues) has been processed (trained + published):
+        dispatched updates fenced, deferred epoch logs dumped, and the
+        final (latest-wins) model publish landed. True if drained within
+        timeout.
 
         Note this covers trajectories the server has *received*; bytes still
         in transit in socket buffers are invisible here, so to observe an
@@ -600,6 +758,10 @@ class TrainingServer:
         while time.monotonic() < deadline:
             if (self._ingest.unfinished_tasks == 0
                     and self._decoded.unfinished_tasks == 0
+                    # single-host pipeline: dispatched-but-unfenced
+                    # updates, deferred logs, pending publishes (the
+                    # learner thread fences + flushes on its idle tick)
+                    and self._learner_pending() == 0
                     # multi-host: assembled-but-untrained epoch batches and
                     # the broadcast step in flight also count as pending
                     and not self._mh_ready
@@ -612,8 +774,12 @@ class TrainingServer:
         """Periodic on-disk model bytes (ref: server reads the .pt file to
         serve agents, training_zmq.rs:905-919; for us handshakes are
         served from memory and the file is a resume/debug aid). Reuses the
-        serialized bytes, throttled by learner.checkpoint_every_epochs."""
-        if version % self._checkpoint_every != 0:
+        serialized bytes, throttled by learner.checkpoint_every_epochs.
+        Distance-gated, not modulo-gated: latest-wins publish coalescing
+        makes published versions an arbitrary subsequence, so waiting for
+        a version divisible by the cadence could starve the file forever
+        (with every version published the two rules write identically)."""
+        if version - self._artifact_version < self._checkpoint_every:
             return
         try:
             path = self.algorithm.server_model_path
@@ -621,10 +787,15 @@ class TrainingServer:
             with open(tmp, "wb") as f:
                 f.write(raw)
             os.replace(tmp, path)
+            self._artifact_version = version
         except OSError:
             pass
 
     def _publish(self) -> None:
+        """Synchronous publish on the learner thread — the multi-host
+        loop's path and the ``async_publish: false`` escape hatch (the
+        pipelined path hands :meth:`_publish_snapshot` to the publisher
+        thread instead)."""
         bundle = self.algorithm.bundle()
         raw = bundle.to_bytes()
         with self._bundle_lock:
@@ -632,10 +803,43 @@ class TrainingServer:
             self._bundle_version = bundle.version
         self.transport.publish_model(bundle.version, raw)
         self._write_model_artifact(raw, bundle.version)
-        if self._checkpoint_dir and bundle.version % self._checkpoint_every == 0:
-            # Full-state checkpoint (params + optimizer + RNG + epoch);
-            # async orbax save — the learner loop is not blocked.
-            self._periodic_checkpoint()
+        self._maybe_periodic_checkpoint(bundle.version)
+
+    def _maybe_periodic_checkpoint(self, version: int) -> None:
+        """Distance-gated full-state checkpoint (params + optimizer +
+        RNG + epoch; async orbax save). Distance, not modulo: off-policy
+        versions advance by the whole update-debt between checks, so a
+        ``% N == 0`` gate can skip cadences indefinitely (the same
+        starvation `_write_model_artifact` guards against). Quiesces the
+        pipeline first — the save fences the params anyway, and flushing
+        the deferred logs keeps the checkpointed epoch counter in step
+        with the checkpointed params (a resume must not repeat Epoch
+        rows already logged before the save); a no-op when nothing is
+        pending (the synchronous and multi-host paths)."""
+        if (not self._checkpoint_dir
+                or version - self._ckpt_version < self._checkpoint_every):
+            return
+        self._pipeline_quiesce()
+        self._periodic_checkpoint()
+        # Advance even on a (caught) failed save — retrying every epoch
+        # would hammer a broken checkpoint dir, and multi-host ranks must
+        # stay in lockstep on the due-check regardless of local errors.
+        self._ckpt_version = version
+
+    def _publish_snapshot(self, snapshot) -> None:
+        """Publisher-thread body: the blocking D2H gather, serialize,
+        socket publish, and artifact write all happen here — a slow
+        subscriber or disk never stalls the learner thread, and
+        back-to-back epochs coalesce latest-wins upstream
+        (runtime/pipeline.ModelPublisher). Exceptions are counted and
+        logged by the publisher loop."""
+        bundle = snapshot.to_bundle()
+        raw = bundle.to_bytes()
+        with self._bundle_lock:
+            self._bundle_bytes = raw
+            self._bundle_version = bundle.version
+        self.transport.publish_model(bundle.version, raw)
+        self._write_model_artifact(raw, bundle.version)
 
     def _periodic_checkpoint(self) -> None:
         """One periodic save, with the replay-buffer (aux) snapshot
@@ -673,9 +877,21 @@ class TrainingServer:
         multi_host = self.distributed_info["multi_host"]
         if self.transport is not None:
             self.transport.start()
-            self._staging_thread = threading.Thread(
-                target=self._staging_loop, name="ingest-staging", daemon=True)
-            self._staging_thread.start()
+            # N decode workers (learner.ingest_staging_threads): once the
+            # learner thread is dispatch-only, a single decode thread is
+            # the next ingest bottleneck; the native decoder drops the
+            # GIL, so extra workers scale on real cores.
+            self._staging_threads = [
+                threading.Thread(target=self._staging_loop,
+                                 name=f"ingest-staging-{i}", daemon=True)
+                for i in range(self._staging_count)]
+            for t in self._staging_threads:
+                t.start()
+        if (self.transport is not None and not multi_host
+                and self._async_publish and self._publisher is None):
+            from relayrl_tpu.runtime.pipeline import ModelPublisher
+
+            self._publisher = ModelPublisher(self._publish_snapshot)
         self._mh_ready = []
         self._mh_busy = False
         if multi_host:
@@ -720,11 +936,10 @@ class TrainingServer:
         # double it), not a per-thread grant.
         deadline = (None if join_timeout is None
                     else time.monotonic() + join_timeout)
-        if self._staging_thread is not None:
-            self._staging_thread.join(
-                timeout=30 if deadline is None
-                else max(0.0, deadline - time.monotonic()))
-            self._staging_thread = None
+        for t in self._staging_threads:
+            t.join(timeout=30 if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+        self._staging_threads = []
         if self._learner_thread is not None:
             # Multi-host: the thread may be mid-collective (a step can
             # include a fresh XLA compile) — give it long enough to reach
@@ -735,6 +950,13 @@ class TrainingServer:
                 timeout=default if deadline is None
                 else max(0.0, deadline - time.monotonic()))
             self._learner_thread = None
+        if self._publisher is not None:
+            # After the learner join (no more submits), before the
+            # transport stops (the final publish needs a live socket).
+            self._publisher.stop(
+                timeout=30 if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+            self._publisher = None
         if self.transport is not None:
             self.transport.stop()
         # Drain any in-flight async orbax save — the most recent checkpoint
