@@ -56,7 +56,13 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
              traj_per_epoch: int = 64, algorithm: str = "REINFORCE",
-             transport: str = "zmq") -> dict:
+             transport: str = "zmq", vector: bool = False) -> dict:
+    """``vector=True`` runs the fleet as vector actor hosts: each worker
+    process is ONE VectorAgent stepping ``agents_per_proc`` logical
+    agents through a single batched jitted policy dispatch (the
+    ``actor.host_mode="vector"`` topology) — n_actors stays the number of
+    LOGICAL agents the server sees, so rows are directly comparable with
+    process-per-actor rows at the same n_actors."""
     from relayrl_tpu.runtime.server import TrainingServer
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
@@ -116,6 +122,26 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         orig_publish(version, bundle_bytes)
 
     server.transport.publish_model = publish_model
+    # Per-agent trajectory attribution: distinct agent ids the ingest
+    # plane actually saw. In vector mode this is the proof that N logical
+    # agents multiplexed over one socket still arrive as N attributed
+    # streams (the vector-soak smoke asserts it == actors).
+    seen_traj_agents: set[str] = set()
+    orig_on_traj = server.transport.on_trajectory
+
+    def counting_on_traj(agent_id, payload):
+        seen_traj_agents.add(agent_id)
+        orig_on_traj(agent_id, payload)
+
+    server.transport.on_trajectory = counting_on_traj
+    if server.transport.on_trajectory_decoded is not None:
+        orig_decoded = server.transport.on_trajectory_decoded
+
+        def counting_decoded(batch):
+            seen_traj_agents.update(t.agent_id for t in batch)
+            orig_decoded(batch)
+
+        server.transport.on_trajectory_decoded = counting_decoded
 
     n_procs = (n_actors + agents_per_proc - 1) // agents_per_proc
     env = dict(os.environ)
@@ -141,7 +167,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             # host, and a worker's SUB threads may see nothing until the
             # last stragglers stop competing for the GIL.
             "receipt_grace_s": max(8.0, n_actors / 10.0),
-            "result_path": result_path, **worker_addrs,
+            "result_path": result_path, "vector": vector, **worker_addrs,
         }
         procs.append(subprocess.Popen(
             [sys.executable,
@@ -222,14 +248,21 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     expected = sum(1 for _, pub_ns in publishes for a in agents
                    if _counts(a, pub_ns))
     result = {
-        "bench": f"soak_multi_actor_{transport}",
+        "bench": (f"soak_multi_actor_{transport}"
+                  + ("_vector" if vector else "")),
         "config": {"actors": n_actors, "algorithm": algorithm,
                    "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
+                   "mode": "vector" if vector else "process",
+                   "processes": n_procs,
+                   "agents_per_proc": agents_per_proc,
                    "host_cores": os.cpu_count()},
         "warmup_excluded": warmed,
         "agents_completed": len(agents),
         "agents_crashed": sum(1 for a in agents if a.get("crashed")),
+        "distinct_traj_agents": len(seen_traj_agents),
+        "min_episodes_per_agent": (min(a["episodes"] for a in agents)
+                                   if agents else 0),
         "env_steps_total": total_steps,
         "env_steps_per_sec": round(total_steps / mean_window_s, 1),
         "mean_window_s": round(mean_window_s, 1),
@@ -665,6 +698,7 @@ def _write_results(outfile: str, lines: list[dict]) -> None:
 
 def main():
     quick = "--quick" in sys.argv
+    vector = "--vector" in sys.argv
     bench_cwd()
     transport = ("native" if "--native" in sys.argv
                  else "grpc" if "--grpc" in sys.argv else "zmq")
@@ -699,19 +733,25 @@ def main():
         # unmeasurable, so commit the actor-scaling curve instead: it
         # shows where the single core saturates and that every committed
         # point holds the SLOs with a synchronized window whose span
-        # matches the nominal duration).
+        # matches the nominal duration). With --vector the same logical
+        # actor counts run as vector hosts (<= 16 lanes per process), so
+        # the two curves' 64-actor rows face off directly: process mode
+        # fork-bombs the host there; vector mode makes it a batch width.
         rows = []
         for n in ([4, 16] if quick else [4, 8, 16, 32, 64]):
-            r = run_soak(n_actors=n, agents_per_proc=min(8, n),
+            r = run_soak(n_actors=n,
+                         agents_per_proc=min(16, n) if vector else min(8, n),
                          duration_s=10.0 if quick else 20.0,
-                         transport=transport)
+                         transport=transport, vector=vector)
             print(json.dumps(r))
             assert r["server_stats"]["dropped"] == 0
             assert r["agents_crashed"] == 0
             assert r["agents_completed"] == n, "fleet silently shrank"
             rows.append(r)
         if "--write" in sys.argv:
-            _write_results(f"soak_scaling_{transport}.json", rows)
+            _write_results(
+                f"soak_scaling_{transport}"
+                + ("_vector" if vector else "") + ".json", rows)
         return
     if "--blast-one" in sys.argv:
         # Subprocess worker for run_blast_matrix: one isolated row.
@@ -723,6 +763,16 @@ def main():
         return
     if "--blast" in sys.argv:
         run_blast_matrix(quick)
+        return
+    if vector:
+        # The north-star row as a configuration: 64 logical agents in 4
+        # processes x 16 lanes (quick: 8 as 2x4). SLO asserts + committed
+        # row mirror the process-mode soak64 artifact.
+        result = run_soak(n_actors=8 if quick else 64,
+                          agents_per_proc=4 if quick else 16,
+                          duration_s=8.0 if quick else 30.0,
+                          transport=transport, vector=True)
+        _finish(result, f"soak64_{transport}_vector.json")
         return
     result = run_soak(n_actors=16 if quick else 64,
                       duration_s=8.0 if quick else 30.0,

@@ -45,19 +45,48 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+_ENV_IDS = {"cartpole": "CartPole-v1",
+            "pendulum": "Pendulum-v1",
+            "lunarlander": "LunarLander-v3"}
+
+
 def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
                episodes: int, max_steps: int, greedy_eval: int, queue,
-               eval_barrier):
+               eval_barrier, num_envs: int = 1):
     from relayrl_tpu.utils.hostpin import pin_cpu
 
     pin_cpu()  # actors are CPU hosts
     from relayrl_tpu.envs import make
     from relayrl_tpu.runtime.agent import Agent, run_eval_loop, run_gym_loop
 
+    if num_envs > 1:
+        # Vector topology (actor.host_mode="vector" / --num-envs): this
+        # process hosts num_envs logical agents behind one batched jitted
+        # policy step; ``episodes`` stays the per-LANE target so rows are
+        # comparable with process mode at the same actors x episodes.
+        from relayrl_tpu.envs import make_vector
+        from relayrl_tpu.runtime.agent import VectorAgent
+        from relayrl_tpu.runtime.vector_actor import run_vector_gym_loop
+
+        agent = VectorAgent(num_envs=num_envs, server_type=server_type,
+                            seed=idx, **agent_addrs)
+        venv = make_vector(_ENV_IDS[env_id], num_envs)
+        t0 = time.time()
+        per_lane: list[list[float]] = [[] for _ in range(num_envs)]
+        while min(len(r) for r in per_lane) < episodes:
+            for lane, chunk in enumerate(
+                    run_vector_gym_loop(agent, venv, steps=max_steps)):
+                per_lane[lane].extend(chunk)
+        train_s = time.time() - t0
+        # Greedy eval has no batched path (mode() is per-policy, and the
+        # eval loop is deliberately unrecorded single-env); vector runs
+        # report training returns only.
+        queue.put((idx, [ret for lane in per_lane for ret in lane],
+                   agent.model_version, [], train_s))
+        agent.disable_agent()
+        return
     agent = Agent(server_type=server_type, seed=idx, **agent_addrs)
-    env = make({"cartpole": "CartPole-v1",
-                "pendulum": "Pendulum-v1",
-                "lunarlander": "LunarLander-v3"}[env_id])
+    env = make(_ENV_IDS[env_id])
     t0 = time.time()
     returns = run_gym_loop(agent, env, episodes=episodes, max_steps=max_steps)
     train_s = time.time() - t0
@@ -84,8 +113,13 @@ def main():
     ap.add_argument("--transport", default="zmq",
                     choices=["zmq", "grpc", "native"])
     ap.add_argument("--actors", type=int, default=1)
+    ap.add_argument("--num-envs", type=int, default=None, metavar="N",
+                    help="env lanes per actor process (vector host, "
+                         "runtime/vector_actor.py); default comes from "
+                         "config actor.num_envs when actor.host_mode is "
+                         "\"vector\", else 1 (process mode)")
     ap.add_argument("--episodes", type=int, default=200,
-                    help="episodes PER actor")
+                    help="episodes PER actor (per lane in vector mode)")
     ap.add_argument("--max-steps", type=int, default=500)
     ap.add_argument("--baseline", action="store_true")
     ap.add_argument("--tensorboard", action="store_true")
@@ -142,6 +176,19 @@ def main():
                 "lunarlander": (8, 4)}
     obs_dim, act_dim = env_dims[args.env]
 
+    # actor.host_mode="vector" in relayrl_config.json turns every actor
+    # process into a vector host of actor.num_envs lanes; --num-envs
+    # overrides (and >1 implies vector mode).
+    from relayrl_tpu.config import ConfigLoader
+
+    actor_params = ConfigLoader(create_if_missing=False).get_actor_params()
+    num_envs = (args.num_envs if args.num_envs is not None
+                else (actor_params["num_envs"]
+                      if actor_params["host_mode"] == "vector" else 1))
+    if num_envs > 1 and args.greedy_eval > 0:
+        print("[driver] --greedy-eval ignored in vector mode (no batched "
+              "greedy path)", flush=True)
+
     server = TrainingServer(
         args.algo, obs_dim=obs_dim, act_dim=act_dim,
         server_type=args.transport, env_dir=".",
@@ -154,7 +201,7 @@ def main():
         ctx.Process(target=actor_proc,
                     args=(i, args.transport, agent_addrs, args.env,
                           args.episodes, args.max_steps, args.greedy_eval,
-                          queue, eval_barrier))
+                          queue, eval_barrier, num_envs))
         for i in range(args.actors)
     ]
     for p in procs:
@@ -183,7 +230,7 @@ def main():
 
     # Actors just finished: wait for the last episodes to arrive off the
     # sockets, then drain the learner.
-    total_expected = args.actors * args.episodes
+    total_expected = args.actors * args.episodes * num_envs
     deadline = time.time() + 10
     while (server.stats["trajectories"] < total_expected
            and time.time() < deadline):

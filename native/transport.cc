@@ -26,6 +26,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -74,7 +75,10 @@ std::vector<uint8_t> encode_frame(uint8_t type, const uint8_t* data,
 struct Conn {
   int fd = -1;
   bool subscriber = false;
-  std::string agent_id;  // set by kFrameModelSet; enables unregister-on-drop
+  // All logical agent ids registered on this connection (kFrameModelSet,
+  // callable N times — vector actor hosts multiplex N agents over one
+  // socket); enables unregister-on-drop for every lane.
+  std::vector<std::string> agent_ids;
   std::vector<uint8_t> rbuf;
   std::deque<std::vector<uint8_t>> wqueue;
   size_t woff = 0;  // offset into wqueue.front()
@@ -256,15 +260,17 @@ class Server {
 
   void drop(int fd) {
     auto it = conns_.find(fd);
-    if (it != conns_.end() && !it->second.agent_id.empty()) {
+    if (it != conns_.end()) {
       // Elastic-fleet reaping: a registered agent whose control
       // connection died (crash, kill -9, partition past the idle
       // timeout) is reported so the embedding server can drop it from
       // the registry — the reference's registry is append-only
-      // (training_server_wrapper.rs:159-163); this goes beyond it.
-      push_event(3,
-                 reinterpret_cast<const uint8_t*>(it->second.agent_id.data()),
-                 it->second.agent_id.size());
+      // (training_server_wrapper.rs:159-163); this goes beyond it. One
+      // unregister per logical agent: a dead vector host drops ALL of
+      // its lanes.
+      for (const auto& id : it->second.agent_ids)
+        push_event(3, reinterpret_cast<const uint8_t*>(id.data()),
+                   id.size());
     }
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
     close(fd);
@@ -346,13 +352,21 @@ class Server {
         return send_frame(c, kFrameModel, body.data(), body.size());
       }
       case kFrameModelSet: {
-        c.agent_id.assign(reinterpret_cast<const char*>(payload), len);
+        std::string id(reinterpret_cast<const char*>(payload), len);
         // Re-registration (a reconnected agent replaying its id): clear
         // the stale conn's claim so its eventual drop doesn't emit an
         // unregister for the now-live agent.
-        for (auto& [other_fd, other] : conns_)
-          if (other_fd != c.fd && other.agent_id == c.agent_id)
-            other.agent_id.clear();
+        for (auto& [other_fd, other] : conns_) {
+          if (other_fd == c.fd) continue;
+          auto& ids = other.agent_ids;
+          ids.erase(std::remove(ids.begin(), ids.end(), id), ids.end());
+        }
+        // One connection may register many logical agents (vector actor
+        // hosts); re-registering the same id on the same conn stays a
+        // single claim.
+        if (std::find(c.agent_ids.begin(), c.agent_ids.end(), id) ==
+            c.agent_ids.end())
+          c.agent_ids.push_back(id);
         push_event(2, payload, len);
         return send_frame(c, kFrameIdLogged, nullptr, 0);
       }
@@ -479,21 +493,26 @@ class Client {
     if (subscribed_) {
       if (!send_frame(kFrameSubscribe, nullptr, 0)) return false;
     }
-    if (!registered_id_.empty()) {
-      // Replay the registration exactly like the Subscribe frame: a
+    for (const auto& id : registered_ids_) {
+      // Replay every registration exactly like the Subscribe frame: a
       // transient disconnect must not leave a live, self-healed agent
-      // unregistered (the server's drop() of the old conn emits an
-      // unregister). The IdLogged reply is discarded by the next
-      // want-filtered recv.
+      // (or any logical lane of a vector host) unregistered — the
+      // server's drop() of the old conn emits unregisters for them all.
+      // The IdLogged replies are discarded by the next want-filtered
+      // recv.
       if (!send_frame(kFrameModelSet,
-                      reinterpret_cast<const uint8_t*>(registered_id_.data()),
-                      registered_id_.size()))
+                      reinterpret_cast<const uint8_t*>(id.data()),
+                      id.size()))
         return false;
     }
     return true;
   }
 
-  void mark_registered(const char* id) { registered_id_ = id; }
+  void mark_registered(const char* id) {
+    if (std::find(registered_ids_.begin(), registered_ids_.end(), id) ==
+        registered_ids_.end())
+      registered_ids_.emplace_back(id);
+  }
 
   // Serializes whole operations (send+recv+reconnect sequences) across
   // the threads sharing this client. Recursive: ops call send_frame /
@@ -707,7 +726,7 @@ class Client {
   uint16_t port_ = 0;
   int timeout_ms_ = 5000;
   bool subscribed_ = false;
-  std::string registered_id_;  // replayed on reconnect
+  std::vector<std::string> registered_ids_;  // replayed on reconnect
   bool timed_out_ = false;
 
   std::thread reader_;

@@ -36,7 +36,7 @@ def __getattr__(name):
     # Lazy imports for heavyweight submodules so `import relayrl_tpu` stays
     # cheap in actor processes that only need types + config.
     if name in ("TrainingServer", "Agent", "LocalRunner",
-                "ApplicationAbstract"):
+                "ApplicationAbstract", "VectorAgent", "VectorActorHost"):
         from relayrl_tpu import runtime
 
         return getattr(runtime, name)

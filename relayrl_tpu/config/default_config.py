@@ -154,6 +154,22 @@ DEFAULT_CONFIG: dict = {
     },
     "grpc_idle_timeout_s": 30.0,
     "max_traj_length": 1000,
+    # -- actor plane (docs/architecture.md "actor topology") --
+    "actor": {
+        # Environment lanes per actor process. 1 = the reference's
+        # one-env-per-process shape; >1 turns the process into a vector
+        # actor host: one batched jitted policy step serves num_envs
+        # logical agents over a single transport connection
+        # (runtime/vector_actor.py). The north-star "64 actors" row runs
+        # as e.g. 4 processes x 16 lanes instead of 64 processes.
+        "num_envs": 1,
+        # "process" = one Agent per env (reference parity);
+        # "vector" = VectorAgent host stepping num_envs lanes.
+        # examples/train_distributed.py reads it to pick the actor
+        # topology (--num-envs overrides); benches/bench_soak.py's
+        # --vector flag is the bench-plane equivalent.
+        "host_mode": "process",
+    },
     "model_paths": {
         "client_model": "client_model.rlx",
         "server_model": "server_model.rlx",

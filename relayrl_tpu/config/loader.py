@@ -189,6 +189,20 @@ class ConfigLoader:
         params.update(self._section("learner"))
         return params
 
+    def get_actor_params(self) -> dict[str, Any]:
+        """Actor-plane knobs (``actor.num_envs`` / ``actor.host_mode``),
+        defaults merged under user overrides like every other section —
+        malformed values degrade to the one-env-per-process default."""
+        params = dict(DEFAULT_CONFIG["actor"])
+        params.update(self._section("actor"))
+        try:
+            params["num_envs"] = max(1, int(params.get("num_envs", 1)))
+        except (TypeError, ValueError):
+            params["num_envs"] = 1
+        if params.get("host_mode") not in ("process", "vector"):
+            params["host_mode"] = "process"
+        return params
+
     def raw(self) -> dict:
         return self._raw
 

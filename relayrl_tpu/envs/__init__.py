@@ -17,6 +17,7 @@ from relayrl_tpu.envs.atari import (
 from relayrl_tpu.envs.classic import CartPoleEnv, PendulumEnv
 from relayrl_tpu.envs.memory import RecallEnv
 from relayrl_tpu.envs.spaces import Box, Discrete
+from relayrl_tpu.envs.vector import SyncVectorEnv, make_vector
 
 _BUILTIN = {
     "CartPole-v1": CartPoleEnv,
@@ -46,4 +47,5 @@ def make(env_id: str, **kwargs):
 
 
 __all__ = ["make", "make_atari", "AtariPreprocessing", "SyntheticPixelEnv",
-           "CartPoleEnv", "PendulumEnv", "RecallEnv", "Box", "Discrete"]
+           "CartPoleEnv", "PendulumEnv", "RecallEnv", "Box", "Discrete",
+           "SyncVectorEnv", "make_vector"]

@@ -1,0 +1,82 @@
+"""Stacked-env driver: N gym-likes behind one batched reset/step surface.
+
+The vector actor plane (``runtime/vector_actor.py``) steps N environment
+lanes against a single batched policy dispatch; this module supplies the
+matching env side — a synchronous vector wrapper over the built-in (or
+Gymnasium) gym-likes with **per-env autoreset**: a lane that terminates or
+truncates is reset inside the same ``step`` call, its pre-reset
+observation preserved in that lane's info dict under
+``"final_observation"`` (the Gymnasium VectorEnv convention) so time-limit
+bootstrapping still sees the successor state.
+
+Synchronous on purpose: the policy apply is the batched, jitted part; env
+dynamics here are cheap numpy loops, and a thread/process pool per env
+would reintroduce exactly the oversubscription the vector host removes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+class SyncVectorEnv:
+    """N same-shaped gym-like envs stepped in lockstep with autoreset."""
+
+    def __init__(self, env_fns: Sequence[Callable[[], object]]):
+        if not env_fns:
+            raise ValueError("SyncVectorEnv needs at least one env factory")
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        self.observation_space = self.envs[0].observation_space
+        self.action_space = self.envs[0].action_space
+
+    def reset(self, seed: int | None = None):
+        """Reset every lane; per-lane seeds are ``seed + lane`` so lanes
+        decorrelate while the whole stack stays reproducible."""
+        obs_rows, infos = [], []
+        for lane, env in enumerate(self.envs):
+            obs, info = env.reset(
+                seed=None if seed is None else seed + lane)
+            obs_rows.append(np.asarray(obs))
+            infos.append(info)
+        return np.stack(obs_rows), infos
+
+    def step(self, actions):
+        """Step every lane; finished lanes autoreset in place.
+
+        Returns ``(obs[N,...], rewards[N], terminated[N], truncated[N],
+        infos)`` where a finished lane's ``obs`` row is already the reset
+        observation of its NEXT episode and its info dict carries
+        ``final_observation`` (the pre-reset obs).
+        """
+        obs_rows, rewards, terms, truncs, infos = [], [], [], [], []
+        for env, action in zip(self.envs, actions):
+            obs, reward, terminated, truncated, info = env.step(action)
+            if terminated or truncated:
+                info = dict(info)
+                info["final_observation"] = np.asarray(obs)
+                obs, _ = env.reset()
+            obs_rows.append(np.asarray(obs))
+            rewards.append(reward)
+            terms.append(bool(terminated))
+            truncs.append(bool(truncated))
+            infos.append(info)
+        return (np.stack(obs_rows), np.asarray(rewards, np.float32),
+                np.asarray(terms, bool), np.asarray(truncs, bool), infos)
+
+    def close(self) -> None:
+        for env in self.envs:
+            close = getattr(env, "close", None)
+            if close is not None:
+                close()
+
+
+def make_vector(env_id: str, num_envs: int, **kwargs) -> SyncVectorEnv:
+    """``envs.make`` × N behind the stacked surface."""
+    from relayrl_tpu.envs import make
+
+    return SyncVectorEnv(
+        [(lambda _env_id=env_id: make(_env_id, **kwargs))
+         for _ in range(num_envs)])

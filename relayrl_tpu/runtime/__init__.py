@@ -1,15 +1,23 @@
-"""Runtime processes: actors, local runner, training server."""
+"""Runtime processes: actors, vector actor hosts, local runner, training
+server."""
 
 from relayrl_tpu.runtime.application import ApplicationAbstract
 from relayrl_tpu.runtime.policy_actor import PolicyActor
 from relayrl_tpu.runtime.local_runner import LocalRunner
 
-__all__ = ["ApplicationAbstract", "PolicyActor", "LocalRunner"]
+__all__ = ["ApplicationAbstract", "PolicyActor", "LocalRunner",
+           "VectorActorHost", "VectorAgent"]
 
 
 def __getattr__(name):
-    if name in ("TrainingServer", "Agent"):
+    if name in ("TrainingServer", "Agent", "VectorAgent"):
         from relayrl_tpu.runtime import server as _server, agent as _agent
 
-        return {"TrainingServer": _server.TrainingServer, "Agent": _agent.Agent}[name]
+        return {"TrainingServer": _server.TrainingServer,
+                "Agent": _agent.Agent,
+                "VectorAgent": _agent.VectorAgent}[name]
+    if name == "VectorActorHost":
+        from relayrl_tpu.runtime import vector_actor as _va
+
+        return _va.VectorActorHost
     raise AttributeError(f"module 'relayrl_tpu.runtime' has no attribute {name!r}")

@@ -145,6 +145,156 @@ class Agent:
         self.disable_agent()
 
 
+class VectorAgent:
+    """Networked vector actor host: N logical agents over ONE connection.
+
+    The process-topology answer to the north-star "64 actors" row: where
+    64 :class:`Agent` processes oversubscribe a host, one VectorAgent
+    steps ``num_envs`` environment lanes through a single batched jitted
+    policy dispatch (:class:`~relayrl_tpu.runtime.vector_actor.
+    VectorActorHost`) and presents each lane to the training server as
+    its own logical agent — N registry entries, N attributed trajectory
+    streams, one socket, one model subscription, one atomic hot-swap.
+
+    Agent-compatible lifecycle (``enable_agent``/``disable_agent``/
+    context manager/``model_version``); the action surface is batched
+    (``request_for_actions`` / per-lane ``flag_last_action``) because
+    that is the point.
+    """
+
+    def __init__(
+        self,
+        num_envs: int | None = None,
+        model_path: str | None = None,
+        config_path: str | None = None,
+        server_type: str = "zmq",
+        handshake_timeout_s: float = 60.0,
+        seed: int | None = None,
+        start: bool = True,
+        identity: str | None = None,
+        **addr_overrides,
+    ):
+        self.config = ConfigLoader(None, config_path)
+        actor_params = self.config.get_actor_params()
+        self.num_envs = int(num_envs if num_envs is not None
+                            else actor_params.get("num_envs", 1))
+        if self.num_envs < 1:
+            raise ValueError(f"num_envs must be >= 1, got {self.num_envs}")
+        self.server_type = server_type
+        self._addr_overrides = addr_overrides
+        self._identity = identity
+        self.client_model_path = (model_path
+                                  or self.config.get_client_model_path())
+        self._handshake_timeout_s = handshake_timeout_s
+        self._seed = os.getpid() if seed is None else seed
+        self.host = None
+        self.transport = None
+        self.agent_ids: list[str] = []
+        self.active = False
+        if start:
+            self.enable_agent()
+
+    def enable_agent(self) -> None:
+        if self.active:
+            return
+        from relayrl_tpu.runtime.vector_actor import VectorActorHost
+
+        overrides = dict(self._addr_overrides)
+        overrides.setdefault("negotiate_window_s",
+                             min(self._handshake_timeout_s * 0.5, 30.0))
+        if self._identity is not None:
+            overrides.setdefault("identity", self._identity)
+        self.transport = make_agent_transport(
+            self.server_type, self.config, **overrides)
+        version, bundle_bytes = self.transport.fetch_model(
+            self._handshake_timeout_s)
+        bundle = ModelBundle.from_bytes(bundle_bytes)
+        bundle.version = version
+        try:
+            bundle.save(self.client_model_path)
+        except OSError:
+            pass
+        # Lane ids derive from the connection identity so a fleet of
+        # vector hosts never collides; the server sees N distinct agents.
+        self.agent_ids = [f"{self.transport.identity}.lane{k}"
+                          for k in range(self.num_envs)]
+        if self.host is None:
+            self.host = VectorActorHost(
+                bundle,
+                num_envs=self.num_envs,
+                max_traj_length=self.config.get_max_traj_length(),
+                on_send=self._send_lane,
+                seed=self._seed,
+            )
+        else:
+            self.host.maybe_swap(bundle)
+        # One registration round-trip per logical lane, all over the one
+        # connection (the transports' multi-id contract, base.py).
+        for agent_id in self.agent_ids:
+            if not self.transport.register(agent_id):
+                raise RuntimeError(
+                    f"logical-agent registration failed for {agent_id!r}")
+        self.transport.on_model = self._on_model
+        self.transport.start_model_listener()
+        self.active = True
+
+    def disable_agent(self) -> None:
+        if not self.active:
+            return
+        self.transport.close()
+        self.transport = None
+        self.active = False
+
+    def _send_lane(self, lane: int, payload: bytes) -> None:
+        self.transport.send_trajectory(payload,
+                                       agent_id=self.agent_ids[lane])
+
+    def _on_model(self, version: int, bundle_bytes: bytes) -> None:
+        # ONE receipt serves all lanes: a single maybe_swap atomically
+        # installs the new params for the whole batch.
+        try:
+            bundle = ModelBundle.from_bytes(bundle_bytes)
+            bundle.version = version
+            if self.host.maybe_swap(bundle):
+                try:
+                    bundle.save(self.client_model_path)
+                except OSError:
+                    pass
+        except Exception as e:
+            print(f"[VectorAgent] rejected model update: {e!r}", flush=True)
+
+    # -- batched action API --
+    def request_for_actions(self, obs, masks=None, rewards=None):
+        self._require_active()
+        return self.host.request_for_actions(obs, masks=masks,
+                                             rewards=rewards)
+
+    def flag_last_action(self, lane: int, reward: float = 0.0,
+                         truncated: bool = False, final_obs=None,
+                         terminated: bool | None = None,
+                         final_mask=None) -> None:
+        self._require_active()
+        self.host.flag_last_action(lane, reward, truncated=truncated,
+                                   final_obs=final_obs,
+                                   terminated=terminated,
+                                   final_mask=final_mask)
+
+    @property
+    def model_version(self) -> int:
+        return -1 if self.host is None else self.host.version
+
+    def _require_active(self) -> None:
+        if not self.active or self.host is None:
+            raise RuntimeError(
+                "vector agent is not active (call enable_agent())")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disable_agent()
+
+
 def run_gym_loop(agent: Agent, env, episodes: int, max_steps: int = 1000,
                  seed: int | None = None) -> list[float]:
     """The reference's canonical notebook loop (examples/README.md:125-152):

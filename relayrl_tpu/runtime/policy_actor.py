@@ -31,6 +31,82 @@ from relayrl_tpu.types.model_bundle import (
 from relayrl_tpu.types.trajectory import Trajectory
 
 
+def resolve_actor_context(arch) -> int:
+    """Serving-window length for sequence policies: the model's full
+    context unless ``actor_context`` narrows it. Shared by PolicyActor
+    and VectorActorHost so the positional-table guard can never drift
+    between the single and batched serving paths."""
+    # Same default as build_transformer_discrete (transformer.py): the
+    # model's positional table is 1024 rows when the arch omits the key,
+    # so the serving window must agree or context silently truncates.
+    max_seq = int(arch.get("max_seq_len", 1024))
+    ctx = int(arch.get("actor_context", max_seq))
+    if ctx > max_seq:
+        raise ValueError(
+            f"actor_context {ctx} exceeds the model's max_seq_len "
+            f"{max_seq} (positional table size)")
+    return ctx
+
+
+def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
+    """Shared hot-swap gate: version check, arch-ABI guard, params
+    install under the actor's lock. PolicyActor and VectorActorHost
+    delegate here (same attribute contract: ``version``, ``arch``,
+    ``params``, ``_explore_kwargs``, ``_lock``) so the swap semantics —
+    including the exploration-knob refresh that must NOT rebuild the
+    policy — exist exactly once."""
+    if bundle.version <= actor.version:
+        return False
+    if not arch_equal(bundle.arch, actor.arch):
+        raise ValueError(
+            f"model arch changed {actor.arch} -> {bundle.arch}; "
+            "actor refuses hot-swap (param-ABI guard)")
+    with actor._lock:
+        if dict(bundle.arch) != actor.arch:
+            # Exploration knobs (epsilon/act_noise) changed: they are
+            # traced step arguments, so only the scalar values refresh —
+            # no policy rebuild, no retrace.
+            actor.arch = dict(bundle.arch)
+            actor._explore_kwargs = exploration_kwargs(actor.arch)
+        actor.params = bundle.params
+        actor.version = bundle.version
+    return True
+
+
+def make_batched_step(policy):
+    """One jitted, vmapped sampling step over stacked per-lane inputs:
+    ``fn(params, keys[N,2], obs[N,...], masks, explore) -> (acts, aux,
+    next_keys)`` — the VectorActorHost hot path (N logical agents, one
+    dispatch). Composition is exactly ``_fuse_rng(policy.step)`` per lane
+    (split inside the trace, params broadcast), so a batch-of-1 call is
+    bit-identical to PolicyActor's single step for the same key — the
+    vector host is a batching change, not a numerics change. ``masks`` is
+    ``None`` (maskless policies: no leaves, so the in_axes spec is inert)
+    or a stacked ``[N, act_dim]`` array; ``explore`` is the
+    :func:`exploration_kwargs` dict, broadcast as traced scalars so
+    annealing a knob never retraces."""
+    def _single(params, rng, obs, mask, explore):
+        next_rng, sub = jax.random.split(rng)
+        act, aux = policy.step(params, sub, obs, mask, **explore)
+        return act, aux, next_rng
+
+    return jax.jit(jax.vmap(_single, in_axes=(None, 0, 0, 0, None)))
+
+
+def make_batched_window_step(policy):
+    """Vmapped :attr:`Policy.step_window` for sequence policies:
+    ``fn(params, keys[N,2], windows[N,W,obs], ts[N], masks) -> (acts, aux,
+    next_keys)``. Per-lane window lengths ride as a traced vector, so
+    lanes at different episode positions share one compiled signature
+    (same property the single-actor padded-window path relies on)."""
+    def _single(params, rng, window, t, mask):
+        next_rng, sub = jax.random.split(rng)
+        act, aux = policy.step_window(params, sub, window, t, mask)
+        return act, aux, next_rng
+
+    return jax.jit(jax.vmap(_single, in_axes=(None, 0, 0, 0, 0)))
+
+
 def _fuse_rng(step_fn):
     """Move the per-step ``jax.random.split`` INSIDE the jitted function:
     the wrapped fn takes the carried key and returns ``(*outputs,
@@ -79,16 +155,7 @@ class PolicyActor:
         self._window = None
         self._window_len = 0
         if self.policy.step_window is not None:
-            # Same default as build_transformer_discrete (transformer.py):
-            # the model's positional table is 1024 rows when the arch omits
-            # the key, so the serving window must agree or context silently
-            # truncates.
-            max_seq = int(self.arch.get("max_seq_len", 1024))
-            ctx = int(self.arch.get("actor_context", max_seq))
-            if ctx > max_seq:
-                raise ValueError(
-                    f"actor_context {ctx} exceeds the model's max_seq_len "
-                    f"{max_seq} (positional table size)")
+            ctx = resolve_actor_context(self.arch)
             self._window = np.zeros((ctx, int(self.arch["obs_dim"])),
                                     np.float32)
             self._window_fn = jax.jit(_fuse_rng(self.policy.step_window))
@@ -243,23 +310,7 @@ class PolicyActor:
         """Install a newer model; stale or arch-mismatched bundles are
         rejected (version checking the reference's proto defines but never
         implements — training_grpc.rs:722-725)."""
-        if bundle.version <= self.version:
-            return False
-        if not arch_equal(bundle.arch, self.arch):
-            raise ValueError(
-                f"model arch changed {self.arch} -> {bundle.arch}; "
-                "actor refuses hot-swap (param-ABI guard)"
-            )
-        with self._lock:
-            if dict(bundle.arch) != self.arch:
-                # Exploration knobs (epsilon/act_noise) changed: they are
-                # traced step arguments, so only the scalar values refresh —
-                # no policy rebuild, no retrace.
-                self.arch = dict(bundle.arch)
-                self._explore_kwargs = exploration_kwargs(self.arch)
-            self.params = bundle.params
-            self.version = bundle.version
-        return True
+        return apply_bundle_swap(self, bundle)
 
     def swap_from_bytes(self, buf: bytes) -> bool:
         return self.maybe_swap(ModelBundle.from_bytes(buf))

@@ -13,6 +13,13 @@ Wire protocol (same message surface as the reference, SURVEY.md §2.3):
                agent → ``MODEL_SET <agent_id>`` → server replies ``ID_LOGGED``
 * trajectory:  agent → envelope{agent_id, trajectory bytes} (fire-and-forget)
 * model push:  server → broadcast {version, bundle bytes} to all agents
+
+Logical-agent multiplexing (vector actor hosts): one connection may carry
+N *logical* agents — ``register`` is callable N times with distinct ids,
+each producing its own server-side registry entry, and ``send_trajectory``
+takes an optional ``agent_id`` that stamps the envelope so per-agent
+trajectory attribution survives the shared socket. The model subscription
+stays per-connection (one receipt fans into every logical lane host-side).
 """
 
 from __future__ import annotations
@@ -100,10 +107,18 @@ class AgentTransport(abc.ABC):
 
     @abc.abstractmethod
     def register(self, agent_id: str, timeout_s: float = 10.0) -> bool:
-        """MODEL_SET/ID_LOGGED registration."""
+        """MODEL_SET/ID_LOGGED registration. May be called multiple times
+        with distinct ids: each registers one logical agent on this
+        connection (vector actor hosts multiplex N lanes over one socket).
+        """
 
     @abc.abstractmethod
-    def send_trajectory(self, payload: bytes) -> None: ...
+    def send_trajectory(self, payload: bytes,
+                        agent_id: str | None = None) -> None:
+        """Ship one serialized trajectory. ``agent_id`` stamps the wire
+        envelope (defaults to the connection identity) — vector hosts pass
+        the owning logical lane's id so server-side attribution is
+        per-logical-agent, not per-socket."""
 
     @abc.abstractmethod
     def start_model_listener(self) -> None:

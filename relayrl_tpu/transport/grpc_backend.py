@@ -66,6 +66,14 @@ class _Servicer:
         if first_time:
             self._owner.on_register(agent_id)
         version, bundle = self._owner.get_model()
+        if first_time and version <= known_version:
+            # Logical-lane registration (vector hosts): the registrant
+            # already holds the current model, so the ack is
+            # metadata-sized instead of shipping the full bundle once
+            # per lane. Genuine handshakes send ver=-1 and still get
+            # the bundle below.
+            return msgpack.packb({"code": 1, "ver": version},
+                                 use_bin_type=True)
         if first_time or version > known_version:
             return msgpack.packb({"code": 1, "ver": version, "model": bundle},
                                  use_bin_type=True)
@@ -160,9 +168,13 @@ class GrpcAgentTransport(AgentTransport):
         self._stop = threading.Event()
         self._listener: threading.Thread | None = None
 
-    def _poll_once(self, first: bool, timeout_s: float):
+    def _poll_once(self, first: bool, timeout_s: float,
+                   known_version: int | None = None):
         req = msgpack.packb(
-            {"id": self.identity, "ver": self._known_version, "first": first},
+            {"id": self.identity,
+             "ver": (self._known_version if known_version is None
+                     else known_version),
+             "first": first},
             use_bin_type=True)
         # future-based invocation so close() can cancel a parked long-poll
         # instead of waiting out its full timeout (64 agents x 35 s
@@ -173,7 +185,9 @@ class GrpcAgentTransport(AgentTransport):
             resp = msgpack.unpackb(call.result(), raw=False)
         finally:
             self._inflight = None
-        if resp.get("code") == 1:
+        # A code-1 ack without a bundle (the servicer's metadata-only
+        # registration reply) is not a model delivery.
+        if resp.get("code") == 1 and "model" in resp:
             self._known_version = int(resp["ver"])
             return int(resp["ver"]), resp["model"]
         return None
@@ -186,8 +200,13 @@ class GrpcAgentTransport(AgentTransport):
         last_err: Exception | None = None
         while time.monotonic() < deadline:
             try:
+                # ver=-1 regardless of _known_version: a handshake wants
+                # the bundle unconditionally — without it, a re-handshake
+                # on a transport already at the server's version would
+                # draw the metadata-only ack and spin to timeout.
                 result = self._poll_once(first=True, timeout_s=min(
-                    5.0, max(0.1, deadline - time.monotonic())))
+                    5.0, max(0.1, deadline - time.monotonic())),
+                    known_version=-1)
                 if result is not None:
                     return result
             except grpc.RpcError as e:
@@ -196,15 +215,32 @@ class GrpcAgentTransport(AgentTransport):
         raise TimeoutError(f"gRPC model handshake timed out: {last_err}")
 
     def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
-        # Registration rides the first_time ClientPoll (one RPC fewer than
-        # the ZMQ plane); fetch_model() already registered us.
-        return True
+        # The connection identity registers via the first_time ClientPoll
+        # (one RPC fewer than the ZMQ plane); fetch_model() already did it.
+        # A LOGICAL agent id (vector host lane) has no poll loop of its
+        # own, so it registers with a one-shot first_time poll carrying
+        # the CURRENT known version — the Python servicer then acks
+        # metadata-only (no redundant bundle per lane; the native C++
+        # gRPC server still ships the bundle, which is discarded — the
+        # shared listener owns model delivery for the whole connection).
+        if agent_id is None or agent_id == self.identity:
+            return True
+        req = msgpack.packb({"id": agent_id, "ver": self._known_version,
+                             "first": True}, use_bin_type=True)
+        try:
+            resp = msgpack.unpackb(self._poll(req, timeout=timeout_s),
+                                   raw=False)
+        except grpc.RpcError:
+            return False
+        return resp.get("code") == 1
 
-    def send_trajectory(self, payload: bytes) -> None:
+    def send_trajectory(self, payload: bytes,
+                        agent_id: str | None = None) -> None:
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
         resp = msgpack.unpackb(
-            self._send(pack_trajectory_envelope(self.identity, payload), timeout=30.0),
+            self._send(pack_trajectory_envelope(agent_id or self.identity,
+                                                payload), timeout=30.0),
             raw=False)
         if resp.get("code") != 1:
             raise RuntimeError(f"trajectory rejected: {resp.get('error')}")

@@ -327,11 +327,12 @@ class NativeAgentTransportImpl(AgentTransport):
             ctrl, (agent_id or self.identity).encode(), int(timeout_s * 1000))
         return rc == 0
 
-    def send_trajectory(self, payload: bytes) -> None:
+    def send_trajectory(self, payload: bytes,
+                        agent_id: str | None = None) -> None:
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
         ctrl = self._ensure_ctrl(5.0)
-        env = pack_trajectory_envelope(self.identity, payload)
+        env = pack_trajectory_envelope(agent_id or self.identity, payload)
         data = _buf(env)
         if self._lib.rl_client_send_traj(ctrl, data, len(env)) != 0:
             raise RuntimeError("native trajectory send failed")

@@ -227,11 +227,13 @@ class ZmqAgentTransport(AgentTransport):
             want=REPLY_ID_LOGGED)
         return reply is not None
 
-    def send_trajectory(self, payload: bytes) -> None:
+    def send_trajectory(self, payload: bytes,
+                        agent_id: str | None = None) -> None:
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
         with self._push_lock:
-            self._push.send(pack_trajectory_envelope(self.identity, payload))
+            self._push.send(pack_trajectory_envelope(
+                agent_id or self.identity, payload))
 
     def start_model_listener(self) -> None:
         if self._listener is not None:
