@@ -67,7 +67,7 @@ def start_barrier_wait(cfg: dict, ident: str, publish_ready: bool) -> None:
         time.sleep(0.05)
 
 
-def drain_receipt_grace(transport, receipts: list, native_ledger: bool,
+def drain_receipt_grace(transport, receipts: list, has_ledger: bool,
                         grace_s: float) -> None:
     """Shared grace drain: listener threads may lag the env loops by
     seconds on an oversubscribed host — frames already delivered to this
@@ -81,7 +81,7 @@ def drain_receipt_grace(transport, receipts: list, native_ledger: bool,
     quiet_since = start
     last = len(receipts)
     while time.time() < deadline:
-        if native_ledger:
+        if has_ledger:
             receipts.extend(transport.drain_receipts())
         if len(receipts) != last:
             last = len(receipts)
@@ -124,8 +124,14 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
     # (publish, agent) pair as expected only if this agent subscribed
     # before the publish.
     sub_ts = time.monotonic_ns()
-    native_ledger = hasattr(agent.transport, "drain_receipts")
-    if not native_ledger:
+    # All three backends now expose a pre-decode receipt ledger (the
+    # native C++ reader's, mirrored in the zmq/grpc listener threads) —
+    # stamps are taken the moment the frame leaves the socket, so GIL
+    # pressure on the decode/swap path can no longer eat receipts
+    # (ISSUE 4 satellite: the zmq 64-actor 0.433 investigation). The
+    # on_model fallback below stays for custom transports without one.
+    has_ledger = hasattr(agent.transport, "drain_receipts")
+    if not has_ledger:
         orig_on_model = agent.transport.on_model
 
         def on_model(version, bundle_bytes):
@@ -176,7 +182,7 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         barrier.wait(timeout=30)
     except threading.BrokenBarrierError:
         pass
-    drain_receipt_grace(agent.transport, receipts, native_ledger,
+    drain_receipt_grace(agent.transport, receipts, has_ledger,
                         cfg.get("receipt_grace_s", 8.0))
     out[agent_idx] = {
         "identity": ident,
@@ -222,8 +228,8 @@ def vector_host_loop(cfg: dict) -> list[dict]:
     )
     receipts: list[tuple[int, int]] = []
     sub_ts = time.monotonic_ns()
-    native_ledger = hasattr(agent.transport, "drain_receipts")
-    if not native_ledger:
+    has_ledger = hasattr(agent.transport, "drain_receipts")
+    if not has_ledger:
         orig_on_model = agent.transport.on_model
 
         def on_model(version, bundle_bytes):
@@ -257,7 +263,7 @@ def vector_host_loop(cfg: dict) -> list[dict]:
     except Exception as e:
         crashed = repr(e)
     window_end_ns = time.monotonic_ns()
-    drain_receipt_grace(agent.transport, receipts, native_ledger,
+    drain_receipt_grace(agent.transport, receipts, has_ledger,
                         cfg.get("receipt_grace_s", 8.0))
     unsub_ts = time.monotonic_ns()
     rows = []

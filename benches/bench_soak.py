@@ -52,6 +52,19 @@ from common import bench_cwd, free_port, setup_platform  # noqa: E402
 setup_platform()
 
 
+def _fresh_bench_registry(run_id: str):
+    """One fresh telemetry registry per bench row, installed in THIS
+    (server-hosting) process: every row then embeds a snapshot whose
+    schema is exactly the production ``/snapshot`` endpoint's — bench
+    artifacts and live scrapes are read by the same tooling. Fresh per
+    row so curve rows don't accumulate each other's counters."""
+    from relayrl_tpu import telemetry
+
+    registry = telemetry.Registry(run_id=run_id)
+    telemetry.set_registry(registry)
+    return registry
+
+
 def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
@@ -64,6 +77,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     LOGICAL agents the server sees, so rows are directly comparable with
     process-per-actor rows at the same n_actors."""
     from relayrl_tpu.runtime.server import TrainingServer
+
+    _fresh_bench_registry(f"soak-{transport}-{n_actors}")
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
     if transport == "native":
@@ -285,6 +300,12 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         "window_span_s": window_span_s,
         "wall_s": round(wall, 1),
     }
+    # Server-plane telemetry snapshot (ingest, pipeline, transport-server
+    # metrics of THIS process; worker-process actor metrics live in the
+    # workers) — same schema as the live /snapshot endpoint.
+    from relayrl_tpu import telemetry
+
+    result["telemetry"] = telemetry.get_registry().snapshot()
     server.disable_server()
     return result
 
@@ -348,6 +369,7 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
     from relayrl_tpu.types.action import ActionRecord
     from relayrl_tpu.types.trajectory import serialize_actions
 
+    _fresh_bench_registry(f"blast-{transport}-{n_traj}")
     scratch = tempfile.mkdtemp(prefix="relayrl_blast_")
     if transport in ("native", "grpc"):
         port = free_port()
@@ -515,6 +537,9 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
         # msgpack).
         "timings_s": {k: round(v, 3) for k, v in server.timings.items()},
     }
+    from relayrl_tpu import telemetry
+
+    result["telemetry"] = telemetry.get_registry().snapshot()
     if not profile:
         result["ingest_trajectories_per_sec"] = round(
             stats["trajectories"] / total_s, 1)

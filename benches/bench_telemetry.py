@@ -1,0 +1,141 @@
+"""Telemetry hot-path cost: disabled (null) vs enabled metric operations.
+
+The subsystem's design contract (ISSUE 4): instrumentation sites hold a
+direct metric reference, so the DISABLED cost is one attribute call on a
+shared null object, and the ENABLED cost is a threading.local read plus
+a plain ``+=`` on a per-thread shard — no lock either way. This bench
+measures both (plus histogram observe and snapshot aggregation) and
+ASSERTS the contract so a regression that sneaks a lock or an allocation
+into ``inc()`` fails loudly rather than shaving fleet throughput
+silently.
+
+Prints one JSON line per row; ``--write`` commits to
+benches/results/telemetry.json.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from common import quick, setup_platform  # noqa: E402
+
+setup_platform()
+
+# Generous ceilings on a noisy shared host — an order of magnitude above
+# the measured numbers, tight enough to catch "someone added a lock /
+# registry lookup to the hot path" (~10x regressions).
+MAX_DISABLED_NS = 1500.0
+MAX_ENABLED_COUNTER_NS = 3000.0
+
+
+def _best_ns_per_op(fn, n_ops: int, trials: int) -> float:
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter_ns()
+        fn(n_ops)
+        best = min(best, (time.perf_counter_ns() - t0) / n_ops)
+    return best
+
+
+def _loop_baseline(n_ops: int, trials: int) -> float:
+    """Cost of the bare ``for _ in range(n)`` loop, subtracted from every
+    row so the numbers are per-call, not per-iteration-plus-loop."""
+    def body(n):
+        for _ in range(n):
+            pass
+    return _best_ns_per_op(body, n_ops, trials)
+
+
+def run() -> list[dict]:
+    from relayrl_tpu.telemetry import NullRegistry, Registry
+
+    n_ops = 200_000 if quick() else 1_000_000
+    trials = 3 if quick() else 5
+    base_ns = _loop_baseline(n_ops, trials)
+
+    null_counter = NullRegistry().counter("relayrl_bench_total")
+    reg = Registry(run_id="bench")
+    counter = reg.counter("relayrl_bench_total")
+    hist = reg.histogram("relayrl_bench_seconds")
+    # A registry the size of the instrumented framework (~40 families)
+    # so the snapshot row measures a realistic aggregation.
+    for i in range(40):
+        reg.counter(f"relayrl_bench_fam{i}_total").inc(i)
+
+    def inc_null(n):
+        inc = null_counter.inc
+        for _ in range(n):
+            inc()
+
+    def inc_real(n):
+        inc = counter.inc
+        for _ in range(n):
+            inc()
+
+    def observe_real(n):
+        observe = hist.observe
+        for _ in range(n):
+            observe(0.003)
+
+    rows = []
+
+    def row(name, ns, extra=None):
+        entry = {"bench": "telemetry_hotpath",
+                 "config": {"op": name, "n_ops": n_ops, "trials": trials},
+                 "ns_per_op": round(ns, 1), "unit": "ns/op",
+                 **(extra or {})}
+        print(json.dumps(entry))
+        rows.append(entry)
+        return entry
+
+    disabled_ns = _best_ns_per_op(inc_null, n_ops, trials) - base_ns
+    enabled_ns = _best_ns_per_op(inc_real, n_ops, trials) - base_ns
+    observe_ns = _best_ns_per_op(observe_real, n_ops, trials) - base_ns
+
+    row("counter_inc_disabled", disabled_ns,
+        {"ceiling_ns": MAX_DISABLED_NS})
+    row("counter_inc_enabled", enabled_ns,
+        {"ceiling_ns": MAX_ENABLED_COUNTER_NS})
+    row("histogram_observe_enabled", observe_ns)
+
+    n_snap = 200 if quick() else 1000
+    t0 = time.perf_counter_ns()
+    for _ in range(n_snap):
+        reg.snapshot()
+    snap_us = (time.perf_counter_ns() - t0) / n_snap / 1000.0
+    entry = {"bench": "telemetry_snapshot",
+             "config": {"metric_families": 42, "n_ops": n_snap},
+             "us_per_snapshot": round(snap_us, 1), "unit": "us/snapshot"}
+    print(json.dumps(entry))
+    rows.append(entry)
+
+    # The contract asserts (the CI teeth): disabled must stay an
+    # attribute-call away from free, and the enabled increment must stay
+    # lock-free cheap.
+    assert counter.total() == n_ops * trials
+    assert disabled_ns < MAX_DISABLED_NS, (
+        f"disabled-path inc {disabled_ns:.0f}ns/op exceeds "
+        f"{MAX_DISABLED_NS}ns — the null object gained real work")
+    assert enabled_ns < MAX_ENABLED_COUNTER_NS, (
+        f"enabled inc {enabled_ns:.0f}ns/op exceeds "
+        f"{MAX_ENABLED_COUNTER_NS}ns — the shard hot path gained a "
+        f"lock/lookup")
+    return rows
+
+
+def main():
+    rows = run()
+    if "--write" in sys.argv:
+        import os
+
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "telemetry.json")
+        with open(out, "w") as f:
+            for entry in rows:
+                f.write(json.dumps(entry) + "\n")
+
+
+if __name__ == "__main__":
+    main()

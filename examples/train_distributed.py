@@ -59,6 +59,18 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
     from relayrl_tpu.envs import make
     from relayrl_tpu.runtime.agent import Agent, run_eval_loop, run_gym_loop
 
+    def _serve_actor_telemetry(tag: str) -> None:
+        # telemetry.enabled in the shared config gives every actor
+        # process its own registry (the Agent ctor configures it); the
+        # server owns telemetry.port, so actors export on an ephemeral
+        # port each — the printed URL is the per-actor scrape target
+        # (docs/observability.md).
+        from relayrl_tpu import telemetry
+
+        if telemetry.get_registry().enabled:
+            exporter = telemetry.serve(port=0)
+            print(f"[actor {tag}] telemetry at {exporter.url}", flush=True)
+
     if num_envs > 1:
         # Vector topology (actor.host_mode="vector" / --num-envs): this
         # process hosts num_envs logical agents behind one batched jitted
@@ -70,6 +82,7 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
 
         agent = VectorAgent(num_envs=num_envs, server_type=server_type,
                             seed=idx, **agent_addrs)
+        _serve_actor_telemetry(f"{idx} vector")
         venv = make_vector(_ENV_IDS[env_id], num_envs)
         t0 = time.time()
         per_lane: list[list[float]] = [[] for _ in range(num_envs)]
@@ -86,6 +99,7 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
         agent.disable_agent()
         return
     agent = Agent(server_type=server_type, seed=idx, **agent_addrs)
+    _serve_actor_telemetry(str(idx))
     env = make(_ENV_IDS[env_id])
     t0 = time.time()
     returns = run_gym_loop(agent, env, episodes=episodes, max_steps=max_steps)

@@ -170,6 +170,33 @@ DEFAULT_CONFIG: dict = {
         # --vector flag is the bench-plane equivalent.
         "host_mode": "process",
     },
+    # -- transport plane (docs/observability.md knob table) --
+    "transport": {
+        # Native-transport liveness cadence: the agent pings the control
+        # channel every heartbeat_s from its SUB thread (detects a dead
+        # server and heals the connection C++-side; the server's idle
+        # reaper keys off the same traffic). Was a hard-coded 5.0 in
+        # native_bindings.start_model_listener. <= 0 disables the beat.
+        "heartbeat_s": 5.0,
+    },
+    # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
+    "telemetry": {
+        # false = the process-global registry stays a NullRegistry: every
+        # instrumentation site holds a no-op metric and the hot-path cost
+        # is a single attribute call (benches/bench_telemetry.py).
+        "enabled": False,
+        # Exporter port for /metrics (Prometheus text) + /snapshot
+        # (JSON), served by the training-server process; 0 binds an
+        # ephemeral port (logged at startup).
+        "port": 9100,
+        "host": "127.0.0.1",
+        # NDJSON run-event journal (model publish/swap, agent register/
+        # unregister/reconnect, drop, checkpoint, drain). null disables.
+        "events_path": None,
+        # Run identity stamped on every snapshot and journal line; null
+        # derives one from pid + start time.
+        "run_id": None,
+    },
     "model_paths": {
         "client_model": "client_model.rlx",
         "server_model": "server_model.rlx",

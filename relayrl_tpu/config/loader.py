@@ -203,6 +203,33 @@ class ConfigLoader:
             params["host_mode"] = "process"
         return params
 
+    def get_transport_params(self) -> dict[str, Any]:
+        """Transport-plane knobs (``transport.heartbeat_s``), defaults
+        merged under user overrides; malformed values degrade to the
+        built-in cadence."""
+        params = dict(DEFAULT_CONFIG["transport"])
+        params.update(self._section("transport"))
+        try:
+            params["heartbeat_s"] = float(params.get("heartbeat_s", 5.0))
+        except (TypeError, ValueError):
+            params["heartbeat_s"] = 5.0
+        return params
+
+    def get_telemetry_params(self) -> dict[str, Any]:
+        """Observability knobs (``telemetry.*`` — see
+        docs/observability.md), defaults merged under user overrides.
+        Malformed ``enabled``/``port`` degrade to disabled/default-port
+        rather than crashing the process being observed."""
+        params = dict(DEFAULT_CONFIG["telemetry"])
+        params.update(self._section("telemetry"))
+        params["enabled"] = bool(params.get("enabled", False))
+        try:
+            params["port"] = int(params.get("port", 9100))
+        except (TypeError, ValueError):
+            params["port"] = 9100
+        params["host"] = str(params.get("host") or "127.0.0.1")
+        return params
+
     def raw(self) -> dict:
         return self._raw
 

@@ -41,6 +41,11 @@ class Agent:
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
+        # Actor-process observability: idempotent, so an agent living in
+        # the server's process joins the registry the server installed.
+        from relayrl_tpu import telemetry
+
+        telemetry.configure_from_config(self.config)
         self.server_type = server_type
         self._addr_overrides = addr_overrides
         self.client_model_path = model_path or self.config.get_client_model_path()
@@ -88,6 +93,10 @@ class Agent:
         self.transport.on_model = self._on_model
         self.transport.start_model_listener()
         self.active = True
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("agent_register", agent_id=self.transport.identity,
+                       version=version, side="agent")
 
     def disable_agent(self) -> None:
         if not self.active:
@@ -97,9 +106,12 @@ class Agent:
         self.active = False
 
     def restart_agent(self, **addr_overrides) -> None:
+        from relayrl_tpu import telemetry
+
         self.disable_agent()
         self._addr_overrides.update(addr_overrides)
         self.enable_agent()
+        telemetry.emit("agent_reconnect", agent_id=self.transport.identity)
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
         try:
@@ -175,6 +187,9 @@ class VectorAgent:
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
+        from relayrl_tpu import telemetry
+
+        telemetry.configure_from_config(self.config)
         actor_params = self.config.get_actor_params()
         self.num_envs = int(num_envs if num_envs is not None
                             else actor_params.get("num_envs", 1))
@@ -237,6 +252,10 @@ class VectorAgent:
         self.transport.on_model = self._on_model
         self.transport.start_model_listener()
         self.active = True
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("agent_register", agent_id=self.transport.identity,
+                       lanes=self.num_envs, version=version, side="agent")
 
     def disable_agent(self) -> None:
         if not self.active:

@@ -96,11 +96,20 @@ class InflightWindow:
     """
 
     def __init__(self, max_in_flight: int = 2):
+        from relayrl_tpu import telemetry
+
         self.max_in_flight = max(0, int(max_in_flight))
         self._entries: deque[Any] = deque()
         self.dispatch_count = 0   # total updates ever pushed
         self.fenced_count = 0     # total updates known complete
         self.device_wait_s = 0.0
+        reg = telemetry.get_registry()
+        self._m_device_wait = reg.histogram(
+            "relayrl_learner_device_wait_seconds",
+            "learner thread blocked fencing an in-flight update")
+        self._m_pending = reg.gauge(
+            "relayrl_learner_inflight_pending",
+            "dispatched-but-unfenced updates in the async window")
 
     @property
     def pending(self) -> int:
@@ -114,6 +123,7 @@ class InflightWindow:
         self.dispatch_count += 1
         while len(self._entries) > self.max_in_flight:
             self._fence_oldest()
+        self._m_pending.set(len(self._entries))
 
     def drain(self) -> None:
         """Fence every outstanding update (learner idle / shutdown /
@@ -127,8 +137,11 @@ class InflightWindow:
         fences = self._entries.popleft()
         t0 = time.monotonic()
         jax.block_until_ready(fences)
-        self.device_wait_s += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        self.device_wait_s += dt
         self.fenced_count += 1
+        self._m_device_wait.observe(dt)
+        self._m_pending.set(len(self._entries))
 
 
 @dataclasses.dataclass
@@ -166,6 +179,8 @@ class ModelPublisher:
 
     def __init__(self, publish_fn: Callable[[PublishSnapshot], None],
                  name: str = "model-publisher"):
+        from relayrl_tpu import telemetry
+
         self._publish_fn = publish_fn
         self._cond = threading.Condition()
         self._slot: PublishSnapshot | None = None
@@ -175,6 +190,20 @@ class ModelPublisher:
         self.coalesced = 0
         self.errors = 0
         self.publish_s = 0.0
+        reg = telemetry.get_registry()
+        self._m_published = reg.counter(
+            "relayrl_learner_publishes_total",
+            "model publishes that landed (gather+serialize+send)")
+        self._m_coalesced = reg.counter(
+            "relayrl_learner_publish_coalesced_total",
+            "queued publishes replaced latest-wins before starting")
+        self._m_errors = reg.counter(
+            "relayrl_learner_publish_errors_total",
+            "publish attempts that raised (transient socket/fs)")
+        self._m_publish = reg.histogram(
+            "relayrl_learner_publish_seconds",
+            "one publish on the publisher thread: D2H gather + serialize "
+            "+ socket + artifact write")
         self._thread = threading.Thread(target=self._loop, name=name,
                                         daemon=True)
         self._thread.start()
@@ -190,6 +219,7 @@ class ModelPublisher:
                 return
             if self._slot is not None:
                 self.coalesced += 1
+                self._m_coalesced.inc()
             self._slot = snapshot
             self._cond.notify()
 
@@ -225,11 +255,15 @@ class ModelPublisher:
             try:
                 self._publish_fn(snapshot)
                 self.published += 1
+                self._m_published.inc()
             except Exception as e:  # a transient socket/fs error must not
                 self.errors += 1    # kill the publish plane
+                self._m_errors.inc()
                 print(f"[ModelPublisher] publish error: {e!r}", flush=True)
             finally:
-                self.publish_s += time.monotonic() - t0
+                dt = time.monotonic() - t0
+                self.publish_s += dt
+                self._m_publish.observe(dt)
                 with self._cond:
                     self._busy = False
                     self._cond.notify_all()

@@ -54,13 +54,22 @@ def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
     delegate here (same attribute contract: ``version``, ``arch``,
     ``params``, ``_explore_kwargs``, ``_lock``) so the swap semantics —
     including the exploration-knob refresh that must NOT rebuild the
-    policy — exist exactly once."""
+    policy — exist exactly once. Being the one gate also makes it the
+    one swap-latency instrumentation point: the histogram measures the
+    lock wait + install (what a slow batched step in flight costs every
+    model delivery), and each installed version lands in the event
+    journal."""
+    import time
+
+    from relayrl_tpu import telemetry
+
     if bundle.version <= actor.version:
         return False
     if not arch_equal(bundle.arch, actor.arch):
         raise ValueError(
             f"model arch changed {actor.arch} -> {bundle.arch}; "
             "actor refuses hot-swap (param-ABI guard)")
+    t0 = time.monotonic()
     with actor._lock:
         if dict(bundle.arch) != actor.arch:
             # Exploration knobs (epsilon/act_noise) changed: they are
@@ -70,6 +79,11 @@ def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
             actor._explore_kwargs = exploration_kwargs(actor.arch)
         actor.params = bundle.params
         actor.version = bundle.version
+    telemetry.get_registry().histogram(
+        "relayrl_actor_swap_seconds",
+        "model hot-swap: lock wait + params install").observe(
+            time.monotonic() - t0)
+    telemetry.emit("model_swap", version=bundle.version)
     return True
 
 
@@ -191,6 +205,11 @@ class PolicyActor:
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
+        from relayrl_tpu import telemetry
+
+        self._m_steps = telemetry.get_registry().counter(
+            "relayrl_actor_env_steps_total",
+            "policy steps served (one per env step per lane)")
 
     # -- reference API (agent_zmq.rs:458-571 / o3_agent.rs:117-182) --
     def request_for_action(
@@ -257,6 +276,7 @@ class PolicyActor:
                 done=False,
             )
             self.trajectory.add_action(record, send_if_done=True)
+        self._m_steps.inc()
         return record
 
     def flag_last_action(

@@ -61,6 +61,36 @@ class TrainingServer:
         self.server_type = server_type
         self._addr_overrides = addr_overrides
 
+        # Observability first: the registry must be live before any
+        # component (algorithm logger, transports, pipeline) grabs its
+        # metric handles; disabled mode installs null metrics everywhere
+        # (telemetry.* knobs, docs/observability.md).
+        from relayrl_tpu import telemetry
+
+        self._telemetry = telemetry.configure_from_config(self.config)
+        self._exporter = telemetry.maybe_serve()
+        reg = self._telemetry
+        self._m_trajectories = reg.counter(
+            "relayrl_server_trajectories_total",
+            "trajectories handed to the learner plane")
+        self._m_updates = reg.counter(
+            "relayrl_server_updates_total", "learner updates dispatched")
+        self._m_dropped = reg.counter(
+            "relayrl_server_dropped_total",
+            "payloads lost at ingest (full queue / decode failure)")
+        self._m_nonfinite = reg.gauge(
+            "relayrl_server_dropped_nonfinite",
+            "trajectories rejected by the finite-value guard")
+        self._m_decode = reg.histogram(
+            "relayrl_server_decode_seconds",
+            "one payload decode on a staging worker")
+        self._m_dispatch = reg.histogram(
+            "relayrl_server_dispatch_seconds",
+            "learner-thread host work per trajectory: accumulate + "
+            "assemble + async update dispatch")
+        self._drop_event_pending = 0
+        self._drop_event_last = 0.0
+
         # Multi-host bring-up must precede any other JAX use (no-op for the
         # default single-host config; RELAYRL_COORDINATOR etc. override).
         from relayrl_tpu.parallel.distributed import initialize_distributed
@@ -169,6 +199,36 @@ class TrainingServer:
         # thread drains — decode overlaps the device step.
         self._ingest: queue.Queue[tuple[str, bytes]] = queue.Queue(maxsize=100_000)
         self._decoded: queue.Queue = queue.Queue(maxsize=100_000)
+        # Pull-gauges: depth is read from the live queues only when an
+        # export actually renders — zero hot-path cost. Sources hold a
+        # WEAK reference to this server: the registry is process-global,
+        # and a strong closure would pin a shut-down server's whole
+        # object graph (100k-slot queues, algorithm state) for the
+        # process lifetime. A dead source reads None → omitted from
+        # snapshots.
+        import weakref
+
+        wref = weakref.ref(self)
+
+        def _queue_depth(attr):
+            def read():
+                server = wref()
+                return (None if server is None
+                        else getattr(server, attr).qsize())
+            return read
+
+        def _registered():
+            server = wref()
+            return None if server is None else len(server.agent_ids)
+
+        reg.gauge_fn("relayrl_server_ingest_queue_depth",
+                     _queue_depth("_ingest"),
+                     "raw payloads awaiting a decode worker")
+        reg.gauge_fn("relayrl_server_decoded_queue_depth",
+                     _queue_depth("_decoded"),
+                     "decoded trajectories awaiting the learner thread")
+        reg.gauge_fn("relayrl_server_registered_agents", _registered,
+                     "logical agents currently in the registry")
         self._bundle_lock = threading.Lock()
         self._bundle_bytes: bytes = self.algorithm.bundle().to_bytes()
         self._bundle_version: int = self.algorithm.version
@@ -328,6 +388,39 @@ class TrainingServer:
         watch it to size ingest_staging_threads)."""
         with self._timings_lock:
             self.stats["dropped"] += n
+            self._drop_event_pending += n
+            total = self.stats["dropped"]
+            pending = self._drop_event_pending
+            due = time.monotonic() - self._drop_event_last >= 1.0
+            if due:
+                self._drop_event_pending = 0
+                self._drop_event_last = time.monotonic()
+        self._m_dropped.inc(n)
+        if due:
+            # Journal marker, coalesced to <=1/s — the counter above is
+            # the ledger; the event is the greppable breadcrumb. The
+            # tail of a burst (accumulated but not yet due) is flushed
+            # by _flush_drop_event on drain/shutdown so a 500-drop
+            # incident never journals as n=1.
+            from relayrl_tpu import telemetry
+
+            telemetry.emit("drop", n=pending, total=total)
+
+    def _flush_drop_event(self) -> None:
+        """Emit any drop count still coalescing (quiesce paths: drain
+        success, disable_server) — without this, drops accumulated in
+        the 1-s window after the last emitted event would never reach
+        the journal."""
+        with self._timings_lock:
+            pending = self._drop_event_pending
+            total = self.stats["dropped"]
+            if pending:
+                self._drop_event_pending = 0
+                self._drop_event_last = time.monotonic()
+        if pending:
+            from relayrl_tpu import telemetry
+
+            telemetry.emit("drop", n=pending, total=total)
 
     def _on_trajectory(self, agent_id: str, payload: bytes) -> None:
         try:
@@ -358,6 +451,14 @@ class TrainingServer:
         with self._registry_lock:
             if agent_id not in self.agent_ids:
                 self.agent_ids.append(agent_id)
+                fresh = True
+            else:
+                fresh = False
+        if fresh:
+            from relayrl_tpu import telemetry
+
+            telemetry.emit("agent_register", agent_id=agent_id,
+                           registered=len(self.agent_ids))
 
     def _on_unregister(self, agent_id: str) -> None:
         """Elastic-fleet reaping (the reference's registry is append-only,
@@ -368,7 +469,11 @@ class TrainingServer:
             try:
                 self.agent_ids.remove(agent_id)
             except ValueError:
-                pass
+                return
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("agent_unregister", agent_id=agent_id,
+                       registered=len(self.agent_ids))
 
     # -- staging: raw payload -> decoded trajectory (overlaps learner) --
     def _staging_loop(self) -> None:
@@ -408,6 +513,7 @@ class TrainingServer:
             except Exception:
                 self._count_dropped()
             dt = time.monotonic() - t0
+            self._m_decode.observe(dt)  # per-thread shard: no lock needed
             with self._timings_lock:  # N decode workers share the ledger
                 self.timings["decode_s"] += dt
             if item is not None:
@@ -438,6 +544,7 @@ class TrainingServer:
                  else [item])
         for one in items:
             self.stats["trajectories"] += 1
+            self._m_trajectories.inc()
             try:
                 got = self.algorithm.accumulate(one)
             except Exception as e:
@@ -519,6 +626,7 @@ class TrainingServer:
             bundle = self.algorithm.bundle()  # collective all-gather
             if coord:
                 self.stats["updates"] += 1
+                self._m_updates.inc()
                 try:
                     # On-policy: one update == one epoch. Off-policy: the
                     # algorithm throttles to its traj_per_epoch cadence.
@@ -531,6 +639,10 @@ class TrainingServer:
                     self._bundle_version = bundle.version
                 try:
                     self.transport.publish_model(bundle.version, raw)
+                    from relayrl_tpu import telemetry
+
+                    telemetry.emit("model_publish", version=bundle.version,
+                                   bytes=len(raw))
                 except Exception as e:
                     print(f"[TrainingServer] publish error: {e!r}", flush=True)
                 self._write_model_artifact(raw, bundle.version)
@@ -612,6 +724,7 @@ class TrainingServer:
         future drain) keeps the operator-visible counter fresh."""
         self.stats["dropped_nonfinite"] = getattr(
             self.algorithm, "dropped_nonfinite", 0)
+        self._m_nonfinite.set(self.stats["dropped_nonfinite"])
 
     def _process_one(self, item) -> None:
         """``item``: DecodedTrajectory (columnar fast path) or
@@ -628,6 +741,7 @@ class TrainingServer:
             self._process_one_legacy(item)
             return
         self.stats["trajectories"] += 1
+        self._m_trajectories.inc()
         t0 = time.monotonic()
         try:
             got = algo.accumulate(item)
@@ -658,9 +772,12 @@ class TrainingServer:
         # slot swap, but a due checkpoint quiesces + saves — seconds of
         # fence/IO that must not masquerade as host-side enqueue (the
         # window fence is already accounted in device_wait_s).
-        self.timings["dispatch_s"] += time.monotonic() - t0
+        dispatch_dt = time.monotonic() - t0
+        self.timings["dispatch_s"] += dispatch_dt
+        self._m_dispatch.observe(dispatch_dt)
         if updated:
             self.stats["updates"] += 1
+            self._m_updates.inc()
             try:
                 if self._publisher is not None:
                     self._publisher.submit(algo.snapshot_for_publish())
@@ -679,6 +796,7 @@ class TrainingServer:
         """Pre-pipeline path for plugin algorithms: train + log inside
         receive_trajectory, synchronous publish."""
         self.stats["trajectories"] += 1
+        self._m_trajectories.inc()
         try:
             updated = self.algorithm.receive_trajectory(item)
         except Exception as e:  # never kill the loop on one bad batch
@@ -688,6 +806,7 @@ class TrainingServer:
             self._sync_drop_stats()
         if updated:
             self.stats["updates"] += 1
+            self._m_updates.inc()
             try:
                 self._publish()
             except Exception as e:  # transient socket/fs errors must not
@@ -754,7 +873,10 @@ class TrainingServer:
         Note this covers trajectories the server has *received*; bytes still
         in transit in socket buffers are invisible here, so to observe an
         exact update count poll ``stats['updates']`` first, then drain."""
-        deadline = time.monotonic() + timeout
+        from relayrl_tpu import telemetry
+
+        t0 = time.monotonic()
+        deadline = t0 + timeout
         while time.monotonic() < deadline:
             if (self._ingest.unfinished_tasks == 0
                     and self._decoded.unfinished_tasks == 0
@@ -766,6 +888,10 @@ class TrainingServer:
                     # the broadcast step in flight also count as pending
                     and not self._mh_ready
                     and not self._mh_busy):
+                self._flush_drop_event()
+                telemetry.emit("drain",
+                               wait_s=round(time.monotonic() - t0, 3),
+                               updates=self.stats["updates"])
                 return True
             time.sleep(0.05)
         return False
@@ -796,12 +922,16 @@ class TrainingServer:
         loop's path and the ``async_publish: false`` escape hatch (the
         pipelined path hands :meth:`_publish_snapshot` to the publisher
         thread instead)."""
+        from relayrl_tpu import telemetry
+
         bundle = self.algorithm.bundle()
         raw = bundle.to_bytes()
         with self._bundle_lock:
             self._bundle_bytes = raw
             self._bundle_version = bundle.version
         self.transport.publish_model(bundle.version, raw)
+        telemetry.emit("model_publish", version=bundle.version,
+                       bytes=len(raw))
         self._write_model_artifact(raw, bundle.version)
         self._maybe_periodic_checkpoint(bundle.version)
 
@@ -833,12 +963,16 @@ class TrainingServer:
         back-to-back epochs coalesce latest-wins upstream
         (runtime/pipeline.ModelPublisher). Exceptions are counted and
         logged by the publisher loop."""
+        from relayrl_tpu import telemetry
+
         bundle = snapshot.to_bundle()
         raw = bundle.to_bytes()
         with self._bundle_lock:
             self._bundle_bytes = raw
             self._bundle_version = bundle.version
         self.transport.publish_model(bundle.version, raw)
+        telemetry.emit("model_publish", version=bundle.version,
+                       bytes=len(raw))
         self._write_model_artifact(raw, bundle.version)
 
     def _periodic_checkpoint(self) -> None:
@@ -853,6 +987,11 @@ class TrainingServer:
             checkpoint_algorithm(self.algorithm, self._checkpoint_dir,
                                  include_aux=include_aux,
                                  max_to_keep=self._ckpt_keep)
+            from relayrl_tpu import telemetry
+
+            telemetry.emit("checkpoint", version=self.algorithm.version,
+                           include_aux=include_aux,
+                           dir=str(self._checkpoint_dir))
             # Count after submit so a SYNCHRONOUS failure (same-step
             # collision, bad tree) doesn't consume the aux slot. Saves
             # are async, so a deferred write failure surfaces at the
@@ -959,6 +1098,7 @@ class TrainingServer:
             self._publisher = None
         if self.transport is not None:
             self.transport.stop()
+        self._flush_drop_event()
         # Drain any in-flight async orbax save — the most recent checkpoint
         # is exactly the one a subsequent resume needs.
         mgr = getattr(self.algorithm, "_ckpt_mgr", None)

@@ -107,6 +107,18 @@ class VectorActorHost:
                                on_send(_lane, payload))))
             for lane in range(self.num_envs)
         ]
+        from relayrl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_steps = reg.counter(
+            "relayrl_actor_env_steps_total",
+            "policy steps served (one per env step per lane)")
+        self._m_dispatches = reg.counter(
+            "relayrl_actor_batched_dispatches_total",
+            "batched policy dispatches (each serves num_envs lanes)")
+        reg.gauge("relayrl_actor_lanes",
+                  "env lanes per batched dispatch on this host").set(
+                      self.num_envs)
 
     # -- batched action API --
     def request_for_actions(self, obs, masks=None,
@@ -173,6 +185,8 @@ class VectorActorHost:
                 )
                 self.trajectories[lane].add_action(record, send_if_done=True)
                 records.append(record)
+        self._m_steps.inc(self.num_envs)
+        self._m_dispatches.inc()
         return records
 
     def flag_last_action(self, lane: int, reward: float = 0.0,

@@ -1,0 +1,133 @@
+"""Structured run-event journal: append-only NDJSON.
+
+Where the metrics registry answers "how fast / how many right now", the
+journal answers "what happened, in what order": model publishes and
+swaps, agent register/unregister/reconnect, drops, checkpoints, drains.
+One JSON object per line so the file is greppable mid-run and parseable
+after a crash (the last line may be torn; every prior line is intact —
+each write is flushed whole).
+
+Every event carries the registry's ``run_id``, a wall-clock ``t_unix``
+(human correlation) and a ``mono_ns`` CLOCK_MONOTONIC stamp — the same
+clock the transports and the soak bench stamp receipts with, so journal
+events pair against wire receipts across processes on one host (see
+benches/bench_soak.py's fan-out methodology).
+
+Event volume is run-event scale (tens per second at most: publishes,
+registrations, checkpoints); the one potentially hot type — ``drop`` —
+must be coalesced by the caller (the server emits one event per drop
+*burst* with a count, not one per payload).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, TextIO
+
+# The closed vocabulary instrumentation uses (free-form types are allowed
+# for embedders; these are the ones docs/observability.md documents).
+EVENT_TYPES = (
+    "model_publish",     # server shipped a new version to the fleet
+    "model_swap",        # an actor installed a new version
+    "agent_register",    # logical agent joined the registry
+    "agent_unregister",  # logical agent left (clean exit or reaped)
+    "agent_reconnect",   # agent-side transport rebuilt (restart/heal)
+    "drop",              # ingest-plane loss (coalesced: carries n)
+    "checkpoint",        # full-state checkpoint written
+    "drain",             # pipeline quiesced to empty
+    "heartbeat",         # liveness state transition (alive/slow/dead)
+)
+
+
+class EventJournal:
+    """Thread-safe NDJSON appender bound to one run."""
+
+    def __init__(self, path: str, run_id: str | None = None):
+        self.path = str(path)
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._fh: TextIO | None = open(self.path, "a", encoding="utf-8")
+        self.written = 0
+        self.errors = 0
+
+    def emit(self, event: str, **fields: Any) -> None:
+        record = {"event": str(event), "run_id": self.run_id,
+                  "t_unix": round(time.time(), 6),
+                  "mono_ns": time.monotonic_ns()}
+        for k, v in fields.items():
+            record[k] = _jsonable(v)
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.write(line)
+                self._fh.flush()
+                self.written += 1
+            except (OSError, ValueError):
+                # A full disk / closed fd must never take down the plane
+                # being observed.
+                self.errors += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+class NullJournal:
+    """events_path unset: emit is a no-op attribute call."""
+
+    path = None
+    run_id = None
+    written = 0
+
+    def emit(self, event: str, **fields: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _jsonable(value: Any) -> Any:
+    """Journal fields must serialize without surprises: numpy scalars and
+    0-d arrays become Python scalars; anything else unserializable falls
+    back to ``repr`` rather than raising on the emitting thread."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    item = getattr(value, "item", None)
+    if callable(item) and getattr(value, "ndim", 1) == 0:
+        try:
+            return item()
+        except Exception:
+            pass
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a journal file, tolerating a torn final line (crash mid-
+    write)."""
+    out: list[dict] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail
+    return out
+
+
+__all__ = ["EventJournal", "NullJournal", "read_events", "EVENT_TYPES"]

@@ -240,6 +240,10 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
     return NativeAgentTransport(
         server_addr=_agent_handshake_addr("native", config, overrides),
         identity=overrides.get("identity"),
+        # transport.heartbeat_s config knob (was hard-coded 5.0 in
+        # start_model_listener); an explicit override wins.
+        heartbeat_s=overrides.get(
+            "heartbeat_s", config.get_transport_params()["heartbeat_s"]),
     )
 
 

@@ -25,6 +25,8 @@ stays per-connection (one receipt fans into every logical lane host-side).
 from __future__ import annotations
 
 import abc
+import threading
+from collections import deque
 from typing import Callable
 
 import msgpack
@@ -48,13 +50,138 @@ def unpack_trajectory_envelope(buf: bytes) -> tuple[str, bytes]:
     return str(env.get("id", "?")), env["traj"]
 
 
-def pack_model_frame(version: int, bundle_bytes: bytes) -> bytes:
-    return msgpack.packb({"ver": int(version), "model": bundle_bytes}, use_bin_type=True)
+def pack_model_frame(version: int, bundle_bytes: bytes,
+                     pub_ns: int | None = None) -> bytes:
+    """``pub_ns`` is the publisher's CLOCK_MONOTONIC stamp (same-host
+    comparable — the soak bench's fan-out methodology): when present, a
+    receiving SUB thread can compute its own publish→receipt latency
+    without any cross-process glue. Omitted by default so handshake
+    replies stay byte-stable; absent keys are simply not decoded."""
+    frame = {"ver": int(version), "model": bundle_bytes}
+    if pub_ns is not None:
+        frame["pub_ns"] = int(pub_ns)
+    return msgpack.packb(frame, use_bin_type=True)
+
+
+def unpack_model_frame_ex(buf: bytes) -> tuple[int, bytes, int | None]:
+    """Decode a model frame: ``(version, bundle_bytes, pub_ns|None)``
+    (``pub_ns`` absent in frames packed without a publisher stamp).
+    The ONE decode path — :func:`unpack_model_frame` delegates here so
+    a schema change can never drift between two decoders."""
+    frame = msgpack.unpackb(buf, raw=False)
+    pub_ns = frame.get("pub_ns")
+    return (int(frame["ver"]), frame["model"],
+            None if pub_ns is None else int(pub_ns))
 
 
 def unpack_model_frame(buf: bytes) -> tuple[int, bytes]:
-    frame = msgpack.unpackb(buf, raw=False)
-    return int(frame["ver"]), frame["model"]
+    version, model, _ = unpack_model_frame_ex(buf)
+    return version, model
+
+
+class ReceiptLedger:
+    """Pre-decode model-receipt ledger: ``(version, rx_mono_ns)`` pairs
+    stamped the moment a frame leaves the socket, drained destructively.
+    The Python mirror of the native C++ reader's ledger
+    (``rl_sub_receipts``), shared by the zmq and grpc agent transports
+    so the stamping semantics and bounds can never drift between
+    backends (the zmq 64-actor 0.433 lesson, benches/README.md)."""
+
+    def __init__(self, maxlen: int = 65536):
+        self._receipts: deque[tuple[int, int]] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def append(self, version: int, rx_ns: int) -> None:
+        with self._lock:
+            self._receipts.append((version, rx_ns))
+
+    def drain(self, max_n: int = 65536) -> list[tuple[int, int]]:
+        with self._lock:
+            out: list[tuple[int, int]] = []
+            while self._receipts and len(out) < max_n:
+                out.append(self._receipts.popleft())
+            return out
+
+
+def server_wire_metrics(backend: str,
+                        include_publish_bytes: bool = True) -> dict:
+    """The server-side transport instrument set (one per backend,
+    process-aggregated; null objects when telemetry is disabled):
+    ``recv_total``/``recv_bytes`` for trajectory ingest and
+    ``publish_total``(/``publish_bytes``) for model broadcasts.
+    ``include_publish_bytes=False`` for pull-based planes (grpc long
+    polls) where no broadcast bytes exist to count."""
+    from relayrl_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    labels = {"backend": backend}
+    metrics = {
+        "recv_total": reg.counter(
+            "relayrl_transport_recv_total",
+            "trajectory envelopes received at ingest", labels),
+        "recv_bytes": reg.counter(
+            "relayrl_transport_recv_bytes_total",
+            "trajectory wire bytes received", labels),
+        "publish_total": reg.counter(
+            "relayrl_transport_publish_total",
+            "model publishes", labels),
+    }
+    if include_publish_bytes:
+        metrics["publish_bytes"] = reg.counter(
+            "relayrl_transport_publish_bytes_total",
+            "model broadcast bytes sent", labels)
+    return metrics
+
+
+def agent_wire_metrics(backend: str) -> dict:
+    """The shared agent-side transport instrument set, one registry
+    lookup per connection (all metrics are process-aggregated across
+    connections of the same backend; null objects when telemetry is
+    disabled). Keys:
+
+    * ``send_total`` / ``send_bytes``  — trajectory sends + wire bytes
+    * ``send_seconds``                 — per-send latency histogram
+    * ``model_recv_total`` / ``model_recv_bytes`` — model frames received
+    * ``model_deliver_seconds``        — SUB/poll thread time from the
+      pre-decode receipt stamp to ``on_model`` returning (decode + swap
+      + persist): the per-receipt cost that starves Python SUB threads
+      at fleet fan-out rates (benches/README.md, zmq 64-actor row)
+    * ``receipt_latency_seconds``      — publish→receipt when the frame
+      carries the publisher's monotonic stamp (same-host pairs only)
+    * ``reconnects``                   — transport heals/redials
+    """
+    from relayrl_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    labels = {"backend": backend}
+    return {
+        "send_total": reg.counter(
+            "relayrl_transport_send_total",
+            "trajectory payloads sent", labels),
+        "send_bytes": reg.counter(
+            "relayrl_transport_send_bytes_total",
+            "trajectory wire bytes sent (envelope included)", labels),
+        "send_seconds": reg.histogram(
+            "relayrl_transport_send_seconds",
+            "one trajectory send on the caller thread", labels),
+        "model_recv_total": reg.counter(
+            "relayrl_transport_model_recv_total",
+            "model frames received on the subscription", labels),
+        "model_recv_bytes": reg.counter(
+            "relayrl_transport_model_recv_bytes_total",
+            "model frame bytes received", labels),
+        "model_deliver_seconds": reg.histogram(
+            "relayrl_transport_model_deliver_seconds",
+            "receipt stamp to on_model return (decode+swap+persist)",
+            labels),
+        "receipt_latency_seconds": reg.histogram(
+            "relayrl_transport_receipt_latency_seconds",
+            "publish stamp to receipt stamp, same-host monotonic pairs",
+            labels),
+        "reconnects": reg.counter(
+            "relayrl_transport_reconnects_total",
+            "connection heals/redials observed", labels),
+    }
 
 
 class ServerTransport(abc.ABC):
@@ -95,7 +222,13 @@ class ServerTransport(abc.ABC):
 
 
 class AgentTransport(abc.ABC):
-    """Agent-side: handshake, trajectory send, model-update subscription."""
+    """Agent-side: handshake, trajectory send, model-update subscription.
+
+    Backends that stamp model receipts pre-decode additionally expose
+    ``drain_receipts() -> [(version, rx_mono_ns), ...]`` — the native
+    C++ ledger's surface, mirrored in Python by the zmq/grpc listeners
+    so fan-out accounting (benches/bench_soak.py) is backend-uniform.
+    """
 
     def __init__(self):
         self.on_model: Callable[[int, bytes], None] = lambda *_: None

@@ -35,7 +35,10 @@ import msgpack
 
 from relayrl_tpu.transport.base import (
     AgentTransport,
+    ReceiptLedger,
     ServerTransport,
+    agent_wire_metrics,
+    server_wire_metrics,
     unpack_trajectory_envelope,
 )
 
@@ -51,6 +54,8 @@ class _Servicer:
         self._owner = owner
 
     def send_actions(self, request: bytes, context) -> bytes:
+        self._owner._m["recv_total"].inc()
+        self._owner._m["recv_bytes"].inc(len(request))
         try:
             agent_id, payload = unpack_trajectory_envelope(request)
         except Exception:
@@ -107,6 +112,9 @@ class GrpcServerTransport(ServerTransport):
         self._max_workers = max_workers
         self._server: grpc.Server | None = None
         self._model_cv = threading.Condition()
+        # publish here is a long-poll wakeup, not a broadcast: there are
+        # no broadcast bytes to count.
+        self._m = server_wire_metrics("grpc", include_publish_bytes=False)
 
     def start(self) -> None:
         servicer = _Servicer(self)
@@ -138,6 +146,7 @@ class GrpcServerTransport(ServerTransport):
     def publish_model(self, version: int, bundle_bytes: bytes) -> None:
         # Models are pulled via ClientPoll long-polls; publishing just wakes
         # the waiters (ref: watch channel notify, training_grpc.rs:600-627).
+        self._m["publish_total"].inc()
         with self._model_cv:
             self._model_cv.notify_all()
 
@@ -167,9 +176,18 @@ class GrpcAgentTransport(AgentTransport):
         self._inflight = None
         self._stop = threading.Event()
         self._listener: threading.Thread | None = None
+        self._m = agent_wire_metrics("grpc")
+        # Reconnect accounting matches the native backend's semantics:
+        # count a HEAL (first successful poll after a break), not every
+        # failed retry — a 60s server restart is ONE reconnect, not 60.
+        self._poll_broken = False
+        # Pre-decode receipt ledger (base.ReceiptLedger), same surface
+        # as the native C++ and zmq ledgers — soak fan-out accounting is
+        # backend-uniform.
+        self._ledger = ReceiptLedger()
 
     def _poll_once(self, first: bool, timeout_s: float,
-                   known_version: int | None = None):
+                   known_version: int | None = None, record: bool = False):
         req = msgpack.packb(
             {"id": self.identity,
              "ver": (self._known_version if known_version is None
@@ -182,14 +200,20 @@ class GrpcAgentTransport(AgentTransport):
         call = self._poll.future(req, timeout=timeout_s)
         self._inflight = call
         try:
-            resp = msgpack.unpackb(call.result(), raw=False)
+            raw = call.result()
         finally:
             self._inflight = None
+        rx_ns = time.monotonic_ns()  # receipt stamp BEFORE decode
+        resp = msgpack.unpackb(raw, raw=False)
         # A code-1 ack without a bundle (the servicer's metadata-only
         # registration reply) is not a model delivery.
         if resp.get("code") == 1 and "model" in resp:
             self._known_version = int(resp["ver"])
-            return int(resp["ver"]), resp["model"]
+            if record:  # subscription deliveries only, not handshakes
+                self._ledger.append(int(resp["ver"]), rx_ns)
+                self._m["model_recv_total"].inc()
+                self._m["model_recv_bytes"].inc(len(raw))
+            return int(resp["ver"]), resp["model"], rx_ns
         return None
 
     def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
@@ -208,7 +232,7 @@ class GrpcAgentTransport(AgentTransport):
                     5.0, max(0.1, deadline - time.monotonic())),
                     known_version=-1)
                 if result is not None:
-                    return result
+                    return result[0], result[1]
             except grpc.RpcError as e:
                 last_err = e
                 time.sleep(0.2)
@@ -238,10 +262,12 @@ class GrpcAgentTransport(AgentTransport):
                         agent_id: str | None = None) -> None:
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
-        resp = msgpack.unpackb(
-            self._send(pack_trajectory_envelope(agent_id or self.identity,
-                                                payload), timeout=30.0),
-            raw=False)
+        env = pack_trajectory_envelope(agent_id or self.identity, payload)
+        t0 = time.monotonic()
+        resp = msgpack.unpackb(self._send(env, timeout=30.0), raw=False)
+        self._m["send_seconds"].observe(time.monotonic() - t0)
+        self._m["send_total"].inc()
+        self._m["send_bytes"].inc(len(env))
         if resp.get("code") != 1:
             raise RuntimeError(f"trajectory rejected: {resp.get('error')}")
 
@@ -256,14 +282,38 @@ class GrpcAgentTransport(AgentTransport):
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                result = self._poll_once(first=False, timeout_s=self._poll_timeout_s)
-            except (grpc.RpcError, grpc.FutureCancelledError):
+                result = self._poll_once(first=False,
+                                         timeout_s=self._poll_timeout_s,
+                                         record=True)
+                if self._poll_broken:
+                    # First successful poll after a break: that is the
+                    # one reconnect (native counts heals the same way —
+                    # semantics must match across backends).
+                    self._poll_broken = False
+                    self._m["reconnects"].inc()
+            except (grpc.RpcError, grpc.FutureCancelledError) as e:
                 # FutureCancelledError: close() cancelled the parked poll.
+                # A DEADLINE_EXCEEDED is the benign empty long-poll; any
+                # other RpcError marks the channel broken until a poll
+                # lands again.
+                code = getattr(e, "code", lambda: None)()
+                if (isinstance(e, grpc.RpcError)
+                        and code != grpc.StatusCode.DEADLINE_EXCEEDED
+                        and not self._stop.is_set()):
+                    self._poll_broken = True
                 if self._stop.wait(1.0):
                     break
                 continue
             if result is not None:
-                self.on_model(*result)
+                version, bundle, rx_ns = result
+                self.on_model(version, bundle)
+                self._m["model_deliver_seconds"].observe(
+                    (time.monotonic_ns() - rx_ns) / 1e9)
+
+    def drain_receipts(self, max_n: int = 65536) -> list[tuple[int, int]]:
+        """Drain the pre-decode receipt ledger (same surface as the
+        native C++ and zmq ledgers)."""
+        return self._ledger.drain(max_n)
 
     def close(self) -> None:
         self._stop.set()

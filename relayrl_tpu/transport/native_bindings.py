@@ -270,20 +270,36 @@ class NativeServerTransportImpl(ServerTransport):
 
 
 class NativeAgentTransportImpl(AgentTransport):
+    # Liveness gauge encoding (docs/observability.md): the ping() rc
+    # space folded to three operator states.
+    _HB_ALIVE, _HB_SLOW, _HB_DEAD = 0, 1, 2
+
     def __init__(self, lib_path: str, server_addr: str,
-                 identity: str | None = None):
+                 identity: str | None = None, heartbeat_s: float = 5.0):
         super().__init__()
         import os
         import secrets
+
+        from relayrl_tpu.transport.base import agent_wire_metrics
 
         self._lib = _load(lib_path)
         self.identity = identity or f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}"
         self._host, self._port = _parse_host_port(server_addr)
         self._ctrl = None
         self._sub = None
+        # transport.heartbeat_s config knob (was a hard-coded 5.0 in
+        # start_model_listener); <= 0 disables the beat entirely.
+        self._heartbeat_default = float(heartbeat_s)
         self._heartbeat_s = 0.0
         self._listener: threading.Thread | None = None
         self._stop = threading.Event()
+        self._m = agent_wire_metrics("native")
+        from relayrl_tpu import telemetry
+
+        self._m_liveness = telemetry.get_registry().gauge(
+            "relayrl_transport_heartbeat_state",
+            "control-channel liveness: 0=alive, 1=slow, 2=dead",
+            {"backend": "native"})
 
     def _ensure_ctrl(self, timeout_s: float):
         if self._ctrl is None:
@@ -334,8 +350,12 @@ class NativeAgentTransportImpl(AgentTransport):
         ctrl = self._ensure_ctrl(5.0)
         env = pack_trajectory_envelope(agent_id or self.identity, payload)
         data = _buf(env)
+        t0 = time.monotonic()
         if self._lib.rl_client_send_traj(ctrl, data, len(env)) != 0:
             raise RuntimeError("native trajectory send failed")
+        self._m["send_seconds"].observe(time.monotonic() - t0)
+        self._m["send_total"].inc()
+        self._m["send_bytes"].inc(len(env))
 
     def ping(self, timeout_s: float = 2.0) -> int:
         """Liveness probe on the control channel: 0 alive, 2 slow (no pong
@@ -344,20 +364,24 @@ class NativeAgentTransportImpl(AgentTransport):
         ctrl = self._ensure_ctrl(timeout_s)
         return int(self._lib.rl_client_ping(ctrl, int(timeout_s * 1000)))
 
-    def start_model_listener(self, heartbeat_s: float = 5.0) -> None:
+    def start_model_listener(self, heartbeat_s: float | None = None) -> None:
+        """``heartbeat_s=None`` uses the constructor's value (the
+        ``transport.heartbeat_s`` config knob); an explicit argument
+        still overrides per-listener."""
         if self._listener is not None:
             return
         self._sub = self._lib.rl_sub_connect(self._host.encode(), self._port,
                                              5000)
         if not self._sub:
             raise RuntimeError("native subscribe connection failed")
-        self._heartbeat_s = heartbeat_s
+        self._heartbeat_s = (self._heartbeat_default if heartbeat_s is None
+                             else float(heartbeat_s))
         # Async mode: a C++ reader thread owns the socket — it parses and
         # CLOCK_MONOTONIC-timestamps every ModelPush the moment it arrives
         # (GIL-free; the receipt ledger is the soak benches' fan-out
         # evidence), owns the sub-channel keepalive, and reconnects. The
         # Python thread below only drains the decoded queue.
-        self._lib.rl_sub_start_async(self._sub, int(heartbeat_s * 1000))
+        self._lib.rl_sub_start_async(self._sub, int(self._heartbeat_s * 1000))
         self._stop.clear()
         self._listener = threading.Thread(target=self._sub_loop,
                                           name="native-model-sub", daemon=True)
@@ -391,14 +415,30 @@ class NativeAgentTransportImpl(AgentTransport):
                     and time.monotonic() - last_beat >= self._heartbeat_s):
                 last_beat = time.monotonic()
                 if self._ctrl:
-                    self._lib.rl_client_ping(self._ctrl, 1000)
+                    rc = int(self._lib.rl_client_ping(self._ctrl, 1000))
+                    # rc: 0 alive, 2 slow (no pong in window), 1 hard
+                    # failure healed by redial (counts as a reconnect,
+                    # lands alive), -1 dead even after redial.
+                    if rc == 1:
+                        self._m["reconnects"].inc()
+                    self._m_liveness.set(
+                        self._HB_ALIVE if rc in (0, 1)
+                        else self._HB_SLOW if rc == 2
+                        else self._HB_DEAD)
             if n < 0:
                 continue
             if n > cap:
                 cap = int(n) * 2
                 buf = (ctypes.c_uint8 * cap)()
                 continue
+            # rx_ns is the C++ reader's frame-parse stamp (the ledger
+            # truth); deliver_seconds measures the Python-side handoff
+            # from there through the swap.
+            self._m["model_recv_total"].inc()
+            self._m["model_recv_bytes"].inc(int(n))
             self.on_model(int(version.value), ctypes.string_at(buf, int(n)))
+            self._m["model_deliver_seconds"].observe(
+                max(0.0, (time.monotonic_ns() - int(rx_ns.value)) / 1e9))
 
     def close(self) -> None:
         self._stop.set()

@@ -37,9 +37,13 @@ from relayrl_tpu.transport.base import (
     REPLY_ERROR,
     REPLY_ID_LOGGED,
     REPLY_MODEL,
+    ReceiptLedger,
     ServerTransport,
+    agent_wire_metrics,
     pack_model_frame,
+    server_wire_metrics,
     unpack_model_frame,
+    unpack_model_frame_ex,
     unpack_trajectory_envelope,
 )
 
@@ -72,6 +76,7 @@ class ZmqServerTransport(ServerTransport):
         self._pub_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._m = server_wire_metrics("zmq")
 
     def start(self) -> None:
         self._stop.clear()
@@ -100,8 +105,16 @@ class ZmqServerTransport(ServerTransport):
     def publish_model(self, version: int, bundle_bytes: bytes) -> None:
         if self._pub is None:
             raise RuntimeError("transport not started")
+        # The publisher's monotonic stamp rides the frame so every SUB
+        # thread on this host can compute publish→receipt latency
+        # locally (the telemetry answer to the soak bench's fan-out
+        # methodology; cross-host stamps don't pair and are ignored).
+        frame = pack_model_frame(version, bundle_bytes,
+                                 pub_ns=time.monotonic_ns())
         with self._pub_lock:
-            self._pub.send_multipart([MODEL_TOPIC, pack_model_frame(version, bundle_bytes)])
+            self._pub.send_multipart([MODEL_TOPIC, frame])
+        self._m["publish_total"].inc()
+        self._m["publish_bytes"].inc(len(frame))
 
     # -- loops --
     def _listener_loop(self, addr: str) -> None:
@@ -150,6 +163,8 @@ class ZmqServerTransport(ServerTransport):
                 if not dict(poller.poll(_POLL_MS)):
                     continue
                 buf = sock.recv()
+                self._m["recv_total"].inc()
+                self._m["recv_bytes"].inc(len(buf))
                 try:
                     agent_id, payload = unpack_trajectory_envelope(buf)
                 except Exception:
@@ -181,6 +196,13 @@ class ZmqAgentTransport(AgentTransport):
         self._sub: zmq.Socket | None = None
         self._listener: threading.Thread | None = None
         self._stop = threading.Event()
+        self._m = agent_wire_metrics("zmq")
+        # Pre-decode receipt ledger (base.ReceiptLedger — the native C++
+        # ledger's Python mirror): (version, rx_mono_ns) stamped the
+        # moment recv returns, BEFORE the frame is decoded or the swap
+        # runs — so fan-out accounting measures the wire, not the Python
+        # decode backlog behind it (benches/README.md zmq 64-actor note).
+        self._ledger = ReceiptLedger()
 
     @property
     def identity(self) -> str:
@@ -231,9 +253,13 @@ class ZmqAgentTransport(AgentTransport):
                         agent_id: str | None = None) -> None:
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
+        env = pack_trajectory_envelope(agent_id or self.identity, payload)
+        t0 = time.monotonic()
         with self._push_lock:
-            self._push.send(pack_trajectory_envelope(
-                agent_id or self.identity, payload))
+            self._push.send(env)
+        self._m["send_seconds"].observe(time.monotonic() - t0)
+        self._m["send_total"].inc()
+        self._m["send_bytes"].inc(len(env))
 
     def start_model_listener(self) -> None:
         if self._listener is not None:
@@ -248,20 +274,51 @@ class ZmqAgentTransport(AgentTransport):
 
     def _model_loop(self) -> None:
         """SUB loop → on_model (ref: OS-thread PULL listener,
-        agent_zmq.rs:625-698)."""
+        agent_zmq.rs:625-698).
+
+        The receipt stamp is taken the moment ``recv`` returns — before
+        decode, before the (lock-contended) swap in ``on_model`` — and
+        appended to the ledger right after the version is known. The
+        decode/swap cost is measured separately
+        (``model_deliver_seconds``): under fleet fan-out rates that cost
+        is what backs this thread up, and stamping after it (the old
+        behavior) conflated wire delivery with Python scheduling."""
         poller = zmq.Poller()
         poller.register(self._sub, zmq.POLLIN)
         while not self._stop.is_set():
             if not dict(poller.poll(_POLL_MS)):
                 continue
             frames = self._sub.recv_multipart()
+            rx_ns = time.monotonic_ns()  # pre-decode receipt stamp
             if len(frames) != 2 or frames[0] != MODEL_TOPIC:
                 continue
             try:
-                version, bundle = unpack_model_frame(frames[1])
+                version, bundle, pub_ns = unpack_model_frame_ex(frames[1])
             except Exception:
                 continue
+            self._ledger.append(version, rx_ns)
+            self._m["model_recv_total"].inc()
+            self._m["model_recv_bytes"].inc(len(frames[1]))
+            if pub_ns is not None and 0 <= rx_ns - pub_ns < int(300e9):
+                # Same-host monotonic pair only. CLOCK_MONOTONIC is
+                # per-boot, so a cross-host pair is off by the uptime
+                # difference in EITHER direction — the negative half is
+                # obvious, but the positive half would pin every sample
+                # in the +Inf bucket. Anything beyond 300s cannot be a
+                # real fan-out latency on this plane; treat it as skew
+                # and drop the sample.
+                self._m["receipt_latency_seconds"].observe(
+                    (rx_ns - pub_ns) / 1e9)
             self.on_model(version, bundle)
+            self._m["model_deliver_seconds"].observe(
+                (time.monotonic_ns() - rx_ns) / 1e9)
+
+    def drain_receipts(self, max_n: int = 65536) -> list[tuple[int, int]]:
+        """Drain the pre-decode receipt ledger: ``[(version,
+        rx_mono_ns), ...]`` — same surface and semantics as the native
+        C++ ledger (``rl_sub_receipts``), so soak fan-out accounting is
+        backend-uniform."""
+        return self._ledger.drain(max_n)
 
     def close(self) -> None:
         self._stop.set()

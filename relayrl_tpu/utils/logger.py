@@ -111,6 +111,23 @@ class Logger:
         # column, values right-aligned — an original layout; only the TSV
         # half below preserves the reference's progress.txt schema.
         vals = [self.log_current_row.get(key, "") for key in self.log_headers]
+        # One source, many consumers (ISSUE 4 satellite): the SAME row
+        # that renders to console/TSV/TensorBoard mirrors into the
+        # telemetry registry as `relayrl_epoch_stat{stat=...}` gauges,
+        # so exported epoch metrics can never drift from the logged
+        # ones. Looked up per dump (epoch cadence, not hot path) so a
+        # registry installed after construction still gets the rows; a
+        # NullRegistry makes this a no-op.
+        from relayrl_tpu import telemetry
+
+        registry = telemetry.get_registry()
+        if registry.enabled:
+            for key, val in zip(self.log_headers, vals):
+                if hasattr(val, "__float__"):
+                    registry.gauge(
+                        "relayrl_epoch_stat",
+                        "latest epoch-log row value, one child per column",
+                        labels={"stat": key}).set(float(val))
         rendered = [
             f"{v:.4g}" if hasattr(v, "__float__") else str(v) for v in vals
         ]

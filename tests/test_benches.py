@@ -96,3 +96,28 @@ def test_bench_soak_quick_slos(tmp_path):
     assert soak["server_stats"]["dropped"] == 0
     blast = next(r for r in lines if r["bench"] == "ingest_blast_zmq")
     assert blast["drained"]
+    # Every soak row embeds the server-plane telemetry snapshot in the
+    # production /snapshot schema (ISSUE 4): bench artifacts and live
+    # scrapes are read by the same tooling.
+    for row in (soak, blast):
+        snap = row["telemetry"]
+        assert snap["schema"] == "relayrl-telemetry-v1" and snap["enabled"]
+        names = {m["name"] for m in snap["metrics"]}
+        assert "relayrl_server_trajectories_total" in names
+    traj = next(m for m in soak["telemetry"]["metrics"]
+                if m["name"] == "relayrl_server_trajectories_total")
+    assert traj["value"] == soak["server_stats"]["trajectories"]
+
+
+@pytest.mark.telemetry
+def test_bench_telemetry_quick_asserts_hotpath_cost(tmp_path):
+    # The microbench carries its own ceiling asserts (disabled-path inc
+    # must stay an attribute call, enabled inc lock-free); this smoke
+    # keeps it runnable and its JSON well-formed.
+    lines = _run_bench("bench_telemetry.py", tmp_path, timeout=240)
+    ops = {r["config"]["op"]: r for r in lines
+           if r["bench"] == "telemetry_hotpath"}
+    assert {"counter_inc_disabled", "counter_inc_enabled",
+            "histogram_observe_enabled"} <= set(ops)
+    assert all(r["ns_per_op"] > 0 for r in ops.values())
+    assert any(r["bench"] == "telemetry_snapshot" for r in lines)
