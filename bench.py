@@ -359,8 +359,41 @@ def profile_stages(epochs: int = 6) -> dict:
         logger_kwargs={"output_dir": tempfile.mkdtemp()})
     algo.warmup()
 
+    # Publish split: serialize_s (host gather + wire encode — what
+    # model-wire v2 shrinks with delta frames) vs socket_s (the PUB send
+    # itself) — separately attributable so a wire-format change shows up
+    # in the headline profile instead of hiding inside one bucket. A
+    # real zmq PUB/SUB pair on loopback, drained off-thread, keeps the
+    # socket number honest.
+    import threading
+
+    import zmq
+
+    from relayrl_tpu.transport.base import MODEL_TOPIC, pack_model_frame
+    from relayrl_tpu.transport.modelwire import ModelWireEncoder
+
+    ctx = zmq.Context.instance()
+    pub = ctx.socket(zmq.PUB)
+    pub_port = pub.bind_to_random_port("tcp://127.0.0.1")
+    sub = ctx.socket(zmq.SUB)
+    sub.connect(f"tcp://127.0.0.1:{pub_port}")
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    stop_drain = threading.Event()
+
+    def _drain():
+        poller = zmq.Poller()
+        poller.register(sub, zmq.POLLIN)
+        while not stop_drain.is_set():
+            if dict(poller.poll(50)):
+                sub.recv_multipart()
+
+    drainer = threading.Thread(target=_drain, daemon=True)
+    drainer.start()
+    wire_enc = ModelWireEncoder()  # production default: v2, delta frames
+
     stages = {"decode_s": 0.0, "assemble_s": 0.0, "h2d_s": 0.0,
-              "device_s": 0.0, "publish_s": 0.0}
+              "device_s": 0.0, "publish_s": 0.0, "serialize_s": 0.0,
+              "socket_s": 0.0}
     for raw in payloads:
         t0 = time.perf_counter()
         episode = deserialize_actions(raw)
@@ -382,9 +415,24 @@ def profile_stages(epochs: int = 6) -> dict:
         stages["device_s"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        algo.snapshot_for_publish().to_bundle().to_bytes()
-        stages["publish_s"] += time.perf_counter() - t0
+        snap = algo.snapshot_for_publish()
+        frame, _info = wire_enc.encode(snap.version, snap.arch,
+                                       snap.host_params())
+        dt = time.perf_counter() - t0
+        stages["serialize_s"] += dt
+        stages["publish_s"] += dt
 
+        t0 = time.perf_counter()
+        pub.send_multipart([MODEL_TOPIC,
+                            pack_model_frame(snap.version, frame)])
+        dt = time.perf_counter() - t0
+        stages["socket_s"] += dt
+        stages["publish_s"] += dt  # legacy total: serialize + socket
+
+    stop_drain.set()
+    drainer.join(timeout=2)
+    pub.close(linger=0)
+    sub.close(linger=0)
     return {
         "epochs": epochs, "traj_per_epoch": tpe, "episode_len": ep_len,
         "obs_dim": obs_dim, "act_dim": act_dim,

@@ -1,0 +1,445 @@
+"""Model-wire v2 bench: bytes/publish and publish→swap latency, v1 vs v2.
+
+The distribution plane costs O(actors × model_size × publish_rate)
+bytes under the v1 full-bundle format; wire v2 ships per-leaf integer
+deltas with periodic keyframes (transport/modelwire.py). This bench
+measures, on REAL consecutive updates (actual REINFORCE epoch updates
+for the MLP rows; actual jitted policy-gradient updates for the
+transformer rows — never synthetic noise):
+
+* ``model_wire_bytes`` rows — v1 bytes/publish vs v2 delta-frame bytes
+  (mean + p50), keyframe bytes, the amortized bytes/publish at the
+  default keyframe_interval=10, encode and decode+apply costs, and the
+  reduction ratios. Scenario grid spans the reference 2x128 MLP through
+  transformer sizes, including:
+    - ``full_train``: every parameter moved by an Adam epoch at the
+      config's default lr — the worst case for a lossless delta wire
+      (bits actually changed bound the ratio);
+    - ``rlhf_finetune``: the dominant large-transformer RL recipe —
+      low-lr (1e-6) adaptation with the embedding/lower half frozen
+      (optax.masked) — where the per-leaf skip + small-delta planes pay
+      off hardest. This is the headline transformer row.
+* ``model_wire_latency`` rows — publish→swap wall latency over a LIVE
+  zmq PUB/SUB pair (serialize/encode + socket + decode + install, the
+  full production path through ``PolicyActor.swap_from_wire``), v1 vs
+  v2, at MLP sizes (the "v2 must not cost latency where the bytes win
+  is small" criterion) and the small-transformer size. The v2 rows run
+  with a live telemetry registry and embed its snapshot, so the
+  committed rows carry the new ``relayrl_wire_*`` publish-bytes
+  counters in the exact ``/snapshot`` schema (the soak-row convention).
+
+Run: python benches/bench_model_wire.py [--quick] [--write]
+Artifact (with --write): benches/results/model_wire.json (NDJSON — see
+benches/README.md "results format"; parse with common.load_results).
+Host-side bench: forces CPU JAX like the rest of benches/.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import statistics
+import sys
+import threading
+import time
+
+from common import bench_cwd, emit, free_port, quick, setup_platform
+
+setup_platform()
+
+KEYFRAME_INTERVAL = 10
+
+
+# ---------------------------------------------------------------------------
+# real consecutive-update generators
+# ---------------------------------------------------------------------------
+
+def _reinforce_mlp_versions(obs_dim, act_dim, hidden, updates, seed=0):
+    """Real REINFORCE epoch updates through the algorithm family path
+    (accumulate → train_on_batch), host params snapshot after each."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from relayrl_tpu.algorithms import build_algorithm
+    from relayrl_tpu.types.action import ActionRecord
+
+    rng = np.random.default_rng(seed)
+    tpe, ep_len = 4, 32
+    algo = build_algorithm(
+        "REINFORCE", obs_dim=obs_dim, act_dim=act_dim, traj_per_epoch=tpe,
+        hidden_sizes=list(hidden), with_vf_baseline=True, seed_salt=0,
+        logger_kwargs={"output_dir": tempfile.mkdtemp()})
+    algo.warmup()
+    arch = dict(algo.bundle().arch)
+    versions = [jax.device_get(algo.bundle().params)]
+    for _u in range(updates):
+        for _t in range(tpe):
+            episode = [
+                ActionRecord(
+                    obs=rng.standard_normal(obs_dim).astype(np.float32),
+                    act=np.int64(rng.integers(act_dim)),
+                    rew=float(rng.random()),
+                    data={"logp_a": np.float32(-0.69), "v": np.float32(0.0)},
+                    done=(i == ep_len - 1))
+                for i in range(ep_len)
+            ]
+            batch = algo.accumulate(episode)
+            if batch is not None:
+                jax.block_until_ready(
+                    algo.train_on_batch(batch).device)
+        versions.append(jax.device_get(algo.bundle().params))
+    return arch, versions
+
+
+def _transformer_versions(d_model, n_layers, max_seq_len, lr, updates,
+                          freeze_bottom=False, seed=0, seq=None):
+    """Real jitted policy-gradient (REINFORCE surrogate) Adam updates on
+    a transformer policy. ``freeze_bottom`` applies the standard
+    fine-tune recipe: optax.masked adam over the top half of the blocks
+    + heads + final norm, embeddings and lower blocks frozen."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from relayrl_tpu.models import build_policy
+
+    arch = {"kind": "transformer_discrete", "obs_dim": 8, "act_dim": 5,
+            "d_model": d_model, "n_layers": n_layers, "n_heads": 2,
+            "max_seq_len": max_seq_len, "has_critic": True}
+    policy = build_policy(arch)
+    params = policy.init_params(jax.random.PRNGKey(seed))
+
+    if freeze_bottom:
+        top = {f"block_{i}" for i in range(n_layers // 2, n_layers)}
+        trainable_roots = top | {"pi_head", "vf_head", "vf_head_up",
+                                 "ln_final"}
+
+        def label(path, _leaf):
+            keys = {str(getattr(k, "key", k)) for k in path}
+            return "train" if keys & trainable_roots else "freeze"
+
+        # multi_transform + set_to_zero, NOT optax.masked: masked leaves
+        # the un-masked updates untouched (raw gradients would still
+        # move the "frozen" params).
+        tx = optax.multi_transform(
+            {"train": optax.adam(lr), "freeze": optax.set_to_zero()},
+            jax.tree_util.tree_map_with_path(label, params))
+    else:
+        tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    rng = np.random.default_rng(seed)
+    B, T = 4, int(seq or min(64, max_seq_len))
+    batch = {
+        "obs": jnp.asarray(rng.standard_normal((B, T, 8)), jnp.float32),
+        "act": jnp.asarray(rng.integers(0, 5, (B, T)), jnp.int32),
+        "adv": jnp.asarray(rng.standard_normal((B, T)), jnp.float32),
+    }
+
+    def loss_fn(p):
+        logp, _ent, v = policy.evaluate(p, batch["obs"], batch["act"])
+        pg = -(logp * batch["adv"]).mean()
+        return pg + 0.5 * (v ** 2).mean()
+
+    # Donate like production learners (bench_learner.py does the same):
+    # the old params/opt-state buffers are dead after each call.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def update(p, s):
+        grads = jax.grad(loss_fn)(p)
+        upd, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, upd), s
+
+    versions = [jax.device_get(params)]
+    for _ in range(updates):
+        params, opt_state = update(params, opt_state)
+        versions.append(jax.device_get(params))
+    return arch, versions
+
+
+# ---------------------------------------------------------------------------
+# bytes/publish measurement
+# ---------------------------------------------------------------------------
+
+def measure_bytes(name, scenario, arch, versions) -> dict:
+    import numpy as np
+
+    from relayrl_tpu.transport import modelwire as mw
+    from relayrl_tpu.types.model_bundle import ModelBundle, leaf_manifest
+
+    # small_model_bytes=0: these rows measure the delta FORMAT itself at
+    # every size (production "auto" ships sub-256KB models as v1
+    # passthrough precisely because of what the small rows show here).
+    enc = mw.ModelWireEncoder(keyframe_interval=10**9, compress="auto",
+                              small_model_bytes=0)
+    dec = mw.ModelWireDecoder()
+    codec_name = {mw.CODEC_RAW: "raw", mw.CODEC_ZSTD: "zstd",
+                  mw.CODEC_LZ4: "lz4", mw.CODEC_ZLIB: "zlib"}[enc.codec]
+
+    manifest, leaves = leaf_manifest(versions[0])
+    param_bytes = sum(leaf.nbytes for leaf in leaves)
+    v1_sizes, delta_sizes, enc_ms, dec_ms = [], [], [], []
+    keyframe_bytes = None
+    unchanged = 0
+    for v, params in enumerate(versions, start=1):
+        v1_sizes.append(len(
+            ModelBundle(version=v, arch=arch, params=params).to_bytes()))
+        t0 = time.perf_counter()
+        frame, info = enc.encode(v, arch, params)
+        enc_ms.append((time.perf_counter() - t0) * 1e3)
+        if info["kind"] == "keyframe":
+            keyframe_bytes = len(frame)
+        else:
+            delta_sizes.append(len(frame))
+            _k, hdr, _p = mw.parse_frame(frame)
+            unchanged += len(manifest) - len(hdr["leaves"])
+        t0 = time.perf_counter()
+        out = dec.decode(frame)
+        dec_ms.append((time.perf_counter() - t0) * 1e3)
+        # paranoia: the decoded tree must match the published params
+        for a, b in zip(dec._buffers,
+                        [np.ascontiguousarray(np.asarray(x))
+                         for x in leaf_manifest(params)[1]]):
+            assert a.tobytes() == b.tobytes(), "wire round-trip diverged"
+        assert out is not None
+    n_delta = len(delta_sizes)
+    delta_mean = statistics.fmean(delta_sizes)
+    v1_mean = statistics.fmean(v1_sizes)
+    k = KEYFRAME_INTERVAL
+    amortized = ((k - 1) * delta_mean + keyframe_bytes) / k
+    return {
+        "bench": "model_wire_bytes",
+        "config": {"model": name, "scenario": scenario, "transport": "offline",
+                   "compress": codec_name,
+                   "keyframe_interval": KEYFRAME_INTERVAL,
+                   "updates": n_delta, "param_count": int(param_bytes // 4),
+                   "param_bytes": int(param_bytes)},
+        "v1_bytes_per_publish": round(v1_mean, 1),
+        "keyframe_bytes": keyframe_bytes,
+        "delta_bytes_mean": round(delta_mean, 1),
+        "delta_bytes_p50": statistics.median(delta_sizes),
+        "delta_reduction_x": round(v1_mean / delta_mean, 2),
+        "amortized_bytes_per_publish": round(amortized, 1),
+        "amortized_reduction_x": round(v1_mean / amortized, 2),
+        "unchanged_leaf_frac": round(
+            unchanged / (n_delta * len(manifest)), 3),
+        "encode_ms_mean": round(statistics.fmean(enc_ms), 3),
+        "decode_apply_ms_mean": round(statistics.fmean(dec_ms), 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# publish→swap latency over a live zmq pair
+# ---------------------------------------------------------------------------
+
+def measure_latency(name, arch, versions, wire_version,
+                    embed_snapshot=False, force_delta=False) -> dict:
+    import jax
+
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.runtime.policy_actor import PolicyActor
+    from relayrl_tpu.transport import modelwire as mw
+    from relayrl_tpu.transport.zmq_backend import (
+        ZmqAgentTransport,
+        ZmqServerTransport,
+    )
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    # Every cell runs with a LIVE registry — v1 vs v2 must carry the
+    # same instrumentation cost or the comparison is skewed; the
+    # snapshot is embedded where the row promises the wire counters.
+    from relayrl_tpu.telemetry.core import Registry
+
+    telemetry.set_registry(
+        Registry(run_id=f"bench-wire-{name}-v{wire_version}"))
+
+    p1, p2, p3 = free_port(), free_port(), free_port()
+    srv = ZmqServerTransport(f"tcp://127.0.0.1:{p1}", f"tcp://127.0.0.1:{p2}",
+                             f"tcp://127.0.0.1:{p3}")
+    bundle0 = ModelBundle(version=1, arch=arch, params=versions[0])
+    v1_bytes0 = bundle0.to_bytes()
+    srv.get_model = lambda: (1, v1_bytes0)
+    srv.start()
+    agent = ZmqAgentTransport(f"tcp://127.0.0.1:{p1}", f"tcp://127.0.0.1:{p2}",
+                              f"tcp://127.0.0.1:{p3}")
+    try:
+        ver, bs = agent.fetch_model(timeout_s=30)
+        actor = PolicyActor(ModelBundle.from_bytes(
+            bs, params_template=ModelBundle.RAW_TREE), seed=0)
+        actor.version = ver
+        swap_done: dict[int, float] = {}
+        swap_event = threading.Event()
+
+        def on_model(v, blob):
+            try:
+                if actor.swap_from_wire(v, blob) is not None:
+                    swap_done[v] = time.perf_counter()
+                    swap_event.set()
+            except mw.WireBaseMismatch:
+                pass
+
+        agent.on_model = on_model
+        agent.start_model_listener()
+
+        # force_delta=0 threshold measures the raw delta path even where
+        # production "auto" would passthrough (small models) — committed
+        # alongside the auto row so the adaptive policy is inspectable.
+        enc = mw.ModelWireEncoder(keyframe_interval=KEYFRAME_INTERVAL,
+                                  compress="auto",
+                                  small_model_bytes=0 if force_delta
+                                  else None)
+        enc.encode(1, arch, versions[0])
+
+        def make_frame(v, params):
+            # The serialize/encode the publisher thread pays per publish
+            # in production (v1: full to_bytes; v2: delta/keyframe
+            # encode) — measured inside the latency window below.
+            if wire_version == 2:
+                return enc.encode(v, arch, params)[0]
+            return ModelBundle(version=v, arch=arch, params=params).to_bytes()
+
+        def wait_swap(v, timeout):
+            # Event-based, NOT a busy-spin: a spinning main thread would
+            # GIL-starve the listener doing the decode under test and
+            # inflate the very latency being measured.
+            deadline = time.perf_counter() + timeout
+            while v not in swap_done:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return False
+                swap_event.wait(min(remaining, 0.5))
+                swap_event.clear()
+            return True
+
+        # Subscription-join warmup: encode v2 ONCE (re-encoding would
+        # advance the delta base), re-publish the same frame until the
+        # SUB delivers it (re-deliveries are stale-dropped).
+        frame2 = make_frame(2, versions[1])
+        deadline = time.perf_counter() + 60
+        while 2 not in swap_done:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("warmup publish never reached the SUB")
+            srv.publish_model(2, frame2)
+            wait_swap(2, 0.3)
+
+        lat_ms = []
+        for i, params in enumerate(versions[2:], start=3):
+            t0 = time.perf_counter()
+            frame = make_frame(i, params)
+            srv.publish_model(i, frame)
+            if not wait_swap(i, 30):
+                raise RuntimeError(f"swap of v{i} never landed")
+            lat_ms.append((swap_done[i] - t0) * 1e3)
+        ordered = sorted(lat_ms)
+        row = {
+            "bench": "model_wire_latency",
+            "config": {"model": name, "transport": "zmq",
+                       "wire_version": wire_version,
+                       "wire_policy": ("delta_forced" if force_delta
+                                       else "auto"),
+                       "keyframe_interval": KEYFRAME_INTERVAL,
+                       "publishes": len(lat_ms)},
+            "publish_to_swap_ms_p50": round(statistics.median(lat_ms), 3),
+            "publish_to_swap_ms_p99": round(
+                ordered[min(len(ordered) - 1,
+                            max(0, int(0.99 * len(ordered)) - 1))], 3),
+            "publish_to_swap_ms_mean": round(statistics.fmean(lat_ms), 3),
+        }
+        if embed_snapshot:
+            # The committed soak-row convention: the live registry
+            # snapshot (exact /snapshot schema) rides the row, carrying
+            # the new relayrl_wire_* publish-bytes counters.
+            row["telemetry"] = telemetry.get_registry().snapshot()
+        _ = jax
+        return row
+    finally:
+        agent.close()
+        srv.stop()
+
+
+def main() -> None:
+    bench_cwd()
+    write = "--write" in sys.argv
+    updates = 4 if quick() else 8
+    rows = []
+
+    # -- scenario grid: bytes/publish --
+    grid = [("mlp_2x128_obs4", "reinforce_train",
+             lambda: _reinforce_mlp_versions(4, 2, [128, 128], updates))]
+    if not quick():
+        grid += [
+            ("mlp_2x512_obs64", "reinforce_train",
+             lambda: _reinforce_mlp_versions(64, 18, [512, 512], updates)),
+            ("transformer_d64_L2_S256", "full_train_lr3e-4",
+             lambda: _transformer_versions(64, 2, 256, 3e-4, updates)),
+            ("transformer_d64_L2_S256", "full_train_lr3e-5",
+             lambda: _transformer_versions(64, 2, 256, 3e-5, updates)),
+            # The headline transformer row: RLHF-style fine-tune (lr
+            # 1e-6, embeddings + lower half frozen) — the dominant
+            # large-transformer RL recipe and the shape delta frames are
+            # built for.
+            ("transformer_d256_L4_S1024", "rlhf_finetune_lr1e-6_top_half",
+             lambda: _transformer_versions(256, 4, 1024, 1e-6, updates,
+                                           freeze_bottom=True)),
+        ]
+    else:
+        grid += [("transformer_d32_L1_S64", "full_train_lr3e-5",
+                  lambda: _transformer_versions(32, 1, 64, 3e-5, updates)),
+                 ("transformer_d64_L2_S256", "rlhf_finetune_lr1e-6_top_half",
+                  lambda: _transformer_versions(64, 2, 256, 1e-6, updates,
+                                                freeze_bottom=True))]
+
+    produced = {}
+    for name, scenario, make in grid:
+        arch, versions = make()
+        produced[name] = (arch, versions)
+        row = measure_bytes(name, scenario, arch, versions)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        emit("model_wire_delta_reduction",
+             {"model": name, "scenario": scenario,
+              "compress": row["config"]["compress"]},
+             row["delta_reduction_x"], "x_smaller_than_v1")
+
+    # -- publish→swap latency, v1 vs v2, on the live zmq plane --
+    # Longer real-update chains than the bytes rows: latency p50/p99
+    # wants samples, and these models regenerate in seconds.
+    lat_updates = 6 if quick() else 24
+    lat_sources = {
+        "mlp_2x128_obs4":
+            lambda: _reinforce_mlp_versions(4, 2, [128, 128], lat_updates),
+        "transformer_d64_L2_S256":
+            lambda: _transformer_versions(64, 2, 256, 3e-5, lat_updates),
+    }
+    lat_models = ["mlp_2x128_obs4"]
+    if not quick():
+        lat_models.append("transformer_d64_L2_S256")
+    for name in lat_models:
+        arch, versions = lat_sources[name]()
+        cells = [(1, False, False), (2, True, False)]
+        if name.startswith("mlp"):
+            # Production "auto" passthroughs this size; the forced-delta
+            # cell shows what that policy avoids.
+            cells.append((2, False, True))
+        for wire, with_tel, forced in cells:
+            row = measure_latency(name, arch, versions, wire,
+                                  embed_snapshot=with_tel,
+                                  force_delta=forced)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    if write:
+        import os
+
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "model_wire.json")
+        with open(out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

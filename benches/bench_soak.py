@@ -132,9 +132,11 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     publishes: list[tuple[int, int]] = []
     orig_publish = server.transport.publish_model
 
-    def publish_model(version, bundle_bytes):
+    def publish_model(version, bundle_bytes, **kwargs):
+        # **kwargs: wire-v2 servers pass handshake_bytes to native
+        # transports alongside the frame.
         publishes.append((int(version), time.monotonic_ns()))
-        orig_publish(version, bundle_bytes)
+        orig_publish(version, bundle_bytes, **kwargs)
 
     server.transport.publish_model = publish_model
     # Per-agent trajectory attribution: distinct agent ids the ingest

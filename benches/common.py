@@ -81,6 +81,40 @@ def emit(bench: str, config: dict, value: float, unit: str) -> None:
                       "value": round(value, 6), "unit": unit}), flush=True)
 
 
+def load_results(path) -> list:
+    """Load a committed ``benches/results/*.json`` file as a list of rows.
+
+    The results directory holds TWO shapes (benches/README.md "results
+    format"): NDJSON — one JSON object per line, the shape ``emit()``
+    prints and most benches redirect into their results file (a plain
+    ``json.load`` fails on these with "Extra data") — and single-document
+    JSON (an object or a list, sometimes pretty-printed) from benches
+    that assemble one summary. This loader is the ONE reader for both:
+    single documents parse first (a pretty-printed object is many lines
+    but one document); anything else parses per line. A list document
+    returns as-is; an object document returns as ``[obj]``; every
+    returned element is a parsed row.
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        return doc if isinstance(doc, list) else [doc]
+    except json.JSONDecodeError:
+        pass
+    rows = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"{path}:{lineno}: neither a JSON document nor NDJSON "
+                f"({e})") from e
+    return rows
+
+
 def time_fn(fn, warmup: int = 3, iters: int = 20) -> dict:
     """Median/mean/p99 wall time of ``fn()`` in seconds."""
     for _ in range(warmup):

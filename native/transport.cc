@@ -159,6 +159,21 @@ class Server {
     wake();
   }
 
+  // Model-wire v2 pass-through: broadcast an opaque frame (delta/keyframe/
+  // chunk bytes the embedder produced) WITHOUT touching the stored
+  // handshake model — kFrameGetModel must keep serving a full bundle the
+  // embedder pushes via set_model. Frames queue in order; chunked
+  // publishes stay contiguous because the embedder enqueues all chunks
+  // before the loop thread drains.
+  void broadcast_frame(uint64_t version, const uint8_t* data, size_t len) {
+    {
+      std::lock_guard<std::mutex> g(bcast_mu_);
+      pending_frames_.emplace_back(version,
+                                   std::vector<uint8_t>(data, data + len));
+    }
+    wake();
+  }
+
   long poll(int timeout_ms, int* ev_type, uint8_t* buf, size_t cap) {
     return hub_.poll(timeout_ms, ev_type, buf, cap);
   }
@@ -390,16 +405,30 @@ class Server {
 
   void maybe_broadcast() {
     bool todo = false;
+    std::deque<std::pair<uint64_t, std::vector<uint8_t>>> frames;
     {
       std::lock_guard<std::mutex> g(bcast_mu_);
       todo = pending_broadcast_;
       pending_broadcast_ = false;
+      frames.swap(pending_frames_);
     }
-    if (!todo) return;
-    auto [version, model] = hub_.model_copy();
-    std::vector<uint8_t> body(8 + model.size());
-    memcpy(body.data(), &version, 8);
-    if (!model.empty()) memcpy(body.data() + 8, model.data(), model.size());
+    if (todo) {
+      auto [version, model] = hub_.model_copy();
+      std::vector<uint8_t> body(8 + model.size());
+      memcpy(body.data(), &version, 8);
+      if (!model.empty()) memcpy(body.data() + 8, model.data(), model.size());
+      push_to_subscribers(body);
+    }
+    for (auto& [version, payload] : frames) {
+      std::vector<uint8_t> body(8 + payload.size());
+      memcpy(body.data(), &version, 8);
+      if (!payload.empty())
+        memcpy(body.data() + 8, payload.data(), payload.size());
+      push_to_subscribers(body);
+    }
+  }
+
+  void push_to_subscribers(const std::vector<uint8_t>& body) {
     std::vector<int> dead;
     for (auto& [fd, conn] : conns_) {
       if (!conn.subscriber) continue;
@@ -459,6 +488,9 @@ class Server {
 
   std::mutex bcast_mu_;
   bool pending_broadcast_ = false;
+  // Opaque wire-v2 frames queued by broadcast_frame (ordered; drained by
+  // the loop thread alongside the legacy stored-model broadcast flag).
+  std::deque<std::pair<uint64_t, std::vector<uint8_t>>> pending_frames_;
 
   relayrl::EventHub hub_;  // embedder event queue + model state
 };
@@ -598,6 +630,7 @@ class Client {
     memcpy(version, f.payload.data(), 8);
     *rx_ns = q_frames_.front().rx_ns;
     memcpy(buf, f.payload.data() + 8, n);
+    q_bytes_ -= f.payload.size();
     q_frames_.pop_front();
     return static_cast<long>(n);
   }
@@ -654,10 +687,22 @@ class Client {
             std::lock_guard<std::mutex> lk(q_mu_);
             receipts_.push_back({ver, ns});
             if (receipts_.size() > 65536) receipts_.pop_front();
+            q_bytes_ += f.payload.size();
             q_frames_.push_back({std::move(f), ns});
-            // Agents only ever install the newest model; cap the payload
-            // queue so a slow Python drain can't hoard model-sized frames.
-            while (q_frames_.size() > 8) q_frames_.pop_front();
+            // Cap the payload queue so a slow Python drain can't hoard
+            // model-sized frames — by BYTES, not the old 8-frame count:
+            // wire-v2 deltas are not individually skippable (each
+            // advances the base) and a chunked keyframe arrives as many
+            // frames that must ALL survive until the drain (a frame
+            // count would evict chunk 0 of any frame split finer than
+            // the cap). 256 MiB bounds a slow drain's hoard while
+            // holding far more chunk stream than any sane chunk_bytes
+            // produces; at least one queued frame always survives.
+            while (q_frames_.size() > 1 &&
+                   q_bytes_ > (size_t{256} << 20)) {
+              q_bytes_ -= q_frames_.front().frame.payload.size();
+              q_frames_.pop_front();
+            }
           }
           q_cv_.notify_one();
         }
@@ -735,6 +780,7 @@ class Client {
   std::mutex q_mu_;
   std::condition_variable q_cv_;
   std::deque<QueuedFrame> q_frames_;
+  size_t q_bytes_ = 0;  // payload bytes queued (the eviction budget)
   std::deque<Receipt> receipts_;
 };
 
@@ -764,6 +810,11 @@ void rl_server_set_model(void* h, uint64_t version, const uint8_t* data,
 
 void rl_server_set_idle_timeout(void* h, int ms) {
   static_cast<Server*>(h)->set_idle_timeout(ms);
+}
+
+void rl_server_broadcast_frame(void* h, uint64_t version, const uint8_t* data,
+                               size_t len) {
+  static_cast<Server*>(h)->broadcast_frame(version, data, len);
 }
 
 void rl_server_broadcast(void* h, uint64_t version, const uint8_t* data,

@@ -170,7 +170,7 @@ DEFAULT_CONFIG: dict = {
         # --vector flag is the bench-plane equivalent.
         "host_mode": "process",
     },
-    # -- transport plane (docs/observability.md knob table) --
+    # -- transport plane (docs/operations.md knob table) --
     "transport": {
         # Native-transport liveness cadence: the agent pings the control
         # channel every heartbeat_s from its SUB thread (detects a dead
@@ -178,6 +178,27 @@ DEFAULT_CONFIG: dict = {
         # reaper keys off the same traffic). Was a hard-coded 5.0 in
         # native_bindings.start_model_listener. <= 0 disables the beat.
         "heartbeat_s": 5.0,
+        # -- model-wire v2 (transport/modelwire.py, docs/architecture.md
+        #    "model distribution") --
+        # 2 = delta-compressed per-leaf publish frames with periodic
+        # keyframes; 1 = the legacy full-ModelBundle blob every publish
+        # (the rolling-compat escape hatch — v2 actors still decode it).
+        "wire_version": 2,
+        # Every Nth publish is a full keyframe; it bounds how long a
+        # broadcast subscriber that missed a delta (drop, late join)
+        # stays stale before resyncing. <= 1 makes every frame a
+        # keyframe (== v1 bytes, framed).
+        "keyframe_interval": 10,
+        # Per-frame payload codec: "auto" walks zstd > lz4 > zlib
+        # (stdlib; Z_RLE strategy for delta planes), a codec name pins
+        # it, false/"none" ships raw. Incompressible payloads are
+        # skipped automatically; the codec id rides the frame header.
+        "compress": "auto",
+        # Split broadcast frames larger than this many bytes into
+        # ordered chunk frames (ZMQ HWM-friendly bounded messages; the
+        # native plane passes them through opaquely and Python listeners
+        # reassemble). 0 disables chunking.
+        "chunk_bytes": 0,
     },
     # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
     "telemetry": {

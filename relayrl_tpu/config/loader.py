@@ -204,15 +204,34 @@ class ConfigLoader:
         return params
 
     def get_transport_params(self) -> dict[str, Any]:
-        """Transport-plane knobs (``transport.heartbeat_s``), defaults
-        merged under user overrides; malformed values degrade to the
-        built-in cadence."""
+        """Transport-plane knobs (``transport.heartbeat_s`` plus the
+        model-wire v2 set ``wire_version`` / ``keyframe_interval`` /
+        ``compress`` / ``chunk_bytes``), defaults merged under user
+        overrides; malformed values degrade to the built-ins rather
+        than crashing transport construction."""
         params = dict(DEFAULT_CONFIG["transport"])
         params.update(self._section("transport"))
         try:
             params["heartbeat_s"] = float(params.get("heartbeat_s", 5.0))
         except (TypeError, ValueError):
             params["heartbeat_s"] = 5.0
+        try:
+            params["wire_version"] = int(params.get("wire_version", 2))
+        except (TypeError, ValueError):
+            params["wire_version"] = 2
+        if params["wire_version"] not in (1, 2):
+            params["wire_version"] = 2
+        try:
+            # >= 1: an interval that never keyframed would make the
+            # first dropped delta a permanent broadcast blackout.
+            params["keyframe_interval"] = max(
+                1, int(params.get("keyframe_interval", 10)))
+        except (TypeError, ValueError):
+            params["keyframe_interval"] = 10
+        try:
+            params["chunk_bytes"] = max(0, int(params.get("chunk_bytes", 0)))
+        except (TypeError, ValueError):
+            params["chunk_bytes"] = 0
         return params
 
     def get_telemetry_params(self) -> dict[str, Any]:

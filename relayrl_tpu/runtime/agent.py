@@ -29,6 +29,37 @@ from relayrl_tpu.types.action import ActionRecord
 from relayrl_tpu.types.model_bundle import ModelBundle
 
 
+def _deliver_model(actor_host, transport, client_model_path: str, tag: str,
+                   version: int, blob: bytes) -> None:
+    """Shared model-delivery handler for Agent and VectorAgent (both own
+    one subscription feeding one wire-aware swap): sniffing decode via
+    ``swap_from_wire``, resync on a base mismatch (raised once per
+    divergence — pull transports re-poll with ``ver=-1``, broadcast
+    transports wait out the keyframe interval), isolation of any other
+    decode/validation failure, and the client-model persist on install.
+    One body, so resync semantics can never drift between the two
+    actor-host kinds."""
+    from relayrl_tpu.transport.modelwire import WireBaseMismatch
+
+    try:
+        installed = actor_host.swap_from_wire(version, blob)
+    except WireBaseMismatch as e:
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("model_resync", agent_id=transport.identity,
+                       base=e.base, held=e.held, side="agent")
+        transport.request_resync()
+        return
+    except Exception as e:
+        print(f"[{tag}] rejected model update: {e!r}", flush=True)
+        return
+    if installed is not None:
+        try:
+            installed.save(client_model_path)
+        except OSError:
+            pass
+
+
 class Agent:
     def __init__(
         self,
@@ -69,7 +100,8 @@ class Agent:
         self.transport = make_agent_transport(
             self.server_type, self.config, **overrides)
         version, bundle_bytes = self.transport.fetch_model(self._handshake_timeout_s)
-        bundle = ModelBundle.from_bytes(bundle_bytes)
+        bundle = ModelBundle.from_bytes(bundle_bytes,
+                                        params_template=ModelBundle.RAW_TREE)
         bundle.version = version
         # Persist before loading, like the reference writes client_model.pt
         # (agent_zmq.rs:388-396) — survives restarts / aids debugging.
@@ -114,16 +146,8 @@ class Agent:
         telemetry.emit("agent_reconnect", agent_id=self.transport.identity)
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
-        try:
-            bundle = ModelBundle.from_bytes(bundle_bytes)
-            bundle.version = version
-            if self.actor.maybe_swap(bundle):
-                try:
-                    bundle.save(self.client_model_path)
-                except OSError:
-                    pass
-        except Exception as e:
-            print(f"[Agent] rejected model update: {e!r}", flush=True)
+        _deliver_model(self.actor, self.transport, self.client_model_path,
+                       "Agent", version, bundle_bytes)
 
     # -- action API (ref: o3_agent.rs:117-217) --
     def request_for_action(self, obs, mask=None, reward: float = 0.0) -> ActionRecord:
@@ -223,7 +247,8 @@ class VectorAgent:
             self.server_type, self.config, **overrides)
         version, bundle_bytes = self.transport.fetch_model(
             self._handshake_timeout_s)
-        bundle = ModelBundle.from_bytes(bundle_bytes)
+        bundle = ModelBundle.from_bytes(bundle_bytes,
+                                        params_template=ModelBundle.RAW_TREE)
         bundle.version = version
         try:
             bundle.save(self.client_model_path)
@@ -269,18 +294,10 @@ class VectorAgent:
                                        agent_id=self.agent_ids[lane])
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
-        # ONE receipt serves all lanes: a single maybe_swap atomically
-        # installs the new params for the whole batch.
-        try:
-            bundle = ModelBundle.from_bytes(bundle_bytes)
-            bundle.version = version
-            if self.host.maybe_swap(bundle):
-                try:
-                    bundle.save(self.client_model_path)
-                except OSError:
-                    pass
-        except Exception as e:
-            print(f"[VectorAgent] rejected model update: {e!r}", flush=True)
+        # ONE receipt serves all lanes: a single wire-aware swap
+        # atomically installs the new params for the whole batch.
+        _deliver_model(self.host, self.transport, self.client_model_path,
+                       "VectorAgent", version, bundle_bytes)
 
     # -- batched action API --
     def request_for_actions(self, obs, masks=None, rewards=None):

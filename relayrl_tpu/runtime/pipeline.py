@@ -155,15 +155,20 @@ class PublishSnapshot:
     arch: dict
     params: Any
 
-    def to_bundle(self):
-        """Gather to host + wrap — the blocking D2H that must run on the
-        publisher thread, never the learner thread."""
+    def host_params(self):
+        """The blocking D2H gather — runs on the publisher thread, never
+        the learner thread. The wire-v2 publish path consumes the host
+        tree directly (the encoder keeps it as the next delta's base);
+        :meth:`to_bundle` wraps it for the v1 full-bundle path."""
         import jax
 
+        return jax.device_get(self.params)
+
+    def to_bundle(self):
         from relayrl_tpu.types.model_bundle import ModelBundle
 
         return ModelBundle(version=self.version, arch=self.arch,
-                           params=jax.device_get(self.params))
+                           params=self.host_params())
 
 
 class ModelPublisher:

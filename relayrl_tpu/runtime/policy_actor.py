@@ -87,6 +87,64 @@ def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
     return True
 
 
+def apply_wire_swap(actor, version: int, blob: bytes):
+    """Shared model-delivery decode + swap for both actor hosts: sniffs
+    wire-v2 frames vs legacy v1 bundles and returns the installed
+    :class:`ModelBundle` (or None when nothing was installed).
+
+    v2 path (the hot path): the frame applies into the actor's
+    :class:`~relayrl_tpu.transport.modelwire.ModelWireDecoder`
+    preallocated host buffers via ``np.frombuffer`` views — no flax
+    ``from_bytes`` deep restore — then ONE ``jax.device_put`` of the
+    assembled pytree feeds the existing :func:`apply_bundle_swap` gate.
+    The device_put copies out of the buffers, so the next frame's
+    in-place delta apply can never corrupt installed params (asserted by
+    tests/test_model_wire.py). Installing *device* arrays also spares
+    every subsequent policy dispatch the per-call host transfer.
+
+    v1 path: legacy decode, plus a decoder reseed so a mixed-version
+    fleet (v1 server, v2-capable actor) keeps the wire state coherent.
+
+    Raises :class:`~relayrl_tpu.transport.modelwire.WireBaseMismatch`
+    (once per divergence) so the transport owner can trigger a resync —
+    gRPC re-polls with ``ver=-1``; broadcast planes wait out the
+    keyframe interval.
+    """
+    from relayrl_tpu.transport import modelwire
+
+    if not modelwire.is_wire_frame(blob):
+        bundle = ModelBundle.from_bytes(blob,
+                                        params_template=ModelBundle.RAW_TREE)
+        bundle.version = version
+        if not apply_bundle_swap(actor, bundle):
+            return None
+        if actor._wire_decoder is not None:
+            actor._wire_decoder.seed(bundle.version, bundle.arch,
+                                     bundle.params)
+        return bundle
+    dec = actor._wire_decoder
+    if dec is None:
+        dec = actor._wire_decoder = modelwire.ModelWireDecoder()
+        dec.seed(actor.version, actor.arch, jax.device_get(actor.params))
+    out = dec.decode(blob)
+    if out is None:
+        return None  # stale duplicate, or awaiting a keyframe after resync
+    ver, arch, host_tree = out
+    # The decoder's buffers are its LIVE delta targets — the next frame
+    # mutates them in place — so the install must own its memory:
+    # np.array copies first (device_put alone zero-copy aliases host
+    # numpy on CPU backends; the isolation test in test_model_wire.py
+    # catches exactly that), then ONE device_put of the assembled pytree
+    # where a real transfer exists. On CPU actor hosts the host copies
+    # install directly — same placement semantics as the v1 path, and a
+    # device_put dispatch per leaf would cost more than the memcpy.
+    params = jax.tree.map(np.array, host_tree)
+    if jax.default_backend() != "cpu":
+        params = jax.device_put(params)
+    bundle = ModelBundle(version=ver, arch=arch, params=params)
+    return bundle if apply_bundle_swap(actor, bundle) else None
+
+
 def make_batched_step(policy):
     """One jitted, vmapped sampling step over stacked per-lane inputs:
     ``fn(params, keys[N,2], obs[N,...], masks, explore) -> (acts, aux,
@@ -204,6 +262,10 @@ class PolicyActor:
                 donate_argnums=(1,) if donate else ())
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._rng = jax.random.PRNGKey(seed)
+        # Wire-v2 decode state (preallocated per-leaf host buffers),
+        # created lazily on the first v2 frame (apply_wire_swap) so
+        # in-process actors that never touch the network pay nothing.
+        self._wire_decoder = None
         self.trajectory = Trajectory(max_length=max_traj_length, on_send=on_send)
         from relayrl_tpu import telemetry
 
@@ -333,7 +395,13 @@ class PolicyActor:
         return apply_bundle_swap(self, bundle)
 
     def swap_from_bytes(self, buf: bytes) -> bool:
-        return self.maybe_swap(ModelBundle.from_bytes(buf))
+        return self.maybe_swap(
+            ModelBundle.from_bytes(buf, params_template=ModelBundle.RAW_TREE))
+
+    def swap_from_wire(self, version: int, blob: bytes):
+        """Wire-v2-aware swap (sniffs v1 bundles too); returns the
+        installed ModelBundle or None — see :func:`apply_wire_swap`."""
+        return apply_wire_swap(self, version, blob)
 
     def _push_window(self, obs: np.ndarray) -> bool:
         """Append one observation to the rolling history (lock held).

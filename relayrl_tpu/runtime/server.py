@@ -232,6 +232,24 @@ class TrainingServer:
         self._bundle_lock = threading.Lock()
         self._bundle_bytes: bytes = self.algorithm.bundle().to_bytes()
         self._bundle_version: int = self.algorithm.version
+        # Latest published model as a HOST tree (version, arch, params):
+        # the v1 bundle bytes for handshakes/artifacts serialize lazily
+        # from it in _get_model, so the wire-v2 publish path never pays a
+        # full flax serialize per publish (only per handshake-or-artifact
+        # that actually needs one).
+        self._bundle_host: tuple[int, dict, object] | None = None
+        # Model-wire v2 (transport/modelwire.py): per-leaf delta frames
+        # with periodic keyframes replace the full-bundle blob on the
+        # broadcast plane. transport.wire_version=1 is the rolling-compat
+        # escape hatch (v1 fleets; v2 actors decode either).
+        transport_cfg = self.config.get_transport_params()
+        self._wire_encoder = None
+        if int(transport_cfg.get("wire_version", 2)) >= 2:
+            from relayrl_tpu.transport.modelwire import ModelWireEncoder
+
+            self._wire_encoder = ModelWireEncoder(
+                keyframe_interval=transport_cfg["keyframe_interval"],
+                compress=transport_cfg["compress"])
 
         # Non-coordinator processes run learner steps only — the actor
         # plane (sockets) binds on the coordinator host alone.
@@ -246,6 +264,19 @@ class TrainingServer:
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
             self.transport.on_unregister = self._on_unregister
+            if getattr(self.transport, "serves_full_bundles_only", False):
+                # This plane (native C++ gRPC long-polls) ships the
+                # stored full bundle to every subscriber regardless —
+                # encoding delta frames would burn publisher CPU and
+                # record wire counters for bytes that never leave.
+                self._wire_encoder = None
+            if self._wire_encoder is not None:
+                # Pull transports (gRPC long-polls) choose delta-vs-full
+                # per subscriber through this surface; the version probe
+                # keeps their wakeup checks from forcing lazy serializes.
+                self.transport.get_model_update = self._get_model_update
+                self.transport.get_model_version = (
+                    lambda: self.latest_model_version)
 
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
@@ -437,14 +468,53 @@ class TrainingServer:
             self._count_dropped(len(batch))
 
     def _get_model(self) -> tuple[int, bytes]:
+        """Current full model as v1 bundle bytes (handshakes, artifact
+        writes, gRPC resyncs). Serialized lazily from the latest
+        published host tree — at most once per version (barring a benign
+        handshake race), and not at all for versions nobody handshakes
+        during (the wire-v2 serialize saving; v1 publishes still store
+        their bytes eagerly). The serialize itself runs OUTSIDE
+        ``_bundle_lock``: a multi-second flax serialize of a large model
+        under the lock would stall every version probe and the
+        publisher's host-snapshot store."""
         with self._bundle_lock:
+            host = self._bundle_host
+            if host is None or host[0] == self._bundle_version:
+                return self._bundle_version, self._bundle_bytes
+        ver, arch, params = host
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        raw = ModelBundle(version=ver, arch=dict(arch),
+                          params=params).to_bytes()
+        with self._bundle_lock:
+            if ver > self._bundle_version:
+                self._bundle_bytes = raw
+                self._bundle_version = ver
+            # A racing caller may have installed a newer version; the
+            # cached pair is always internally consistent either way.
             return self._bundle_version, self._bundle_bytes
+
+    def _get_model_update(self, known_version: int) -> tuple[int, bytes]:
+        """Freshest blob a subscriber at ``known_version`` can decode:
+        the latest wire frame when its base matches (or it is a
+        keyframe), else the full v1 bundle (the server-side resync —
+        cheaper than bouncing the subscriber through an extra RTT)."""
+        enc = self._wire_encoder
+        if enc is not None:
+            got = enc.frame_for(known_version)
+            if got is not None:
+                return got
+        return self._get_model()
 
     @property
     def latest_model_version(self) -> int:
-        """Version of the most recently published model bundle — what an
-        agent's hot-swap should converge to (embedder/eval surface)."""
+        """Version of the most recently published model — what an
+        agent's hot-swap should converge to (embedder/eval surface).
+        Reads the published host snapshot, not the lazily-serialized v1
+        byte cache, which may trail it under wire v2."""
         with self._bundle_lock:
+            if self._bundle_host is not None:
+                return max(self._bundle_version, self._bundle_host[0])
             return self._bundle_version
 
     def _on_register(self, agent_id: str) -> None:
@@ -633,19 +703,16 @@ class TrainingServer:
                     self.algorithm.maybe_log_epoch()
                 except Exception as e:
                     print(f"[TrainingServer] log error: {e!r}", flush=True)
-                raw = bundle.to_bytes()
-                with self._bundle_lock:
-                    self._bundle_bytes = raw
-                    self._bundle_version = bundle.version
                 try:
-                    self.transport.publish_model(bundle.version, raw)
-                    from relayrl_tpu import telemetry
+                    import jax
 
-                    telemetry.emit("model_publish", version=bundle.version,
-                                   bytes=len(raw))
+                    # The collective bundle() all-gathered on every rank;
+                    # only the coordinator owns the actor plane, so only
+                    # it pays the host gather + wire encode.
+                    self._publish_params(bundle.version, bundle.arch,
+                                         jax.device_get(bundle.params))
                 except Exception as e:
                     print(f"[TrainingServer] publish error: {e!r}", flush=True)
-                self._write_model_artifact(raw, bundle.version)
                 if self._tb is not None:
                     try:
                         self._tb.poll()
@@ -900,13 +967,16 @@ class TrainingServer:
         """Periodic on-disk model bytes (ref: server reads the .pt file to
         serve agents, training_zmq.rs:905-919; for us handshakes are
         served from memory and the file is a resume/debug aid). Reuses the
-        serialized bytes, throttled by learner.checkpoint_every_epochs.
-        Distance-gated, not modulo-gated: latest-wins publish coalescing
-        makes published versions an arbitrary subsequence, so waiting for
-        a version divisible by the cadence could starve the file forever
-        (with every version published the two rules write identically)."""
+        (lazily) serialized v1 bytes, throttled by
+        learner.checkpoint_every_epochs. Distance-gated, not
+        modulo-gated: latest-wins publish coalescing makes published
+        versions an arbitrary subsequence, so waiting for a version
+        divisible by the cadence could starve the file forever (with
+        every version published the two rules write identically)."""
         if version - self._artifact_version < self._checkpoint_every:
             return
+        if raw is None:
+            raw = self._get_model()[1]
         try:
             path = self.algorithm.server_model_path
             tmp = f"{path}.tmp"
@@ -917,22 +987,58 @@ class TrainingServer:
         except OSError:
             pass
 
+    def _publish_params(self, version: int, arch: dict, host_params) -> None:
+        """The ONE broadcast path (pipelined, synchronous, and multi-host
+        publishes all land here with a host params tree). Wire v2: the
+        encoder turns the publish into a keyframe or per-leaf delta frame
+        off the learner thread; the full v1 bundle serializes lazily only
+        when a handshake, artifact write, or native set_model needs it.
+        Wire v1: the legacy full-bundle bytes ship on every publish."""
+        from relayrl_tpu import telemetry
+
+        enc = self._wire_encoder
+        with self._bundle_lock:
+            self._bundle_host = (int(version), dict(arch), host_params)
+        try:
+            if enc is not None:
+                frame, info = enc.encode(version, arch, host_params)
+                if getattr(self.transport, "needs_handshake_bytes", False):
+                    # The native core answers handshakes from pushed
+                    # bytes; a v2 publish rides with the v1 bundle for
+                    # set_model.
+                    self.transport.publish_model(
+                        version, frame, handshake_bytes=self._get_model()[1])
+                else:
+                    self.transport.publish_model(version, frame)
+                telemetry.emit("model_publish", version=version,
+                               bytes=info["frame_bytes"], kind=info["kind"],
+                               raw_bytes=info["raw_bytes"])
+            else:
+                from relayrl_tpu.types.model_bundle import ModelBundle
+
+                raw = ModelBundle(version=int(version), arch=dict(arch),
+                                  params=host_params).to_bytes()
+                with self._bundle_lock:
+                    self._bundle_bytes = raw
+                    self._bundle_version = int(version)
+                self.transport.publish_model(version, raw)
+                telemetry.emit("model_publish", version=version,
+                               bytes=len(raw))
+        finally:
+            # Distance-gated; a transient publish error must not starve
+            # the on-disk artifact (the multi-host path always wrote it).
+            self._write_model_artifact(None, version)
+
     def _publish(self) -> None:
         """Synchronous publish on the learner thread — the multi-host
         loop's path and the ``async_publish: false`` escape hatch (the
         pipelined path hands :meth:`_publish_snapshot` to the publisher
         thread instead)."""
-        from relayrl_tpu import telemetry
+        import jax
 
         bundle = self.algorithm.bundle()
-        raw = bundle.to_bytes()
-        with self._bundle_lock:
-            self._bundle_bytes = raw
-            self._bundle_version = bundle.version
-        self.transport.publish_model(bundle.version, raw)
-        telemetry.emit("model_publish", version=bundle.version,
-                       bytes=len(raw))
-        self._write_model_artifact(raw, bundle.version)
+        self._publish_params(bundle.version, bundle.arch,
+                             jax.device_get(bundle.params))
         self._maybe_periodic_checkpoint(bundle.version)
 
     def _maybe_periodic_checkpoint(self, version: int) -> None:
@@ -957,23 +1063,14 @@ class TrainingServer:
         self._ckpt_version = version
 
     def _publish_snapshot(self, snapshot) -> None:
-        """Publisher-thread body: the blocking D2H gather, serialize,
-        socket publish, and artifact write all happen here — a slow
-        subscriber or disk never stalls the learner thread, and
-        back-to-back epochs coalesce latest-wins upstream
-        (runtime/pipeline.ModelPublisher). Exceptions are counted and
-        logged by the publisher loop."""
-        from relayrl_tpu import telemetry
-
-        bundle = snapshot.to_bundle()
-        raw = bundle.to_bytes()
-        with self._bundle_lock:
-            self._bundle_bytes = raw
-            self._bundle_version = bundle.version
-        self.transport.publish_model(bundle.version, raw)
-        telemetry.emit("model_publish", version=bundle.version,
-                       bytes=len(raw))
-        self._write_model_artifact(raw, bundle.version)
+        """Publisher-thread body: the blocking D2H gather, wire encode
+        (delta/keyframe under v2, full serialize under v1), socket
+        publish, and artifact write all happen here — a slow subscriber
+        or disk never stalls the learner thread, and back-to-back epochs
+        coalesce latest-wins upstream (runtime/pipeline.ModelPublisher).
+        Exceptions are counted and logged by the publisher loop."""
+        self._publish_params(snapshot.version, snapshot.arch,
+                             snapshot.host_params())
 
     def _periodic_checkpoint(self) -> None:
         """One periodic save, with the replay-buffer (aux) snapshot

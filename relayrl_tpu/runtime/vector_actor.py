@@ -38,6 +38,7 @@ import numpy as np
 from relayrl_tpu.models import build_policy, validate_policy
 from relayrl_tpu.runtime.policy_actor import (
     apply_bundle_swap,
+    apply_wire_swap,
     make_batched_step,
     make_batched_window_step,
     resolve_actor_context,
@@ -89,6 +90,10 @@ class VectorActorHost:
             self._window_lens = np.zeros(self.num_envs, np.int32)
             self._batched_window_fn = make_batched_window_step(self.policy)
         self._explore_kwargs = exploration_kwargs(self.arch)
+        # Wire-v2 decode state, lazily created on the first v2 frame —
+        # ONE decoder for all lanes (the whole point: one subscription,
+        # one delta apply, one device_put, N lanes served).
+        self._wire_decoder = None
         if rng_keys is not None:
             keys = np.asarray(rng_keys)
             if keys.shape[0] != self.num_envs:
@@ -225,7 +230,13 @@ class VectorActorHost:
         return apply_bundle_swap(self, bundle)
 
     def swap_from_bytes(self, buf: bytes) -> bool:
-        return self.maybe_swap(ModelBundle.from_bytes(buf))
+        return self.maybe_swap(
+            ModelBundle.from_bytes(buf, params_template=ModelBundle.RAW_TREE))
+
+    def swap_from_wire(self, version: int, blob: bytes):
+        """Wire-v2-aware swap shared with PolicyActor (same attribute
+        contract); one frame updates every lane atomically."""
+        return apply_wire_swap(self, version, blob)
 
     def reset_episode(self, lane: int | None = None) -> None:
         """Reset per-episode serving state (history windows) without

@@ -31,6 +31,8 @@ from typing import Any, TextIO
 EVENT_TYPES = (
     "model_publish",     # server shipped a new version to the fleet
     "model_swap",        # an actor installed a new version
+    "model_resync",      # a wire-v2 delta didn't fit the held base; the
+                         # actor is re-pulling / awaiting a keyframe
     "agent_register",    # logical agent joined the registry
     "agent_unregister",  # logical agent left (clean exit or reaped)
     "agent_reconnect",   # agent-side transport rebuilt (restart/heal)

@@ -152,6 +152,14 @@ def make_server_transport(server_type: str, config: ConfigLoader,
         server_type = _resolve_auto()
         print(f"[Transport] auto -> {server_type} (server bind side)",
               flush=True)
+    transport_params = config.get_transport_params()
+    chunk_bytes = overrides.get("chunk_bytes",
+                                transport_params["chunk_bytes"])
+    if int(transport_params.get("wire_version", 2)) < 2:
+        # wire_version=1 is the rolling-compat escape hatch for PRE-v2
+        # actors — which have no chunk reassembler, so chunk frames
+        # would break exactly the fleet that knob serves.
+        chunk_bytes = 0
     if server_type == "zmq":
         from relayrl_tpu.transport.zmq_backend import ZmqServerTransport
 
@@ -162,6 +170,7 @@ def make_server_transport(server_type: str, config: ConfigLoader,
                 "trajectory_addr", config.get_traj_server().address),
             model_pub_addr=overrides.get(
                 "model_pub_addr", config.get_train_server().address),
+            chunk_bytes=chunk_bytes,
         )
     if server_type == "grpc":
         bind_addr = overrides.get("bind_addr",
@@ -188,6 +197,7 @@ def make_server_transport(server_type: str, config: ConfigLoader,
 
         return NativeServerTransport(
             bind_addr=overrides.get("bind_addr", config.get_traj_server().host_port),
+            chunk_bytes=chunk_bytes,
         )
     raise ValueError(f"unknown server_type {server_type!r} (zmq|grpc|native|auto)")
 

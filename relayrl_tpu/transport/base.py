@@ -194,11 +194,29 @@ class ServerTransport(abc.ABC):
     handshakes.
     ``on_register(agent_id)`` records an agent (multi-actor registry,
     ref: training_server_wrapper.rs:159-163).
+    ``get_model_update(known_version)`` is the model-wire v2 pull
+    surface: the freshest frame a subscriber holding ``known_version``
+    can decode (a delta when its base matches, else a full bundle).
+    Backends with per-subscriber delivery (gRPC long-polls) prefer it
+    when set; broadcast backends never call it. None means "no encoder
+    — serve get_model()".
     """
+
+    #: True when this backend's native core answers handshakes itself
+    #: from bytes pushed at publish time (set_model) — the embedding
+    #: server must then pass ``handshake_bytes`` (a full v1 bundle)
+    #: alongside any v2 ``publish_model`` frame.
+    needs_handshake_bytes = False
 
     def __init__(self):
         self.on_trajectory: Callable[[str, bytes], None] = lambda *_: None
         self.get_model: Callable[[], tuple[int, bytes]] = lambda: (0, b"")
+        self.get_model_update = None
+        # Cheap current-version probe (no bundle serialize): long-poll
+        # wakeup checks want the version alone — under wire v2 the full
+        # v1 bytes serialize lazily, and probing through get_model()
+        # would serialize a bundle nobody ships. None -> get_model()[0].
+        self.get_model_version = None
         self.on_register: Callable[[str], None] = lambda *_: None
         # Elastic fleets: fired when a registered agent's connection dies
         # (native transport's crash/idle detection; other backends may
@@ -256,6 +274,12 @@ class AgentTransport(abc.ABC):
     @abc.abstractmethod
     def start_model_listener(self) -> None:
         """Begin delivering model updates to ``on_model`` asynchronously."""
+
+    def request_resync(self) -> None:
+        """Model-wire v2 resync hook: ask the server for a full model on
+        the next delivery. Pull transports (gRPC) re-poll with
+        ``ver=-1``; broadcast transports have no back-channel and rely
+        on the publisher's periodic keyframes — the default no-op."""
 
     @abc.abstractmethod
     def close(self) -> None: ...

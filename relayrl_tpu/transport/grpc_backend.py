@@ -63,6 +63,24 @@ class _Servicer:
         self._owner.on_trajectory(agent_id, payload)
         return msgpack.packb({"code": 1})
 
+    def _model_update(self, known_version: int) -> tuple[int, bytes]:
+        """The freshest blob a subscriber holding ``known_version`` can
+        decode: the model-wire v2 delta/keyframe frame when the embedder
+        installed ``get_model_update`` (the delta-vs-full choice is
+        per-subscriber on this pull plane), else the full bundle."""
+        fn = self._owner.get_model_update
+        if fn is not None:
+            return fn(known_version)
+        return self._owner.get_model()
+
+    def _model_version(self) -> int:
+        """Version probe for long-poll wakeups — must not force a full
+        bundle serialize (wire-v2 servers serialize v1 bytes lazily)."""
+        fn = self._owner.get_model_version
+        if fn is not None:
+            return int(fn())
+        return self._owner.get_model()[0]
+
     def client_poll(self, request: bytes, context) -> bytes:
         req = msgpack.unpackb(request, raw=False)
         agent_id = str(req.get("id", "?"))
@@ -70,7 +88,12 @@ class _Servicer:
         first_time = bool(req.get("first", False))
         if first_time:
             self._owner.on_register(agent_id)
-        version, bundle = self._owner.get_model()
+        # Version probe only on entry: get_model() would force the
+        # wire-v2 server's LAZY v1 serialize for every published version
+        # (under its bundle lock, on an RPC thread) even when the reply
+        # ships a delta frame — the bundle is fetched only on the
+        # branches that actually send it.
+        version = self._model_version()
         if first_time and version <= known_version:
             # Logical-lane registration (vector hosts): the registrant
             # already holds the current model, so the ack is
@@ -79,17 +102,25 @@ class _Servicer:
             # the bundle below.
             return msgpack.packb({"code": 1, "ver": version},
                                  use_bin_type=True)
-        if first_time or version > known_version:
+        if first_time or known_version < 0:
+            # Handshakes and explicit resyncs (re-poll with ver=-1) get
+            # the full bundle unconditionally.
+            version, bundle = self._owner.get_model()
             return msgpack.packb({"code": 1, "ver": version, "model": bundle},
+                                 use_bin_type=True)
+        if version > known_version:
+            version, blob = self._model_update(known_version)
+            return msgpack.packb({"code": 1, "ver": version, "model": blob},
                                  use_bin_type=True)
         # long poll: wait for a newer model or timeout
         deadline = time.monotonic() + self._owner.idle_timeout_s
         with self._owner._model_cv:
             while True:
-                version, bundle = self._owner.get_model()
+                version = self._model_version()
                 if version > known_version:
+                    version, blob = self._model_update(known_version)
                     return msgpack.packb(
-                        {"code": 1, "ver": version, "model": bundle},
+                        {"code": 1, "ver": version, "model": blob},
                         use_bin_type=True)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not context.is_active():
@@ -314,6 +345,12 @@ class GrpcAgentTransport(AgentTransport):
         """Drain the pre-decode receipt ledger (same surface as the
         native C++ and zmq ledgers)."""
         return self._ledger.drain(max_n)
+
+    def request_resync(self) -> None:
+        """Model-wire v2 resync: forget the held version so the next
+        long-poll carries ``ver=-1`` and the server replies with a full
+        bundle instead of an undecodable delta."""
+        self._known_version = -1
 
     def close(self) -> None:
         self._stop.set()

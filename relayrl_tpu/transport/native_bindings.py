@@ -39,6 +39,14 @@ def _load(lib_path: str) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
     lib.rl_server_broadcast.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
+    # Wire-v2 opaque-frame broadcast (no stored-model update). Tolerate a
+    # stale prebuilt .so without the symbol: publishers then fall back to
+    # full-bundle broadcasts (correctness kept, wire savings lost).
+    try:
+        lib.rl_server_broadcast_frame.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_size_t]
+    except AttributeError:
+        pass
     lib.rl_server_poll.restype = ctypes.c_long
     lib.rl_server_poll.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int), u8p,
@@ -115,8 +123,12 @@ def _buf(data: bytes):
 class NativeServerTransportImpl(ServerTransport):
     PREFIX = "rl_server"  # symbol prefix: framed-TCP core (transport.cc)
 
+    # The C++ core answers kFrameGetModel itself from set_model bytes, so
+    # wire-v2 publishes must ride with a full v1 bundle for handshakes.
+    needs_handshake_bytes = True
+
     def __init__(self, lib_path: str, bind_addr: str,
-                 idle_timeout_s: float = 0.0):
+                 idle_timeout_s: float = 0.0, chunk_bytes: int = 0):
         super().__init__()
         self._lib = _load(lib_path)
         host, port = _parse_host_port(bind_addr)
@@ -126,6 +138,12 @@ class NativeServerTransportImpl(ServerTransport):
         # 0 disables reaping; live agents heartbeat well inside any sane
         # timeout, so only crashed/partitioned peers are dropped.
         self._idle_timeout_ms = int(idle_timeout_s * 1000)
+        # transport.chunk_bytes — the C++ framed protocol handles big
+        # frames natively, so chunking defaults off here; when enabled
+        # the chunks ride kFrameModelPush opaquely (pass-through) and the
+        # Python sub loop reassembles. NB: each chunk stamps a C++
+        # receipt, so fan-out accounting sees per-chunk receipt rows.
+        self._chunk_bytes = max(0, int(chunk_bytes))
         self._poller: threading.Thread | None = None
         self._stop = threading.Event()
         self.drain_parse_failures = 0  # lost decoded batches (observable)
@@ -167,10 +185,35 @@ class NativeServerTransportImpl(ServerTransport):
         except Exception:
             pass
 
-    def publish_model(self, version: int, bundle_bytes: bytes) -> None:
-        data = _buf(bundle_bytes)
-        self._fn("broadcast")(self._handle, version, data,
-                                      len(bundle_bytes))
+    def publish_model(self, version: int, bundle_bytes: bytes,
+                      handshake_bytes: bytes | None = None) -> None:
+        """Legacy (v1) publishes broadcast AND store ``bundle_bytes`` as
+        the handshake model in one C++ call. Wire-v2 publishes pass the
+        frame as ``bundle_bytes`` plus a full v1 bundle as
+        ``handshake_bytes``: the bundle goes to set_model (handshakes),
+        the frame rides broadcast_frame opaquely (chunked when
+        ``transport.chunk_bytes`` bounds it)."""
+        if handshake_bytes is None:
+            data = _buf(bundle_bytes)
+            self._fn("broadcast")(self._handle, version, data,
+                                  len(bundle_bytes))
+            return
+        hs = _buf(handshake_bytes)
+        self._fn("set_model")(self._handle, version, hs, len(handshake_bytes))
+        if not hasattr(self._lib, "rl_server_broadcast_frame"):
+            # Stale prebuilt .so: broadcast the full bundle instead (the
+            # C++ broadcast would otherwise store the frame as the
+            # handshake model and poison late joiners).
+            data = _buf(handshake_bytes)
+            self._fn("broadcast")(self._handle, version, data,
+                                  len(handshake_bytes))
+            return
+        from relayrl_tpu.transport.modelwire import split_frame
+
+        for part in split_frame(bundle_bytes, self._chunk_bytes, version):
+            data = _buf(part)
+            self._lib.rl_server_broadcast_frame(self._handle, version, data,
+                                                len(part))
 
     def _poll_loop(self) -> None:
         # Two modes, picked at start() by whether the embedder wants the
@@ -399,11 +442,17 @@ class NativeAgentTransportImpl(AgentTransport):
         return [(int(vers[i]), int(ts[i])) for i in range(int(n))]
 
     def _sub_loop(self) -> None:
+        from relayrl_tpu.transport.modelwire import ChunkReassembler
+
         cap = 1 << 20
         buf = (ctypes.c_uint8 * cap)()  # reused; fresh alloc zeroes 1 MiB/poll
         version = ctypes.c_uint64(0)
         rx_ns = ctypes.c_int64(0)
         last_beat = time.monotonic()
+        # Chunked wire-v2 frames (server transport.chunk_bytes) ride the
+        # C++ core as opaque ModelPush payloads; reassemble before
+        # on_model so the embedder always sees whole frames.
+        reasm = ChunkReassembler()
         while not self._stop.is_set():
             n = self._lib.rl_sub_next(self._sub, 200, ctypes.byref(version),
                                       ctypes.byref(rx_ns), buf, cap)
@@ -434,9 +483,12 @@ class NativeAgentTransportImpl(AgentTransport):
             # rx_ns is the C++ reader's frame-parse stamp (the ledger
             # truth); deliver_seconds measures the Python-side handoff
             # from there through the swap.
-            self._m["model_recv_total"].inc()
             self._m["model_recv_bytes"].inc(int(n))
-            self.on_model(int(version.value), ctypes.string_at(buf, int(n)))
+            blob = reasm.feed(ctypes.string_at(buf, int(n)))
+            if blob is None:
+                continue  # mid-chunk: deliver on the final part
+            self._m["model_recv_total"].inc()
+            self.on_model(int(version.value), blob)
             self._m["model_deliver_seconds"].observe(
                 max(0.0, (time.monotonic_ns() - int(rx_ns.value)) / 1e9))
 
@@ -465,6 +517,12 @@ class NativeGrpcServerTransportImpl(NativeServerTransportImpl):
 
     PREFIX = "rl_grpc_server"
 
+    # The C++ ClientPoll serves the stored model to every subscriber and
+    # cannot pick delta-vs-full per known version: wire-v2 frames would
+    # be encoded, paid for, and then discarded. The embedding server
+    # reads this and skips the encoder entirely on this plane.
+    serves_full_bundles_only = True
+
     def __init__(self, lib_path: str, bind_addr: str,
                  idle_timeout_s: float = 30.0):
         super().__init__(lib_path, bind_addr, idle_timeout_s=idle_timeout_s)
@@ -478,3 +536,14 @@ class NativeGrpcServerTransportImpl(NativeServerTransportImpl):
         # tests/embedders tune the long-poll window after construction
         self._idle_timeout_ms = int(value * 1000)
         self._fn("set_idle_timeout")(self._handle, self._idle_timeout_ms)
+
+    def publish_model(self, version: int, bundle_bytes: bytes,
+                      handshake_bytes: bytes | None = None) -> None:
+        """The native gRPC plane serves ClientPoll long-polls from the
+        C++ stored model, which cannot pick delta-vs-full per subscriber
+        — so this plane stays full-bundle: a wire-v2 publish stores and
+        wakes pollers with the v1 ``handshake_bytes`` (agents decode it
+        through the same sniffing path)."""
+        blob = handshake_bytes if handshake_bytes is not None else bundle_bytes
+        data = _buf(blob)
+        self._fn("broadcast")(self._handle, version, data, len(blob))

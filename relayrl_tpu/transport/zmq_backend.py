@@ -68,12 +68,16 @@ class ZmqServerTransport(ServerTransport):
     """ROUTER handshake + PULL trajectory ingest + PUB model broadcast."""
 
     def __init__(self, agent_listener_addr: str, trajectory_addr: str,
-                 model_pub_addr: str):
+                 model_pub_addr: str, chunk_bytes: int = 0):
         super().__init__()
         self._addrs = (agent_listener_addr, trajectory_addr, model_pub_addr)
         self._ctx: zmq.Context | None = None
         self._pub: zmq.Socket | None = None
         self._pub_lock = threading.Lock()
+        # transport.chunk_bytes: broadcast frames above this size are
+        # split into ordered chunk frames (modelwire.split_frame) so the
+        # PUB socket's HWM accounting sees bounded messages; 0 = off.
+        self._chunk_bytes = max(0, int(chunk_bytes))
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._m = server_wire_metrics("zmq")
@@ -105,16 +109,25 @@ class ZmqServerTransport(ServerTransport):
     def publish_model(self, version: int, bundle_bytes: bytes) -> None:
         if self._pub is None:
             raise RuntimeError("transport not started")
+        from relayrl_tpu.transport.modelwire import split_frame
+
         # The publisher's monotonic stamp rides the frame so every SUB
         # thread on this host can compute publish→receipt latency
         # locally (the telemetry answer to the soak bench's fan-out
         # methodology; cross-host stamps don't pair and are ignored).
-        frame = pack_model_frame(version, bundle_bytes,
-                                 pub_ns=time.monotonic_ns())
+        # A model blob over chunk_bytes ships as ordered chunk frames
+        # under ONE lock hold, so no other publish can interleave; the
+        # agent-side ChunkReassembler restores the original frame.
+        parts = split_frame(bundle_bytes, self._chunk_bytes, version)
+        sent = 0
         with self._pub_lock:
-            self._pub.send_multipart([MODEL_TOPIC, frame])
+            for part in parts:
+                frame = pack_model_frame(version, part,
+                                         pub_ns=time.monotonic_ns())
+                self._pub.send_multipart([MODEL_TOPIC, frame])
+                sent += len(frame)
         self._m["publish_total"].inc()
-        self._m["publish_bytes"].inc(len(frame))
+        self._m["publish_bytes"].inc(sent)
 
     # -- loops --
     def _listener_loop(self, addr: str) -> None:
@@ -203,6 +216,12 @@ class ZmqAgentTransport(AgentTransport):
         # runs — so fan-out accounting measures the wire, not the Python
         # decode backlog behind it (benches/README.md zmq 64-actor note).
         self._ledger = ReceiptLedger()
+        # Chunked model frames (server transport.chunk_bytes) reassemble
+        # here before the ledger stamp / on_model, so one publish is one
+        # receipt no matter how many wire messages carried it.
+        from relayrl_tpu.transport.modelwire import ChunkReassembler
+
+        self._reasm = ChunkReassembler()
 
     @property
     def identity(self) -> str:
@@ -296,9 +315,12 @@ class ZmqAgentTransport(AgentTransport):
                 version, bundle, pub_ns = unpack_model_frame_ex(frames[1])
             except Exception:
                 continue
+            self._m["model_recv_bytes"].inc(len(frames[1]))
+            bundle = self._reasm.feed(bundle)
+            if bundle is None:
+                continue  # mid-chunk: the receipt stamps on the last part
             self._ledger.append(version, rx_ns)
             self._m["model_recv_total"].inc()
-            self._m["model_recv_bytes"].inc(len(frames[1]))
             if pub_ns is not None and 0 <= rx_ns - pub_ns < int(300e9):
                 # Same-host monotonic pair only. CLOCK_MONOTONIC is
                 # per-boot, so a cross-host pair is off by the uptime

@@ -20,6 +20,7 @@ code and weights; JAX params are data-only, so the TPU-native bundle ships
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Mapping
 
 import msgpack
@@ -27,11 +28,26 @@ import msgpack
 WIRE_VERSION = 1
 
 
+class _RawTreeSentinel:
+    """Explicit opt-in for the no-template decode path (see
+    :meth:`ModelBundle.from_bytes`)."""
+
+    def __repr__(self) -> str:  # pragma: no cover - repr cosmetics
+        return "ModelBundle.RAW_TREE"
+
+
 @dataclasses.dataclass
 class ModelBundle:
     version: int
     arch: dict[str, Any]
     params: Any  # parameter pytree
+
+    # Pass as ``params_template`` to explicitly request the raw
+    # nested-dict restore (no custom pytree node types) without the
+    # fallback warning — the hot-path choice for pure apply fns, which
+    # only ever index nested dicts. Deliberately NOT annotated: an
+    # annotated class attribute would become a dataclass field.
+    RAW_TREE = _RawTreeSentinel()
 
     def to_bytes(self) -> bytes:
         from flax import serialization
@@ -49,9 +65,16 @@ class ModelBundle:
         """Decode a bundle.
 
         ``params_template`` — when given, params are restored *into* this
-        pytree structure (flax ``from_bytes``), preserving custom node types;
-        otherwise they come back as nested dicts of numpy arrays, which is
-        exactly what a pure apply fn needs.
+        pytree structure (flax ``from_bytes``), preserving custom node
+        types (FrozenDict, dataclass nodes, ...).
+
+        Without a template the restore is structural only: params come
+        back as plain nested dicts of numpy arrays. That is exactly what
+        a pure apply fn needs, but it silently DROPS any custom pytree
+        node types the serialized tree had — so the fallback is explicit
+        here: passing ``params_template=None`` warns once per call site,
+        and callers that want the raw-dict restore on purpose pass
+        ``params_template=ModelBundle.RAW_TREE``.
         """
         from flax import serialization
 
@@ -59,10 +82,20 @@ class ModelBundle:
         if wire.get("v") != WIRE_VERSION:
             raise ValueError(f"unsupported model bundle version: {wire.get('v')}")
         raw = wire["params"]
-        if params_template is not None:
-            params = serialization.from_bytes(params_template, raw)
-        else:
+        if params_template is None:
+            warnings.warn(
+                "ModelBundle.from_bytes without params_template restores "
+                "params as plain nested dicts — custom pytree node types "
+                "are not reconstructed. Pass the live params tree as "
+                "params_template to preserve them, or "
+                "params_template=ModelBundle.RAW_TREE to opt into the "
+                "raw-dict restore explicitly.",
+                stacklevel=2)
             params = serialization.msgpack_restore(raw)
+        elif params_template is cls.RAW_TREE:
+            params = serialization.msgpack_restore(raw)
+        else:
+            params = serialization.from_bytes(params_template, raw)
         return cls(version=int(wire["ver"]), arch=dict(wire["arch"]), params=params)
 
     # -- file helpers (the reference's server reads model bytes off disk to
@@ -97,6 +130,81 @@ def exploration_kwargs(arch: Mapping[str, Any]) -> dict[str, Any]:
 
     return {k: jnp.float32(arch[k]) for k in EXPLORATION_ARCH_KEYS
             if k in arch}
+
+
+# -- leaf manifest + template-driven assembly (model-wire v2) ---------------
+# The wire format ships params as a flat sequence of leaf payloads; the
+# manifest [(path, dtype, shape), ...] is the schema both ends agree on
+# (hashed into every delta frame). Flatten order is jax's deterministic
+# tree_flatten order, so publisher and subscriber derive identical
+# manifests from isomorphic trees.
+
+def _path_key(entry) -> str:
+    """One jax KeyEntry -> a STRING key. Always a string, matching the
+    flax state-dict convention (``to_state_dict`` renders sequence nodes
+    as ``{'0': ...}`` dicts): a publisher flattening the live tree
+    (SequenceKey idx 0) and a subscriber seeded from a restored v1
+    bundle (DictKey '0') must derive the SAME manifest hash, or every
+    delta resyncs forever on trees containing list/tuple nodes."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def leaf_manifest(params: Any) -> tuple[list[list], list]:
+    """Flatten a params pytree into ``(manifest, leaves)``:
+    ``manifest[i] = [path_keys, dtype_str, shape]`` and ``leaves[i]`` the
+    matching C-contiguous host array."""
+    import jax
+    import numpy as np
+
+    paths_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    manifest, leaves = [], []
+    for path, leaf in paths_leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        manifest.append([[_path_key(k) for k in path],
+                         str(arr.dtype), list(arr.shape)])
+        leaves.append(arr)
+    return manifest, leaves
+
+
+def tree_from_leaves(manifest: list, leaves: list,
+                     params_template: Any | None = None) -> Any:
+    """Assemble ``leaves`` back into a params pytree.
+
+    With ``params_template`` the assembly is template-driven: leaves are
+    matched to the template's own flatten paths and unflattened with its
+    treedef, preserving custom node types. Without one the result is
+    plain nested dicts keyed by the manifest paths — the same structural
+    restore ``ModelBundle.from_bytes`` does without a template (apply
+    fns only ever index nested dicts, so this is the actor default).
+    """
+    if params_template is not None:
+        import jax
+
+        tpl_paths, treedef = jax.tree_util.tree_flatten_with_path(
+            params_template)
+        by_path = {tuple(entry[0]): leaf
+                   for entry, leaf in zip(manifest, leaves)}
+        ordered = []
+        for path, _tpl_leaf in tpl_paths:
+            key = tuple(_path_key(k) for k in path)
+            if key not in by_path:
+                raise ValueError(
+                    f"params_template has leaf {key} absent from the wire "
+                    f"manifest — template and published tree diverge")
+            ordered.append(by_path[key])
+        return jax.tree_util.tree_unflatten(treedef, ordered)
+    root: dict = {}
+    for (path, _dtype, _shape), leaf in zip(manifest, leaves):
+        if not path:
+            return leaf  # single-leaf tree (bare array params)
+        node = root
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = leaf
+    return root
 
 
 def arch_equal(a: Mapping[str, Any], b: Mapping[str, Any]) -> bool:

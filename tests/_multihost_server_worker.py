@@ -211,8 +211,10 @@ def drive_fleet(server, phase_ports, target_updates, tag):
         exploration_kwargs,
     )
 
-    with server._bundle_lock:
-        bundle = ModelBundle.from_bytes(server._bundle_bytes)
+    # _get_model (not the raw attribute): wire-v2 servers serialize the
+    # v1 bundle bytes lazily, so the attribute may lag the live model.
+    bundle = ModelBundle.from_bytes(server._get_model()[1],
+                                    params_template=ModelBundle.RAW_TREE)
     policy = build_policy(bundle.arch)
     explore = exploration_kwargs(bundle.arch)
     obs = np.zeros(3, np.float32)

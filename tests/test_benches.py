@@ -121,3 +121,57 @@ def test_bench_telemetry_quick_asserts_hotpath_cost(tmp_path):
             "histogram_observe_enabled"} <= set(ops)
     assert all(r["ns_per_op"] > 0 for r in ops.values())
     assert any(r["bench"] == "telemetry_snapshot" for r in lines)
+
+
+def test_bench_model_wire_quick_smoke(tmp_path):
+    """Model-wire v2 bench (--quick): bytes rows with sane ratios, the
+    RLHF-style fine-tune scenario beating full-train, and latency rows
+    for both wire versions on the live zmq pair."""
+    lines = _run_bench("bench_model_wire.py", tmp_path, timeout=420)
+    bytes_rows = [r for r in lines if r["bench"] == "model_wire_bytes"]
+    assert bytes_rows, "no bytes rows emitted"
+    for r in bytes_rows:
+        assert r["delta_reduction_x"] >= 1.0
+        assert r["keyframe_bytes"] > 0
+        assert r["v1_bytes_per_publish"] > r["delta_bytes_mean"] or \
+            r["delta_reduction_x"] >= 0.99
+        assert r["encode_ms_mean"] > 0 and r["decode_apply_ms_mean"] > 0
+    finetune = [r for r in bytes_rows
+                if r["config"]["scenario"].startswith("rlhf_finetune")]
+    full = [r for r in bytes_rows
+            if "train" in r["config"]["scenario"]
+            and not r["config"]["scenario"].startswith("rlhf")]
+    assert finetune and full
+    # The per-leaf skip must show up: frozen-trunk deltas beat the best
+    # full-train row.
+    assert (max(r["delta_reduction_x"] for r in finetune)
+            > min(r["delta_reduction_x"] for r in full))
+    lat = {r["config"]["wire_version"]: r for r in lines
+           if r["bench"] == "model_wire_latency"
+           and r["config"].get("wire_policy") == "auto"}
+    assert {1, 2} <= set(lat)
+    assert lat[2]["publish_to_swap_ms_p50"] > 0
+    # v2 rows carry the wire counters in the /snapshot schema (the
+    # soak-row convention).
+    snap = lat[2]["telemetry"]
+    assert snap["schema"] == "relayrl-telemetry-v1"
+    names = {m["name"] for m in snap["metrics"]}
+    assert "relayrl_wire_publish_bytes_total" in names
+
+
+def test_committed_results_all_parse_with_shared_loader():
+    """Satellite (ISSUE 5): every committed benches/results/*.json file
+    parses through common.load_results — the one reader for both the
+    NDJSON and single-document shapes (a plain json.load fails on the
+    NDJSON ones; see benches/README.md "results format")."""
+    sys.path.insert(0, str(BENCH_DIR))
+    try:
+        from common import load_results
+    finally:
+        sys.path.pop(0)
+    results = sorted((BENCH_DIR / "results").glob("*.json"))
+    assert results, "no committed results found"
+    for path in results:
+        rows = load_results(path)
+        assert isinstance(rows, list) and rows, path.name
+        assert all(isinstance(r, (dict, list)) for r in rows), path.name

@@ -1,0 +1,636 @@
+"""Model wire-format v2: delta frames, keyframes, resync, zero-copy swap.
+
+The contract under test (ISSUE 5 acceptance): an actor fed v2 frames —
+deltas, a forced keyframe, and a forced resync after a dropped frame —
+holds params BYTE-IDENTICAL to the v1 full-bundle path, on all three
+transports. Delta encode/apply runs in the integer domain (zigzag of the
+storage-word difference), so equality is exact by construction; these
+tests pin it, plus the framing/codec/chunking machinery around it.
+"""
+
+import time
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.runtime.agent import Agent
+from relayrl_tpu.runtime.policy_actor import PolicyActor, apply_wire_swap
+from relayrl_tpu.runtime.vector_actor import VectorActorHost
+from relayrl_tpu.transport import make_server_transport, modelwire as mw
+from relayrl_tpu.types.model_bundle import (
+    ModelBundle,
+    leaf_manifest,
+    tree_from_leaves,
+)
+
+from _util import free_port as _free_port  # noqa: E402
+
+ARCH = {"kind": "mlp_discrete", "obs_dim": 4, "act_dim": 2,
+        "hidden_sizes": [8]}
+
+
+def _params(seed=0, scale=0.05):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": (rng.standard_normal((16, 32)) * scale)
+                  .astype(np.float32),
+                  "bias": np.zeros(32, np.float32)},
+        "head": {"kernel": (rng.standard_normal((32, 4)) * scale)
+                 .astype(np.float32)},
+        "counts": rng.integers(0, 100, 7).astype(np.int32),
+        "table": rng.integers(0, 255, (5, 5)).astype(np.uint8),
+    }
+
+
+def _step(params, seed, eps=3e-4, only=None):
+    """A realistic consecutive update: small dense perturbation of the
+    float leaves (``only`` restricts to a dotted-path subset — the
+    frozen-trunk shape); integer leaves stay put. Works on any pytree
+    (the real MLP params in the actor tests, the fixture dict here)."""
+    rng = np.random.default_rng(seed)
+
+    def bump(path, leaf):
+        leaf = np.asarray(leaf)
+        key = ".".join(
+            str(getattr(k, "key",
+                        getattr(k, "name", getattr(k, "idx", k))))
+            for k in path)
+        if leaf.dtype.kind != "f" or (only is not None and key not in only):
+            return leaf
+        return (leaf + eps * rng.standard_normal(leaf.shape)).astype(
+            leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(bump, params)
+
+
+def _assert_tree_bytes_equal(a, b, msg=""):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), msg
+    for x, y in zip(la, lb):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), msg
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestFraming:
+    def test_delta_roundtrip_bit_identical_across_dtypes(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        dec = mw.ModelWireDecoder()
+        cur = _params()
+        history = []
+        for v in range(1, 6):
+            frame, info = enc.encode(v, ARCH, cur)
+            history.append((v, frame, jax.tree.map(np.copy, cur),
+                            info["kind"]))
+            cur = _step(cur, seed=v)
+        kinds = [k for *_rest, k in history]
+        assert kinds[0] == "keyframe" and set(kinds[1:]) == {"delta"}
+        for v, frame, want, _kind in history:
+            out = dec.decode(frame)
+            assert out is not None
+            ver, arch, tree = out
+            assert ver == v and arch == ARCH
+            _assert_tree_bytes_equal(tree, want, f"version {v}")
+
+    def test_unchanged_leaves_skipped_and_identical_publish_tiny(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        p = _params()
+        enc.encode(1, ARCH, p)
+        frame, info = enc.encode(2, ARCH, p)  # nothing changed
+        assert info["kind"] == "delta"
+        _kind, hdr, _payload = mw.parse_frame(frame)
+        assert hdr["leaves"] == []
+        assert info["frame_bytes"] < 1024
+
+        # A partial update ships only the touched leaves.
+        q = _step(p, seed=9, only={"head.kernel"})
+        frame, _ = enc.encode(3, ARCH, q)
+        _kind, hdr, _payload = mw.parse_frame(frame)
+        manifest, _ = leaf_manifest(p)
+        touched = {tuple(manifest[idx][0]) for idx, _enc, _n in hdr["leaves"]}
+        assert touched == {("head", "kernel")}
+
+    def test_keyframe_interval_and_force(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=3, small_model_bytes=0)
+        cur = _params()
+        kinds = []
+        for v in range(1, 8):
+            _frame, info = enc.encode(v, ARCH, cur)
+            kinds.append(info["kind"])
+            cur = _step(cur, seed=v)
+        assert kinds == ["keyframe", "delta", "delta",
+                         "keyframe", "delta", "delta", "keyframe"]
+        enc.force_keyframe()
+        _frame, info = enc.encode(8, ARCH, cur)
+        assert info["kind"] == "keyframe"
+
+    def test_manifest_change_forces_keyframe(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        p = _params()
+        enc.encode(1, ARCH, p)
+        grown = dict(p, extra=np.ones(3, np.float32))
+        _frame, info = enc.encode(2, ARCH, grown)
+        assert info["kind"] == "keyframe"
+
+    def test_crc_corruption_rejected_without_state_damage(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        dec = mw.ModelWireDecoder()
+        p = _params()
+        f1, _ = enc.encode(1, ARCH, p)
+        q = _step(p, seed=1)
+        f2, _ = enc.encode(2, ARCH, q)
+        dec.decode(f1)
+        corrupt = bytearray(f2)
+        corrupt[-1] ^= 0xFF  # payload byte flip
+        with pytest.raises(mw.WireFrameError):
+            dec.decode(bytes(corrupt))
+        assert dec.version == 1  # state not advanced
+        out = dec.decode(f2)  # the pristine frame still applies
+        assert out is not None and out[0] == 2
+        _assert_tree_bytes_equal(out[2], q)
+
+    def test_base_mismatch_raises_once_then_blacks_out_until_keyframe(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        dec = mw.ModelWireDecoder()
+        cur = _params()
+        frames = []
+        for v in range(1, 6):
+            frames.append(enc.encode(v, ARCH, cur)[0])
+            cur = _step(cur, seed=v)
+        enc.force_keyframe()
+        key_frame, info = enc.encode(6, ARCH, cur)
+        assert info["kind"] == "keyframe"
+        dec.decode(frames[0])
+        dec.decode(frames[1])
+        # frames[2] (v3) dropped on the wire: v4's base=3 mismatches
+        with pytest.raises(mw.WireBaseMismatch) as ei:
+            dec.decode(frames[3])
+        assert ei.value.base == 3 and ei.value.held == 2
+        # further deltas are dropped SILENTLY (no exception spam)
+        assert dec.decode(frames[4]) is None
+        assert dec.awaiting_keyframe and dec.resyncs == 1
+        out = dec.decode(key_frame)
+        assert out is not None and out[0] == 6
+        _assert_tree_bytes_equal(out[2], cur)
+        assert not dec.awaiting_keyframe
+
+    def test_stale_duplicate_frames_dropped(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        dec = mw.ModelWireDecoder()
+        p = _params()
+        f1, _ = enc.encode(1, ARCH, p)
+        f2, _ = enc.encode(2, ARCH, _step(p, seed=1))
+        assert dec.decode(f1) is not None
+        assert dec.decode(f2) is not None
+        assert dec.decode(f1) is None  # re-delivery: stale
+        assert dec.decode(f2) is None
+        assert dec.version == 2
+
+    def test_codec_rides_header_and_incompressible_skip(self):
+        # Low-lr float deltas compress; the codec id lands in the header.
+        enc = mw.ModelWireEncoder(keyframe_interval=100, compress="auto",
+                                 small_model_bytes=0)
+        big = {"w": np.zeros((64, 1024), np.float32)}
+        enc.encode(1, ARCH, big)
+        frame, _ = enc.encode(2, ARCH, _step(big, seed=1, eps=1e-6))
+        _kind, hdr, _p = mw.parse_frame(frame)
+        assert hdr["codec"] != mw.CODEC_RAW
+        # Incompressible random bytes skip compression entirely.
+        rng = np.random.default_rng(0)
+        noisy = {"t": rng.integers(0, 255, 400_000).astype(np.uint8)}
+        enc2 = mw.ModelWireEncoder(keyframe_interval=100, compress="auto",
+                                 small_model_bytes=0)
+        enc2.encode(1, ARCH, noisy)
+        frame, _ = enc2.encode(
+            2, ARCH, {"t": rng.integers(0, 255, 400_000).astype(np.uint8)})
+        _kind, hdr, _p = mw.parse_frame(frame)
+        assert hdr["codec"] == mw.CODEC_RAW
+
+    def test_compress_off_knob(self):
+        enc = mw.ModelWireEncoder(keyframe_interval=100, compress=False,
+                                 small_model_bytes=0)
+        frame, _ = enc.encode(1, ARCH, _params())
+        _kind, hdr, _p = mw.parse_frame(frame)
+        assert hdr["codec"] == mw.CODEC_RAW
+
+    def test_v1_bundle_bytes_are_not_wire_frames(self):
+        alg_bytes = ModelBundle(1, ARCH, _params()).to_bytes()
+        assert not mw.is_wire_frame(alg_bytes)
+        frame, _ = mw.ModelWireEncoder(small_model_bytes=0).encode(1, ARCH, _params())
+        assert mw.is_wire_frame(frame)
+
+
+class TestChunking:
+    def test_split_reassemble_roundtrip(self):
+        frame, _ = mw.ModelWireEncoder(compress=False, small_model_bytes=0).encode(
+            1, ARCH, _params())
+        parts = mw.split_frame(frame, 256, version=1)
+        assert len(parts) > 1 and all(mw.is_chunk_frame(p) for p in parts)
+        re = mw.ChunkReassembler()
+        got = [re.feed(p) for p in parts]
+        assert got[:-1] == [None] * (len(parts) - 1)
+        assert got[-1] == frame
+
+    def test_small_frame_not_wrapped(self):
+        assert mw.split_frame(b"tiny", 256, version=1) == [b"tiny"]
+        assert mw.ChunkReassembler().feed(b"tiny") == b"tiny"
+
+    def test_missing_chunk_drops_partial_never_delivers(self):
+        frame, _ = mw.ModelWireEncoder(compress=False, small_model_bytes=0).encode(
+            1, ARCH, _params())
+        parts = mw.split_frame(frame, 256, version=1)
+        re = mw.ChunkReassembler()
+        for p in parts[:2]:
+            assert re.feed(p) is None
+        # chunk 2 lost; chunk 3 arrives out of sequence -> partial dropped
+        assert re.feed(parts[3]) is None
+        assert re.dropped_partials >= 1
+        # a fresh complete run still assembles
+        assert [re.feed(p) for p in parts][-1] == frame
+
+
+class TestActorSwap:
+    def _actor(self, seed=0):
+        from relayrl_tpu.models import build_policy
+
+        policy = build_policy(dict(ARCH))
+        params = jax.device_get(policy.init_params(jax.random.PRNGKey(seed)))
+        bundle = ModelBundle(version=1, arch=dict(ARCH), params=params)
+        return PolicyActor(bundle, seed=seed), params
+
+    def test_wire_swap_matches_v1_path_including_resync(self):
+        """The acceptance scenario at decoder level: >=3 updates with a
+        forced keyframe and a forced resync after a dropped frame — the
+        v2 actor's params stay byte-identical to a v1 full-bundle twin
+        fed the same versions."""
+        actor_v2, params = self._actor()
+        actor_v1, _ = self._actor()
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        enc.encode(1, dict(ARCH), params)  # seed the base at the handshake
+        cur = params
+        versions = {}
+        for v in range(2, 6):
+            cur = _step(cur, seed=v)
+            versions[v] = cur
+        f2 = enc.encode(2, dict(ARCH), versions[2])[0]
+        f3 = enc.encode(3, dict(ARCH), versions[3])[0]  # will be dropped
+        f4 = enc.encode(4, dict(ARCH), versions[4])[0]
+        enc.force_keyframe()
+        f5 = enc.encode(5, dict(ARCH), versions[5])[0]
+
+        assert actor_v2.swap_from_wire(2, f2) is not None
+        with pytest.raises(mw.WireBaseMismatch):
+            actor_v2.swap_from_wire(4, f4)  # f3 never arrived
+        assert actor_v2.version == 2  # still serving the last good model
+        assert actor_v2.swap_from_wire(5, f5) is not None  # keyframe snaps
+        assert actor_v2.version == 5
+
+        v1_bytes = ModelBundle(5, dict(ARCH), versions[5]).to_bytes()
+        actor_v1.swap_from_bytes(v1_bytes)
+        assert actor_v1.version == 5
+        _assert_tree_bytes_equal(actor_v2.params, actor_v1.params)
+        _assert_tree_bytes_equal(actor_v2.params, versions[5])
+
+    def test_transformer_policy_wire_swap_bit_identical(self):
+        """Same scenario for a transformer policy (sequence serving path,
+        positional table, layernorms): deltas + forced keyframe + forced
+        resync, byte-identical to the v1 twin."""
+        from relayrl_tpu.models import build_policy
+
+        t_arch = {"kind": "transformer_discrete", "obs_dim": 6, "act_dim": 3,
+                  "d_model": 16, "n_layers": 1, "n_heads": 2,
+                  "max_seq_len": 32, "has_critic": True}
+        policy = build_policy(dict(t_arch))
+        params = jax.device_get(policy.init_params(jax.random.PRNGKey(0)))
+        bundle = ModelBundle(version=1, arch=dict(t_arch), params=params)
+        v2 = PolicyActor(bundle, seed=0)
+        v1 = PolicyActor(ModelBundle(1, dict(t_arch), params), seed=0)
+
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        enc.encode(1, dict(t_arch), params)
+        cur = params
+        frames = {}
+        for v in range(2, 6):
+            cur = jax.tree.map(
+                lambda x, _v=v: (np.asarray(x) + np.float32(1e-4) *
+                                 np.random.default_rng(_v)
+                                 .standard_normal(np.shape(x))
+                                 .astype(np.float32)).astype(np.float32)
+                if np.asarray(x).dtype == np.float32 else np.asarray(x), cur)
+            if v == 5:
+                enc.force_keyframe()
+            frames[v] = enc.encode(v, dict(t_arch), cur)[0]
+        final = cur
+
+        assert v2.swap_from_wire(2, frames[2]) is not None
+        with pytest.raises(mw.WireBaseMismatch):
+            v2.swap_from_wire(4, frames[4])  # 3 dropped
+        assert v2.swap_from_wire(5, frames[5]) is not None  # keyframe
+        v1.swap_from_bytes(ModelBundle(5, dict(t_arch), final).to_bytes())
+        _assert_tree_bytes_equal(v2.params, v1.params)
+        # The swapped policy still serves.
+        rec = v2.request_for_action(np.zeros(6, np.float32))
+        assert rec.act is not None
+
+    def test_installed_params_isolated_from_decoder_buffers(self):
+        """device_put inside the swap gate must COPY out of the decoder's
+        preallocated buffers: the next delta applies in place, and a
+        swap that aliased them would silently mutate the installed
+        (version-N) params into version-N+1 bytes."""
+        actor, params = self._actor()
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        enc.encode(1, dict(ARCH), params)
+        p2 = _step(params, seed=2)
+        p3 = _step(p2, seed=3)
+        assert actor.swap_from_wire(2, enc.encode(2, dict(ARCH), p2)[0])
+        installed = jax.tree.map(lambda x: np.asarray(x).copy(), actor.params)
+        # Decode v3 WITHOUT swapping (decoder mutates its buffers).
+        actor._wire_decoder.decode(enc.encode(3, dict(ARCH), p3)[0])
+        _assert_tree_bytes_equal(actor.params, installed,
+                                 "delta apply leaked into installed params")
+        _assert_tree_bytes_equal(actor.params, p2)
+
+    def test_v1_delivery_reseeds_decoder_midstream(self):
+        """Mixed fleet: a v1 full bundle arriving between v2 deltas must
+        reset the wire state so later deltas (based on it) apply."""
+        actor, params = self._actor()
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        enc.encode(1, dict(ARCH), params)
+        p2 = _step(params, seed=2)
+        assert actor.swap_from_wire(2, enc.encode(2, dict(ARCH), p2)[0])
+        # v3 arrives as a LEGACY v1 bundle (rolling compat)
+        p3 = _step(p2, seed=3)
+        enc.encode(3, dict(ARCH), p3)  # encoder advances its base too
+        assert actor.swap_from_wire(
+            3, ModelBundle(3, dict(ARCH), p3).to_bytes()) is not None
+        # v4 delta based on v3 applies cleanly post-reseed
+        p4 = _step(p3, seed=4)
+        assert actor.swap_from_wire(4, enc.encode(4, dict(ARCH), p4)[0])
+        _assert_tree_bytes_equal(actor.params, p4)
+
+    def test_vector_host_single_swap_serves_all_lanes(self):
+        from relayrl_tpu.models import build_policy
+
+        policy = build_policy(dict(ARCH))
+        params = jax.device_get(policy.init_params(jax.random.PRNGKey(0)))
+        host = VectorActorHost(ModelBundle(1, dict(ARCH), params),
+                               num_envs=4, seed=0)
+        enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+        enc.encode(1, dict(ARCH), params)
+        p2 = _step(params, seed=2)
+        assert host.swap_from_wire(2, enc.encode(2, dict(ARCH), p2)[0])
+        assert host.version == 2
+        _assert_tree_bytes_equal(host.params, p2)
+        recs = host.request_for_actions(np.zeros((4, 4), np.float32))
+        assert len(recs) == 4
+
+
+class TestManifest:
+    def test_template_assembly_preserves_custom_nodes(self):
+        from flax.core import FrozenDict, freeze
+
+        tree = freeze({"a": {"w": np.ones((2, 2), np.float32)},
+                       "b": np.zeros(3, np.float32)})
+        manifest, leaves = leaf_manifest(tree)
+        plain = tree_from_leaves(manifest, leaves)
+        assert isinstance(plain, dict) and not isinstance(plain, FrozenDict)
+        rebuilt = tree_from_leaves(manifest, leaves, params_template=tree)
+        assert isinstance(rebuilt, FrozenDict)
+        _assert_tree_bytes_equal(rebuilt, tree)
+
+    def test_manifest_matches_across_live_and_restored_trees(self):
+        """The publisher flattens the LIVE params tree (a list node
+        flattens via SequenceKey) while a subscriber may seed from a
+        flax-restored v1 bundle (the state dict renders sequences as
+        {'0': ...} str-key dicts). Path keys are normalized to strings
+        so both derive the SAME manifest hash — a mismatch would make
+        every delta resync forever on such trees."""
+        live = {"layers": [{"w": np.ones((2, 2), np.float32)},
+                           {"w": np.zeros((2, 2), np.float32)}],
+                "head": np.ones(3, np.float32)}
+        buf = ModelBundle(1, dict(ARCH), live).to_bytes()
+        restored = ModelBundle.from_bytes(
+            buf, params_template=ModelBundle.RAW_TREE).params
+        m_live, l_live = leaf_manifest(live)
+        m_rest, l_rest = leaf_manifest(restored)
+        assert mw.manifest_hash(m_live) == mw.manifest_hash(m_rest)
+        for a, b in zip(l_live, l_rest):
+            assert a.tobytes() == b.tobytes()
+
+    def test_manifest_hash_stable_and_layout_sensitive(self):
+        m1, _ = leaf_manifest(_params())
+        m2, _ = leaf_manifest(_params(seed=7))  # values differ, layout same
+        assert mw.manifest_hash(m1) == mw.manifest_hash(m2)
+        grown, _ = leaf_manifest(dict(_params(),
+                                      extra=np.ones(2, np.float32)))
+        assert mw.manifest_hash(grown) != mw.manifest_hash(m1)
+
+
+def _transport_addrs(kind, p1, p2, p3):
+    if kind == "zmq":
+        return ({"agent_listener_addr": f"tcp://127.0.0.1:{p1}",
+                 "trajectory_addr": f"tcp://127.0.0.1:{p2}",
+                 "model_pub_addr": f"tcp://127.0.0.1:{p3}"},
+                {"agent_listener_addr": f"tcp://127.0.0.1:{p1}",
+                 "trajectory_addr": f"tcp://127.0.0.1:{p2}",
+                 "model_sub_addr": f"tcp://127.0.0.1:{p3}"})
+    if kind == "grpc":
+        return ({"bind_addr": f"127.0.0.1:{p1}", "native_grpc": False},
+                {"server_addr": f"127.0.0.1:{p1}"})
+    return ({"bind_addr": f"127.0.0.1:{p1}"},
+            {"server_addr": f"127.0.0.1:{p1}"})
+
+
+@pytest.mark.parametrize("kind", ["zmq", "grpc", "native"])
+def test_e2e_bit_identical_with_keyframe_and_resync(tmp_cwd, kind):
+    """The acceptance scenario over LIVE transports: a REINFORCE-shaped
+    MLP actor driven through v2 deltas, a dropped frame (forced resync),
+    and a forced keyframe ends byte-identical to the v1 full-bundle
+    reference — on zmq (broadcast), grpc (long-poll, server-side
+    delta-vs-full), and the native framed-TCP core (opaque pass-through
+    + handshake bytes)."""
+    if kind == "native":
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C native)")
+    cfg = ConfigLoader(create_if_missing=False)
+    srv_over, ag_over = _transport_addrs(
+        kind, _free_port(), _free_port(), _free_port())
+    from relayrl_tpu.models import build_policy
+
+    policy = build_policy(dict(ARCH))
+    params = jax.device_get(policy.init_params(jax.random.PRNGKey(0)))
+    versions = {1: params}
+    for v in range(2, 7):
+        versions[v] = _step(versions[v - 1], seed=v)
+    enc = mw.ModelWireEncoder(keyframe_interval=100, small_model_bytes=0)
+
+    def v1_bytes(v):
+        return ModelBundle(v, dict(ARCH), versions[v]).to_bytes()
+
+    srv = make_server_transport(kind, cfg, **srv_over)
+    state = {"ver": 1}
+    srv.get_model = lambda: (state["ver"], v1_bytes(state["ver"]))
+    srv.get_model_update = (
+        lambda known: (enc.frame_for(known)
+                       or (state["ver"], v1_bytes(state["ver"]))))
+    srv.start()
+    try:
+        agent = Agent(server_type=kind, handshake_timeout_s=30, seed=0,
+                      model_path=str(tmp_cwd / "client.rlx"), **ag_over)
+        try:
+            assert agent.model_version == 1
+            enc.encode(1, dict(ARCH), versions[1])  # base = handshake model
+
+            def publish_until(v, frame, pred, what):
+                # Re-publish in a loop: a SUB subscription still joining
+                # can miss early broadcasts (repo convention — the blast
+                # pattern in test_model_swap_isolation); re-deliveries of
+                # the same frame are stale-dropped by the decoder.
+                state["ver"] = v
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    if getattr(srv, "needs_handshake_bytes", False):
+                        srv.publish_model(v, frame,
+                                          handshake_bytes=v1_bytes(v))
+                    else:
+                        srv.publish_model(v, frame)
+                    if _wait(pred, timeout=0.5):
+                        return
+                raise AssertionError(f"{kind}: {what}")
+
+            # v2, v3: plain deltas
+            for v in (2, 3):
+                publish_until(v, enc.encode(v, dict(ARCH), versions[v])[0],
+                              lambda _v=v: agent.model_version == _v,
+                              f"never reached version {v}")
+            # v4 is DROPPED: encode (the publisher's base advances) but
+            # never publish — the fleet misses it.
+            enc.encode(4, dict(ARCH), versions[4])
+            state["ver"] = 4
+            # v5 delta has base=4 -> undecodable for the actor at 3:
+            # grpc recovers server-side (full-bundle fallback when the
+            # frame base mismatches the poll's known version); zmq/native
+            # raise WireBaseMismatch and wait for a keyframe.
+            frame5 = enc.encode(5, dict(ARCH), versions[5])[0]
+            if kind == "grpc":
+                publish_until(5, frame5,
+                              lambda: agent.model_version == 5,
+                              "full-bundle resync never converged")
+            else:
+                publish_until(
+                    5, frame5,
+                    lambda: (agent.actor._wire_decoder is not None
+                             and agent.actor._wire_decoder.resyncs >= 1),
+                    "base mismatch never observed")
+                assert agent.model_version == 3  # still on the last good
+            # forced keyframe snaps everyone to 6
+            enc.force_keyframe()
+            publish_until(6, enc.encode(6, dict(ARCH), versions[6])[0],
+                          lambda: agent.model_version == 6,
+                          "keyframe resync never converged")
+
+            ref = ModelBundle.from_bytes(
+                v1_bytes(6), params_template=ModelBundle.RAW_TREE)
+            _assert_tree_bytes_equal(agent.actor.params, ref.params,
+                                     f"{kind}: v2 diverged from v1 bytes")
+            if kind != "grpc":
+                dec = agent.actor._wire_decoder
+                assert dec is not None and dec.resyncs >= 1
+        finally:
+            agent.disable_agent()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("kind", ["zmq", "native"])
+def test_e2e_chunked_keyframe_reassembles(tmp_cwd, kind):
+    """transport.chunk_bytes splits a broadcast frame into many wire
+    messages; the listener reassembles and the swap still lands (and is
+    still byte-identical)."""
+    if kind == "native":
+        from relayrl_tpu.transport.native_backend import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C native)")
+    cfg = ConfigLoader(create_if_missing=False)
+    srv_over, ag_over = _transport_addrs(
+        kind, _free_port(), _free_port(), _free_port())
+    srv_over["chunk_bytes"] = 2048  # force multi-chunk model frames
+    from relayrl_tpu.models import build_policy
+
+    big_arch = dict(ARCH, hidden_sizes=[64, 64])  # ~18 KB of params
+    policy = build_policy(big_arch)
+    params = jax.device_get(policy.init_params(jax.random.PRNGKey(0)))
+    p2 = _step(params, seed=2)
+    enc = mw.ModelWireEncoder(keyframe_interval=100, compress=False,
+                                 small_model_bytes=0)
+
+    srv = make_server_transport(kind, cfg, **srv_over)
+    srv.get_model = lambda: (1, ModelBundle(1, big_arch, params).to_bytes())
+    srv.start()
+    try:
+        agent = Agent(server_type=kind, handshake_timeout_s=30, seed=0,
+                      model_path=str(tmp_cwd / "client.rlx"), **ag_over)
+        try:
+            enc.encode(1, big_arch, params)
+            enc.force_keyframe()
+            frame = enc.encode(2, big_arch, p2)[0]
+            assert len(frame) > 4 * 2048  # really exercises chunking
+            hs = ModelBundle(2, big_arch, p2).to_bytes()
+            deadline = time.monotonic() + 20
+            while agent.model_version != 2:
+                assert time.monotonic() < deadline, \
+                    f"{kind}: chunked keyframe never installed"
+                if getattr(srv, "needs_handshake_bytes", False):
+                    srv.publish_model(2, frame, handshake_bytes=hs)
+                else:
+                    srv.publish_model(2, frame)
+                _wait(lambda: agent.model_version == 2, timeout=0.5)
+            _assert_tree_bytes_equal(agent.actor.params, p2)
+        finally:
+            agent.disable_agent()
+    finally:
+        srv.stop()
+
+
+class TestBundleFallback:
+    """Satellite: the no-template ModelBundle.from_bytes fallback is
+    explicit — warns, and RAW_TREE opts in silently."""
+
+    def test_no_template_warns_and_restores_plain_dicts(self):
+        buf = ModelBundle(3, dict(ARCH), _params()).to_bytes()
+        with warnings.catch_warnings(record=True) as got:
+            warnings.simplefilter("always")
+            out = ModelBundle.from_bytes(buf)
+        assert any("params_template" in str(w.message) for w in got)
+        assert isinstance(out.params, dict)
+
+    def test_raw_tree_sentinel_is_silent(self):
+        buf = ModelBundle(3, dict(ARCH), _params()).to_bytes()
+        with warnings.catch_warnings(record=True) as got:
+            warnings.simplefilter("always")
+            out = ModelBundle.from_bytes(
+                buf, params_template=ModelBundle.RAW_TREE)
+        assert not [w for w in got if "params_template" in str(w.message)]
+        _assert_tree_bytes_equal(out.params, _params())
+
+    def test_template_roundtrip_preserves_custom_nodes(self):
+        from flax.core import FrozenDict, freeze
+
+        tree = freeze({"a": {"w": np.ones((2, 2), np.float32)}})
+        buf = ModelBundle(1, dict(ARCH), tree).to_bytes()
+        out = ModelBundle.from_bytes(buf, params_template=tree)
+        assert isinstance(out.params, FrozenDict)
+        _assert_tree_bytes_equal(out.params, tree)
