@@ -34,7 +34,10 @@ def transport_addr_overrides(cfg: dict) -> dict:
     """cfg → the agent-side address kwargs for its server_type (shared by
     both fleet modes so a new transport's keys exist in one place)."""
     if cfg.get("server_type", "zmq") in ("native", "grpc"):
-        return {"server_addr": cfg["server_addr"]}
+        overrides = {"server_addr": cfg["server_addr"]}
+        if "heartbeat_s" in cfg:  # chaos runs tighten the heal cadence
+            overrides["heartbeat_s"] = cfg["heartbeat_s"]
+        return overrides
     return {
         "agent_listener_addr": cfg["agent_listener_addr"],
         "trajectory_addr": cfg["trajectory_addr"],
@@ -95,6 +98,50 @@ def drain_receipt_grace(transport, receipts: list, has_ledger: bool,
         time.sleep(0.2)
 
 
+def chaos_setup(cfg: dict) -> None:
+    """Chaos-mode worker plumbing (bench_soak --chaos): install the
+    fault plan via the env hook BEFORE any Agent is constructed, and a
+    fresh telemetry registry so the worker's result row can embed its
+    injected-fault / retry / spool counters."""
+    if cfg.get("fault_plan"):
+        from relayrl_tpu import faults
+
+        os.environ[faults.ENV_VAR] = cfg["fault_plan"]
+    if cfg.get("chaos_telemetry"):
+        from relayrl_tpu import telemetry
+
+        telemetry.set_registry(telemetry.Registry(
+            run_id=f"chaos-worker-{cfg['worker_id']}"))
+
+
+def chaos_finish(agent, row: dict, cfg: dict) -> None:
+    """End-of-window chaos accounting for one agent row: final spool
+    flush (a full replay pass — the at-least-once guarantee the server's
+    dedup turns into exactly-once) and the per-agent sent-seq counts the
+    coordinator reconciles against the server ledger."""
+    spool = getattr(agent, "spool", None)
+    if spool is None:
+        return
+    if cfg.get("final_replay"):
+        # Convergence phase: injection STOPS (the chaos contract — the
+        # measured window abused the system; now it must heal), then one
+        # full replay pass must land so the coordinator's zero-loss
+        # accounting is about recovery, not about racing a live fault.
+        from relayrl_tpu import faults
+
+        faults.deactivate()
+        row["spool_flushed"] = spool.flush(
+            deadline_s=cfg.get("flush_deadline_s", 45.0))
+        # zmq's PUSH is fire-and-forget: a replay burst still sits in
+        # libzmq's pipe when this thread moves on, and disable_agent's
+        # linger=0 close would drop the tail — give the wire a beat.
+        # (ack'd transports returned only after the server took each
+        # frame, so this is purely the broadcast-plane close race.)
+        time.sleep(cfg.get("flush_linger_s", 2.0))
+    row["sent_counts"] = spool.sent_counts()
+    row["spool_depth"] = spool.depth
+
+
 def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier):
     import numpy as np
 
@@ -104,6 +151,7 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
     addr_overrides = transport_addr_overrides(cfg)
     agent = Agent(
         model_path=os.path.join(cfg["scratch"], f"model_{ident}.msgpack"),
+        config_path=cfg.get("config_path"),
         seed=cfg["worker_id"] * 1000 + agent_idx,
         handshake_timeout_s=cfg["handshake_timeout_s"],
         server_type=cfg.get("server_type", "zmq"),
@@ -150,6 +198,13 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
     # Cross-process start barrier: agent 0 of each worker publishes the
     # readiness file (see start_barrier_wait for the full rationale).
     start_barrier_wait(cfg, ident, publish_ready=agent_idx == 0)
+    from relayrl_tpu import faults
+
+    # actor.step kill site: a plan rule {"site": "actor.step",
+    # "op": "kill_process", "at": N} SIGKILLs this worker at env step N
+    # (the actor crash drill as a plan entry). None without a plan.
+    fault_step = faults.site("actor.step")
+    timeline: dict[int, int] = {}  # wall-second -> env steps (chaos MTTR)
     window_start_ns = time.monotonic_ns()
     deadline = time.time() + cfg["duration_s"]
     crashed = None
@@ -158,10 +213,16 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
             obs = rng.standard_normal(obs_dim).astype(np.float32)
             reward = 0.0
             for _ in range(ep_len):
+                if fault_step is not None and fault_step.take_kill_process():
+                    import signal
+
+                    os.kill(os.getpid(), signal.SIGKILL)
                 agent.request_for_action(obs, reward=reward)
                 obs = rng.standard_normal(obs_dim).astype(np.float32)
                 reward = 1.0
                 steps += 1
+                bucket = int(time.time())
+                timeline[bucket] = timeline.get(bucket, 0) + 1
                 # Deadline check INSIDE the episode: under heavy
                 # oversubscription one 25-step episode can take many
                 # seconds, and finishing it would stretch this agent's
@@ -184,7 +245,7 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         pass
     drain_receipt_grace(agent.transport, receipts, has_ledger,
                         cfg.get("receipt_grace_s", 8.0))
-    out[agent_idx] = {
+    row = {
         "identity": ident,
         "steps": steps,
         "episodes": episodes,
@@ -193,12 +254,15 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
         "sub_ts": sub_ts,
         "window_start_ns": window_start_ns,
         "window_end_ns": window_end_ns,
+        "timeline": {str(k): v for k, v in timeline.items()},
         # Departure stamp: a publish after this agent stopped listening
         # cannot be received, so the bench excludes such pairs from
         # `expected` (fleet teardown is as staggered as bring-up).
         "unsub_ts": time.monotonic_ns(),
         "crashed": crashed,
     }
+    chaos_finish(agent, row, cfg)
+    out[agent_idx] = row
     agent.disable_agent()
 
 
@@ -220,6 +284,7 @@ def vector_host_loop(cfg: dict) -> list[dict]:
     agent = VectorAgent(
         num_envs=n_lanes,
         model_path=os.path.join(cfg["scratch"], f"model_{ident}.msgpack"),
+        config_path=cfg.get("config_path"),
         seed=cfg["worker_id"] * 1000,
         handshake_timeout_s=cfg["handshake_timeout_s"],
         server_type=cfg.get("server_type", "zmq"),
@@ -283,6 +348,9 @@ def vector_host_loop(cfg: dict) -> list[dict]:
             "unsub_ts": unsub_ts,
             "crashed": crashed,
         })
+    # Chaos accounting rides the lane-0 row (ONE spool per connection
+    # covering all lanes — sent_counts is keyed per lane id already).
+    chaos_finish(agent, rows[0], cfg)
     agent.disable_agent()
     return rows
 
@@ -294,6 +362,7 @@ def main():
     #                        diagnostic dumps every thread's traceback
     cfg = json.loads(sys.argv[1])
     os.environ["JAX_PLATFORMS"] = "cpu"
+    chaos_setup(cfg)
 
     if cfg.get("vector"):
         rows = vector_host_loop(cfg)
@@ -318,9 +387,15 @@ def main():
     for t in threads:
         t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"]
                + barrier_s + 120)
+    result = {"worker_id": cfg["worker_id"], "agents": list(out.values())}
+    if cfg.get("chaos_telemetry"):
+        from relayrl_tpu import telemetry
+
+        # the worker-side half of the chaos evidence: injected-fault,
+        # retry, breaker, and spool counters live in THIS process
+        result["telemetry"] = telemetry.get_registry().snapshot()
     with open(cfg["result_path"], "w") as f:
-        json.dump({"worker_id": cfg["worker_id"],
-                   "agents": list(out.values())}, f)
+        json.dump(result, f)
 
 
 if __name__ == "__main__":

@@ -142,12 +142,16 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     # Per-agent trajectory attribution: distinct agent ids the ingest
     # plane actually saw. In vector mode this is the proof that N logical
     # agents multiplexed over one socket still arrive as N attributed
-    # streams (the vector-soak smoke asserts it == actors).
+    # streams (the vector-soak smoke asserts it == actors). Envelope ids
+    # carry the spool's sequence tag on the wire (crash-recovery plane);
+    # strip it the same way the server's ingest funnel does.
+    from relayrl_tpu.transport.base import split_agent_seq
+
     seen_traj_agents: set[str] = set()
     orig_on_traj = server.transport.on_trajectory
 
     def counting_on_traj(agent_id, payload):
-        seen_traj_agents.add(agent_id)
+        seen_traj_agents.add(split_agent_seq(agent_id)[0])
         orig_on_traj(agent_id, payload)
 
     server.transport.on_trajectory = counting_on_traj
@@ -155,7 +159,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         orig_decoded = server.transport.on_trajectory_decoded
 
         def counting_decoded(batch):
-            seen_traj_agents.update(t.agent_id for t in batch)
+            seen_traj_agents.update(split_agent_seq(t.agent_id)[0]
+                                    for t in batch)
             orig_decoded(batch)
 
         server.transport.on_trajectory_decoded = counting_decoded
@@ -701,6 +706,354 @@ def run_churn(n_actors: int = 16, agents_per_proc: int = 4,
     return result
 
 
+def _chaos_fault_plan(seed: int = 7) -> dict:
+    """The standard chaos-soak plan: steady packet-level abuse on both
+    agent-side planes. The learner SIGKILL is driven by the coordinator
+    (run_chaos), not the plan — a plan rule can only kill the process
+    hosting the hook site."""
+    return {
+        "seed": seed,
+        "rules": [
+            {"site": "agent.send", "op": "drop", "prob": 0.02},
+            {"site": "agent.send", "op": "duplicate", "prob": 0.02},
+            {"site": "agent.send", "op": "delay", "prob": 0.02,
+             "delay_s": 0.02},
+            {"site": "agent.model", "op": "drop", "prob": 0.05},
+            {"site": "agent.model", "op": "corrupt", "prob": 0.02},
+        ],
+    }
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _sum_counters(snapshots: list[dict], prefixes: tuple[str, ...]) -> dict:
+    """Aggregate matching counter rows across process snapshots:
+    ``name{labels} -> summed value`` (the cross-process half of the
+    chaos evidence — injected faults and retries live in the workers)."""
+    agg: dict[str, float] = {}
+    for snap in snapshots:
+        for m in snap.get("metrics", []):
+            name = m.get("name", "")
+            if not name.startswith(prefixes):
+                continue
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted((m.get("labels") or {}).items()))
+            key = f"{name}{{{labels}}}" if labels else name
+            agg[key] = agg.get(key, 0) + (m.get("value") or 0)
+    return agg
+
+
+def run_chaos(transport: str = "zmq", n_actors: int = 8,
+              agents_per_proc: int = 4, duration_s: float = 45.0,
+              episode_len: int = 10, obs_dim: int = 8, act_dim: int = 4,
+              traj_per_epoch: int = 8) -> dict:
+    """Chaos soak (ISSUE 6): the fleet trains under a deterministic
+    fault plan (drops/dups/delays/corruption on both agent planes) while
+    the coordinator SIGKILLs the learner a third of the way in and
+    restarts it with resume. Commits MTTR (kill → recovered throughput),
+    per-second throughput timeline, and the zero-loss / zero-dup
+    sequence accounting: after the workers' final spool flush, every
+    sequence each actor assigned must be accepted exactly once by the
+    surviving server line of history, replay surplus landing in the
+    duplicate counter."""
+    scratch = tempfile.mkdtemp(prefix="relayrl_chaos_")
+    if transport in ("native", "grpc"):
+        port = free_port()
+        server_addrs = {"bind_addr": f"127.0.0.1:{port}"}
+        worker_addrs = {"server_type": transport,
+                        "server_addr": f"127.0.0.1:{port}"}
+    else:
+        ports = [free_port() for _ in range(3)]
+        server_addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
+            "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
+            "model_pub_addr": f"tcp://127.0.0.1:{ports[2]}",
+        }
+        worker_addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
+            "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
+            "model_sub_addr": f"tcp://127.0.0.1:{ports[2]}",
+        }
+    plan = _chaos_fault_plan()
+    plan_path = os.path.join(scratch, "fault_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(plan, f)
+    status_path = os.path.join(scratch, "status.json")
+    # Zero-loss needs the spool window to cover every trajectory sent
+    # since the last COMMITTED checkpoint: orbax saves are async, so at
+    # kill time the committed line can lag several versions — for the
+    # drill, size the window to hold the whole run (the runbook's sizing
+    # rule: peak traj rate x (checkpoint interval + commit lag + MTTR)).
+    worker_config = os.path.join(scratch, "worker_config.json")
+    with open(worker_config, "w") as f:
+        json.dump({"actor": {"spool_entries": 16384}}, f)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(_HERE)
+    env["PYTHONPATH"] = repo_root
+
+    def spawn_server(resume: bool) -> subprocess.Popen:
+        cfg = {
+            "algorithm": "REINFORCE", "obs_dim": obs_dim,
+            "act_dim": act_dim,
+            "hyperparams": {"traj_per_epoch": traj_per_epoch,
+                            "hidden_sizes": [32, 32]},
+            "server_type": transport, "scratch": scratch,
+            "checkpoint_every": 2, "resume": resume,
+            "status_path": status_path, **server_addrs,
+        }
+        return subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "_chaos_server.py"),
+             json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+    server = spawn_server(resume=False)
+    t_wait = time.time() + 180
+    while _read_json(status_path) is None and time.time() < t_wait:
+        if server.poll() is not None:
+            out, _ = server.communicate()
+            raise RuntimeError(f"chaos server died at start:\n{out[-3000:]}")
+        time.sleep(0.2)
+    assert _read_json(status_path) is not None, "chaos server never ready"
+
+    n_procs = (n_actors + agents_per_proc - 1) // agents_per_proc
+    procs, result_paths = [], []
+    for w in range(n_procs):
+        n_here = min(agents_per_proc, n_actors - w * agents_per_proc)
+        result_path = os.path.join(scratch, f"worker_{w}.json")
+        result_paths.append(result_path)
+        cfg = {
+            "worker_id": w, "agents_per_proc": n_here,
+            "duration_s": duration_s, "episode_len": episode_len,
+            "obs_dim": obs_dim, "scratch": scratch,
+            "handshake_timeout_s": 180.0,
+            "start_barrier": True, "go_timeout_s": 360.0,
+            "receipt_grace_s": 4.0,
+            "fault_plan": plan_path, "chaos_telemetry": True,
+            "final_replay": True, "config_path": worker_config,
+            "result_path": result_path,
+            **worker_addrs,
+        }
+        if transport == "native":
+            cfg["heartbeat_s"] = 1.0  # tight heal cadence bounds MTTR
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "_soak_worker.py"),
+             json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+
+    ready_deadline = time.time() + 300
+    while time.time() < ready_deadline:
+        if sum(os.path.exists(os.path.join(scratch, f"ready_{w}"))
+               for w in range(n_procs)) == n_procs:
+            break
+        time.sleep(0.1)
+    with open(os.path.join(scratch, "go"), "w") as f:
+        f.write(str(time.time()))
+
+    # Learner-plane sampler: actors here are fully async (a dead learner
+    # does not slow the env loops), so the honest MTTR is the INGEST
+    # plane's — time from kill until the server is accepting
+    # trajectories at its pre-kill rate again. Sampled from the status
+    # file; the counter reset at restart marks the new line of history.
+    import threading as threading_mod
+
+    ingest_samples: list[tuple[float, int]] = []  # (wall, trajectories)
+    sampler_stop = threading_mod.Event()
+
+    def sample_loop() -> None:
+        while not sampler_stop.is_set():
+            s = _read_json(status_path)
+            if s:
+                ingest_samples.append((time.time(),
+                                       int(s["stats"]["trajectories"])))
+            sampler_stop.wait(0.5)
+
+    sampler = threading_mod.Thread(target=sample_loop, daemon=True)
+    sampler.start()
+
+    # The drill: SIGKILL a third of the way into the window, restart
+    # with resume after a short outage.
+    time.sleep(duration_s / 3.0)
+    kill_wall = time.time()
+    server.kill()
+    server.wait(timeout=30)
+    outage_s = 3.0
+    time.sleep(outage_s)
+    server = spawn_server(resume=True)
+    restart_wall = time.time()
+
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=duration_s + 720)
+        outs.append(out)
+    sampler_stop.set()
+    sampler.join(timeout=5)
+
+    agents = []
+    worker_snapshots = []
+    for path, out, p in zip(result_paths, outs, procs):
+        if p.returncode != 0 or not os.path.exists(path):
+            raise RuntimeError(
+                f"chaos worker failed (rc={p.returncode}):\n{out[-3000:]}")
+        with open(path) as f:
+            data = json.load(f)
+        agents.extend(data["agents"])
+        if data.get("telemetry"):
+            worker_snapshots.append(data["telemetry"])
+
+    # Expected per-agent sent counts (spool seq spaces) for the
+    # accounting reconciliation below.
+    sent_counts: dict[str, int] = {}
+    for a in agents:
+        for ident, n in (a.get("sent_counts") or {}).items():
+            sent_counts[ident] = max(sent_counts.get(ident, 0), int(n))
+
+    def accounted(status: dict | None) -> bool:
+        if not status:
+            return False
+        rows = status["accounting"]["agents"]
+        return all(
+            ident in rows and rows[ident]["max_seq"] == n
+            and rows[ident]["contiguous"]
+            for ident, n in sent_counts.items())
+
+    acct_deadline = time.time() + 120
+    status = _read_json(status_path)
+    while time.time() < acct_deadline and not accounted(status):
+        if server.poll() is not None:
+            out, _ = server.communicate()
+            raise RuntimeError(
+                f"restarted chaos server died:\n{out[-3000:]}")
+        time.sleep(0.5)
+        status = _read_json(status_path)
+    import signal as signal_mod
+
+    server.send_signal(signal_mod.SIGTERM)
+    try:
+        server.communicate(timeout=90)
+    except subprocess.TimeoutExpired:
+        server.kill()
+
+    # Actor-plane timeline (context: async actors barely dip — that is
+    # itself a designed property worth committing).
+    timeline: dict[int, int] = {}
+    for a in agents:
+        for bucket, n in (a.get("timeline") or {}).items():
+            timeline[int(bucket)] = timeline.get(int(bucket), 0) + int(n)
+    kill_bucket = int(kill_wall)
+    pre = [timeline.get(b, 0)
+           for b in range(min(timeline) + 2, kill_bucket)] if timeline else []
+    pre_mean = (sum(pre) / len(pre)) if pre else 0.0
+    recovered_from = None
+    if timeline and pre_mean > 0:
+        last = max(timeline)
+        for b in range(kill_bucket, last - 1):
+            window = [timeline.get(x, 0) for x in (b, b + 1, b + 2)]
+            if sum(window) / 3.0 >= 0.6 * pre_mean:
+                recovered_from = b
+                break
+    post = ([timeline.get(b, 0)
+             for b in range(recovered_from, max(timeline) + 1)]
+            if recovered_from is not None else [])
+
+    # Learner-plane MTTR: ingest rate per sample interval; the counter
+    # reset (delta < 0) marks the restarted line of history.
+    rates: list[tuple[float, float]] = []  # (wall, traj/s)
+    for (t0, n0), (t1, n1) in zip(ingest_samples, ingest_samples[1:]):
+        if t1 <= t0:
+            continue
+        delta = n1 - n0
+        if delta < 0:  # restart boundary: the fresh counter's absolute
+            delta = n1  # value is the rate evidence for that interval
+        rates.append((t1, delta / (t1 - t0)))
+    pre_rates = [r for t, r in rates if t < kill_wall]
+    pre_ingest = (sum(pre_rates) / len(pre_rates)) if pre_rates else 0.0
+    mttr_s = None
+    if pre_ingest > 0:
+        for i, (t, _) in enumerate(rates):
+            if t < restart_wall:
+                continue
+            window = [r for _, r in rates[i:i + 3]]
+            if window and sum(window) / len(window) >= 0.5 * pre_ingest:
+                mttr_s = round(t - kill_wall, 1)
+                break
+
+    rows = (status or {}).get("accounting", {}).get("agents", {})
+    zero_loss = accounted(status)
+    result = {
+        "bench": f"chaos_soak_{transport}",
+        "config": {"actors": n_actors, "agents_per_proc": agents_per_proc,
+                   "duration_s": duration_s, "episode_len": episode_len,
+                   "traj_per_epoch": traj_per_epoch,
+                   "outage_s": round(restart_wall - kill_wall, 1),
+                   "fault_plan": plan, "host_cores": os.cpu_count()},
+        "agents_completed": len(agents),
+        "agents_crashed": sum(1 for a in agents if a.get("crashed")),
+        "spool_flushed_all": all(a.get("spool_flushed", True)
+                                 for a in agents),
+        "env_steps_total": sum(a["steps"] for a in agents),
+        # Actor plane: async by design — a dead learner must NOT dent
+        # env throughput (breaker keeps sends non-blocking).
+        "pre_kill_steps_per_s": round(pre_mean, 1),
+        "post_recovery_steps_per_s": (round(sum(post) / len(post), 1)
+                                      if post else None),
+        # Learner plane: the honest MTTR — kill → ingest rate back to
+        # >= 50% of the pre-kill mean (includes the outage itself).
+        "mttr_s": mttr_s,
+        "pre_kill_ingest_traj_per_s": round(pre_ingest, 1),
+        "ingest_rate_timeline": [
+            [round(t - kill_wall, 1), round(r, 1)] for t, r in rates],
+        "timeline_steps_per_s": {str(k): timeline[k]
+                                 for k in sorted(timeline)},
+        "accounting": {
+            "agents": rows,
+            "duplicates_deduped": (status or {}).get(
+                "accounting", {}).get("duplicates"),
+            "sent_totals": sent_counts,
+            "zero_loss": zero_loss,
+            # zero double-training is BY CONSTRUCTION of the ledger
+            # (accepted == max_seq == sent, each seq at most once);
+            # surplus deliveries are visible above as duplicates.
+            "zero_double_train": zero_loss,
+        },
+        "server_stats": (status or {}).get("stats"),
+        "server_version_final": (status or {}).get("version"),
+        # Server-plane snapshot (post-restart line of history) + the
+        # aggregated worker-side fault/retry/spool/breaker counters.
+        "telemetry": (status or {}).get("telemetry"),
+        "worker_fault_counters": _sum_counters(
+            worker_snapshots,
+            ("relayrl_faults_", "relayrl_retry_", "relayrl_spool_",
+             "relayrl_breaker_", "relayrl_transport_swallowed",
+             "relayrl_transport_reconnects")),
+    }
+    return result
+
+
+def _finish_chaos(result: dict, outfile: str | None) -> None:
+    print(json.dumps(result))
+    assert result["agents_crashed"] == 0, "agent thread(s) crashed"
+    assert result["accounting"]["zero_loss"], (
+        "sequence accounting shows loss or double-training")
+    assert result["spool_flushed_all"], "a worker's final flush timed out"
+    assert result["mttr_s"] is not None, "throughput never recovered"
+    faults_fired = sum(
+        v for k, v in result["worker_fault_counters"].items()
+        if k.startswith("relayrl_faults_injected_total"))
+    assert faults_fired > 0, "the chaos row injected no faults"
+    if outfile is not None and "--write" in sys.argv:
+        _write_results(outfile, [result])
+
+
 def _finish(result: dict, outfile: str | None) -> None:
     """Shared SLO asserts + optional committed write for a soak result.
     Pass ``outfile=None`` to defer writing (callers with multiple result
@@ -736,6 +1089,17 @@ def main():
             print("native .so unavailable; build with make -C native",
                   file=sys.stderr)
             return
+    if "--chaos" in sys.argv:
+        # Crash-recovery soak: faults injected per the standard plan +
+        # learner SIGKILL/resume mid-window; commits MTTR and the
+        # zero-loss/zero-dup accounting (ISSUE 6 acceptance row).
+        result = run_chaos(
+            transport=transport,
+            n_actors=4 if quick else 8,
+            agents_per_proc=4,
+            duration_s=20.0 if quick else 45.0)
+        _finish_chaos(result, f"chaos_soak_{transport}.json")
+        return
     if "--churn" in sys.argv:
         if transport != "native":
             print("churn mode needs the native transport (--native)",

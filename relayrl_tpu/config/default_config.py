@@ -169,6 +169,18 @@ DEFAULT_CONFIG: dict = {
         # topology (--num-envs overrides); benches/bench_soak.py's
         # --vector flag is the bench-plane equivalent.
         "host_mode": "process",
+        # -- trajectory spool (runtime/spool.py, crash-recovery plane) --
+        # Outbound trajectories are retained in a bounded window and
+        # replayed on reconnect; the server's sequence-number dedup makes
+        # the replay exactly-once. spool_entries=0 disables the spool
+        # entirely (sends go straight to the transport, untagged — the
+        # pre-recovery wire shape).
+        "spool_entries": 512,
+        "spool_bytes": 67108864,  # 64 MiB retained-payload bound
+        # Directory for the file-backed spool (survives an actor process
+        # crash — the restarted actor replays what the dead one had in
+        # flight). null = in-memory only.
+        "spool_dir": None,
     },
     # -- transport plane (docs/operations.md knob table) --
     "transport": {
@@ -199,6 +211,23 @@ DEFAULT_CONFIG: dict = {
         # native plane passes them through opaquely and Python listeners
         # reassemble). 0 disables chunking.
         "chunk_bytes": 0,
+        # -- unified retry/backoff (transport/retry.py) --
+        # One policy drives every bounded retry loop on the agent side
+        # (handshake, connect, spooled sends): jittered exponential
+        # backoff base*multiplier^k capped at max_delay_s, bounded by
+        # deadline_s per op (max_attempts=0 = deadline-only). The breaker
+        # knobs bound how fast a dead learner trips send paths into
+        # spool-only mode and how often a half-open probe retests it.
+        "retry": {
+            "base_delay_s": 0.05,
+            "max_delay_s": 2.0,
+            "multiplier": 2.0,
+            "jitter": 0.5,
+            "deadline_s": 30.0,
+            "max_attempts": 0,
+            "breaker_threshold": 3,
+            "breaker_reset_s": 2.0,
+        },
     },
     # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
     "telemetry": {
@@ -261,6 +290,11 @@ DEFAULT_CONFIG: dict = {
         # Ingest decode workers feeding the learner thread (the native
         # decoder drops the GIL, so extra workers scale on real cores).
         "ingest_staging_threads": 1,
+        # Idempotent-ingest dedup window (runtime/spool.SequenceLedger):
+        # per-agent out-of-order tolerance for sequence-tagged
+        # trajectories; replays beyond max_seq - window drop as
+        # duplicates. 0 disables dedup (every tagged send trains).
+        "ingest_dedup_window": 4096,
         # multi-host learner bring-up (jax.distributed); single-process when
         # coordinator is null. Env overrides: RELAYRL_COORDINATOR,
         # RELAYRL_NUM_PROCESSES. The per-host rank is deliberately NOT a

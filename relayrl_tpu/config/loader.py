@@ -201,6 +201,19 @@ class ConfigLoader:
             params["num_envs"] = 1
         if params.get("host_mode") not in ("process", "vector"):
             params["host_mode"] = "process"
+        try:
+            # 0 legitimately disables the spool; negatives clamp to 0.
+            params["spool_entries"] = max(0, int(
+                params.get("spool_entries", 512)))
+        except (TypeError, ValueError):
+            params["spool_entries"] = 512
+        try:
+            params["spool_bytes"] = max(1 << 16, int(
+                params.get("spool_bytes", 64 << 20)))
+        except (TypeError, ValueError):
+            params["spool_bytes"] = 64 << 20
+        spool_dir = params.get("spool_dir")
+        params["spool_dir"] = str(spool_dir) if spool_dir else None
         return params
 
     def get_transport_params(self) -> dict[str, Any]:
@@ -232,6 +245,15 @@ class ConfigLoader:
             params["chunk_bytes"] = max(0, int(params.get("chunk_bytes", 0)))
         except (TypeError, ValueError):
             params["chunk_bytes"] = 0
+        # retry: keep the raw (merged) dict — RetryPolicy.from_dict and
+        # retry.breaker_from_config own per-knob validation, so a
+        # malformed knob degrades at the consumer with the same
+        # defaults everywhere.
+        retry = params.get("retry")
+        defaults = dict(DEFAULT_CONFIG["transport"]["retry"])
+        if isinstance(retry, Mapping):
+            defaults.update(retry)
+        params["retry"] = defaults
         return params
 
     def get_telemetry_params(self) -> dict[str, Any]:

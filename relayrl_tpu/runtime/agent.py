@@ -60,6 +60,63 @@ def _deliver_model(actor_host, transport, client_model_path: str, tag: str,
             pass
 
 
+def _bind_spool_impl(owner, name: str) -> None:
+    """Create (first enable) or re-bind (restart) the owner's trajectory
+    spool (runtime/spool.py). Shared by Agent and VectorAgent so the
+    spool lifecycle — survives restart_agent with its seq counters and
+    retained window intact, send_fn re-bound to the fresh transport —
+    exists exactly once. ``actor.spool_entries: 0`` disables the spool
+    (sends go straight to the transport, untagged)."""
+    params = owner.config.get_actor_params()
+    if params["spool_entries"] <= 0:
+        owner.spool = None
+        return
+
+    def send_fn(payload: bytes, tagged_id: str) -> None:
+        owner.transport.send_trajectory(payload, agent_id=tagged_id)
+
+    if owner.spool is None:
+        from relayrl_tpu.runtime.spool import TrajectorySpool
+        from relayrl_tpu.transport.retry import breaker_from_config
+
+        retry_cfg = owner.config.get_transport_params()["retry"]
+        owner.spool = TrajectorySpool(
+            send_fn=send_fn,
+            max_entries=params["spool_entries"],
+            max_bytes=params["spool_bytes"],
+            directory=params["spool_dir"],
+            name=name,
+            breaker=breaker_from_config(f"agent:{name}", retry_cfg),
+        )
+        if params["spool_dir"] and owner.spool.depth:
+            # A prior process life left trajectories in flight (actor
+            # crash drill): replay them now that a transport is live.
+            owner.spool.replay()
+    else:
+        owner.spool.send_fn = send_fn
+
+
+def _handle_reconnect_impl(owner, agent_ids: list[str]) -> None:
+    """Shared transport-heal handler: re-register every logical agent
+    (the server may have reaped them on kernel close — _on_register
+    dedups, so this is idempotent on servers that kept them) and replay
+    the spool window (the server's sequence dedup makes the replay
+    exactly-once). Runs on a transport thread; failures degrade to the
+    next heal rather than killing the listener."""
+    from relayrl_tpu import telemetry
+
+    for agent_id in agent_ids:
+        try:
+            owner.transport.register(agent_id, timeout_s=5.0)
+        except Exception as e:
+            print(f"[Agent] re-register {agent_id!r} after reconnect "
+                  f"failed: {e!r}", flush=True)
+    replayed = owner.spool.replay() if owner.spool is not None else 0
+    telemetry.emit("agent_reconnect",
+                   agent_id=agent_ids[0] if agent_ids else "?",
+                   lanes=len(agent_ids), replayed=replayed)
+
+
 class Agent:
     def __init__(
         self,
@@ -74,9 +131,12 @@ class Agent:
         self.config = ConfigLoader(None, config_path)
         # Actor-process observability: idempotent, so an agent living in
         # the server's process joins the registry the server installed.
-        from relayrl_tpu import telemetry
+        from relayrl_tpu import faults, telemetry
 
         telemetry.configure_from_config(self.config)
+        # Fault plan (chaos drills): env-driven install must precede
+        # transport construction so its hook sites resolve.
+        faults.maybe_install_from_env()
         self.server_type = server_type
         self._addr_overrides = addr_overrides
         self.client_model_path = model_path or self.config.get_client_model_path()
@@ -84,6 +144,7 @@ class Agent:
         self._seed = os.getpid() if seed is None else seed
         self.actor: PolicyActor | None = None
         self.transport = None
+        self.spool = None  # TrajectorySpool, built on first enable
         self.active = False
         if start:
             self.enable_agent()
@@ -109,20 +170,21 @@ class Agent:
             bundle.save(self.client_model_path)
         except OSError:
             pass
+        self._bind_spool()
         if self.actor is None:
             self.actor = PolicyActor(
                 bundle,
                 max_traj_length=self.config.get_max_traj_length(),
-                on_send=lambda payload: self.transport.send_trajectory(payload),
+                on_send=self._send_traj,
                 seed=self._seed,
             )
         else:
             self.actor.maybe_swap(bundle)
-            self.actor.trajectory._on_send = (
-                lambda payload: self.transport.send_trajectory(payload))
+            self.actor.trajectory._on_send = self._send_traj
         if not self.transport.register(self.transport.identity):
             raise RuntimeError("agent registration (MODEL_SET/ID_LOGGED) failed")
         self.transport.on_model = self._on_model
+        self.transport.on_reconnect = self._handle_reconnect
         self.transport.start_model_listener()
         self.active = True
         from relayrl_tpu import telemetry
@@ -130,9 +192,28 @@ class Agent:
         telemetry.emit("agent_register", agent_id=self.transport.identity,
                        version=version, side="agent")
 
+    def _send_traj(self, payload: bytes) -> None:
+        if self.spool is not None:
+            self.spool.send(payload, self.transport.identity)
+        else:  # actor.spool_entries == 0: the pre-recovery direct path
+            self.transport.send_trajectory(payload)
+
+    def _bind_spool(self) -> None:
+        name = self._addr_overrides.get("identity") or "agent"
+        _bind_spool_impl(self, name)
+
+    def _handle_reconnect(self) -> None:
+        _handle_reconnect_impl(self, [self.transport.identity])
+
     def disable_agent(self) -> None:
         if not self.active:
             return
+        if self.spool is not None:
+            # The spool outlives the transport (its retained window and
+            # seq counters survive restart_agent); detach the send hook
+            # so a send while disabled buffers instead of touching a
+            # closed socket.
+            self.spool.send_fn = None
         self.transport.close()
         self.transport = None
         self.active = False
@@ -143,6 +224,10 @@ class Agent:
         self.disable_agent()
         self._addr_overrides.update(addr_overrides)
         self.enable_agent()
+        if self.spool is not None:
+            # An explicit restart exists because something broke: replay
+            # the retained window (dedup makes it exactly-once).
+            self.spool.replay()
         telemetry.emit("agent_reconnect", agent_id=self.transport.identity)
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
@@ -211,9 +296,10 @@ class VectorAgent:
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
-        from relayrl_tpu import telemetry
+        from relayrl_tpu import faults, telemetry
 
         telemetry.configure_from_config(self.config)
+        faults.maybe_install_from_env()
         actor_params = self.config.get_actor_params()
         self.num_envs = int(num_envs if num_envs is not None
                             else actor_params.get("num_envs", 1))
@@ -228,6 +314,7 @@ class VectorAgent:
         self._seed = os.getpid() if seed is None else seed
         self.host = None
         self.transport = None
+        self.spool = None
         self.agent_ids: list[str] = []
         self.active = False
         if start:
@@ -258,6 +345,7 @@ class VectorAgent:
         # vector hosts never collides; the server sees N distinct agents.
         self.agent_ids = [f"{self.transport.identity}.lane{k}"
                           for k in range(self.num_envs)]
+        _bind_spool_impl(self, self._identity or "vector")
         if self.host is None:
             self.host = VectorActorHost(
                 bundle,
@@ -275,6 +363,8 @@ class VectorAgent:
                 raise RuntimeError(
                     f"logical-agent registration failed for {agent_id!r}")
         self.transport.on_model = self._on_model
+        self.transport.on_reconnect = (
+            lambda: _handle_reconnect_impl(self, self.agent_ids))
         self.transport.start_model_listener()
         self.active = True
         from relayrl_tpu import telemetry
@@ -285,13 +375,18 @@ class VectorAgent:
     def disable_agent(self) -> None:
         if not self.active:
             return
+        if self.spool is not None:
+            self.spool.send_fn = None  # see Agent.disable_agent
         self.transport.close()
         self.transport = None
         self.active = False
 
     def _send_lane(self, lane: int, payload: bytes) -> None:
-        self.transport.send_trajectory(payload,
-                                       agent_id=self.agent_ids[lane])
+        if self.spool is not None:
+            self.spool.send(payload, self.agent_ids[lane])
+        else:
+            self.transport.send_trajectory(payload,
+                                           agent_id=self.agent_ids[lane])
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
         # ONE receipt serves all lanes: a single wire-aware swap

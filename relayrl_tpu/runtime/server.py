@@ -38,6 +38,36 @@ from relayrl_tpu.types.columnar import DecodedTrajectory
 from relayrl_tpu.types.trajectory import deserialize_actions
 
 
+class _EventCoalescer:
+    """≤1 journal event per ``min_interval_s`` for burst-prone counters
+    (ingest drops, duplicate replays): the metric counter is the ledger,
+    the journal event is the greppable breadcrumb — one instance per
+    event type, mutated under the owner's lock, with ``flush`` covering
+    the tail of a burst on quiesce paths."""
+
+    def __init__(self, min_interval_s: float = 1.0):
+        self.pending = 0
+        self._last = 0.0
+        self._min = min_interval_s
+
+    def add(self, n: int) -> int | None:
+        """Accumulate; returns the count to emit now, or None while
+        still coalescing. Caller holds the owning lock."""
+        self.pending += n
+        if time.monotonic() - self._last >= self._min:
+            due, self.pending = self.pending, 0
+            self._last = time.monotonic()
+            return due
+        return None
+
+    def flush(self) -> int:
+        """Drain whatever is still coalescing (caller holds the lock)."""
+        due, self.pending = self.pending, 0
+        if due:
+            self._last = time.monotonic()
+        return due
+
+
 class TrainingServer:
     def __init__(
         self,
@@ -88,8 +118,29 @@ class TrainingServer:
             "relayrl_server_dispatch_seconds",
             "learner-thread host work per trajectory: accumulate + "
             "assemble + async update dispatch")
-        self._drop_event_pending = 0
-        self._drop_event_last = 0.0
+        self._m_duplicates = reg.counter(
+            "relayrl_server_duplicate_trajectories_total",
+            "sequence-tagged trajectories dropped by idempotent ingest "
+            "(replays, retry storms, duplicate-injection faults)")
+        self._m_ckpt_failures = reg.counter(
+            "relayrl_server_checkpoint_failures_total",
+            "periodic/final checkpoint saves that raised")
+        self._m_ckpt_consecutive = reg.gauge(
+            "relayrl_server_checkpoint_consecutive_failures",
+            "checkpoint failures since the last successful save "
+            "(alarm when this grows — resume would lose that window)")
+        self._ckpt_consecutive_failures = 0
+        self._drop_events = _EventCoalescer()
+        self._dup_events = _EventCoalescer()
+
+        # Fault-injection plane: the env-driven plan (RELAYRL_FAULT_PLAN)
+        # installs before any hook site resolves; production processes
+        # without the env var get None sites and pay one identity check.
+        from relayrl_tpu import faults
+
+        faults.maybe_install_from_env()
+        self._fault_ingest = faults.site("server.ingest")
+        self._fault_publish = faults.site("server.publish")
 
         # Multi-host bring-up must precede any other JAX use (no-op for the
         # default single-host config; RELAYRL_COORDINATOR etc. override).
@@ -154,6 +205,21 @@ class TrainingServer:
                               self._aux_every)
         self._ckpt_saves = 0
 
+        # Idempotent ingest (runtime/spool.SequenceLedger): sequence-
+        # tagged trajectories are accepted at most once per agent, so
+        # actor replay-on-reconnect can never double-train. The ledger
+        # snapshots to a per-version JSON sidecar next to each
+        # checkpoint and is restored WITH the matching resume, keeping
+        # dedup state consistent with the params line of history.
+        from relayrl_tpu.runtime.spool import SequenceLedger
+
+        try:
+            dedup_window = int(learner_cfg.get("ingest_dedup_window", 4096))
+        except (TypeError, ValueError):
+            dedup_window = 4096
+        self._ingest_ledger = (SequenceLedger(dedup_window)
+                               if dedup_window > 0 else None)
+
         if resume and self._checkpoint_dir:
             # Multi-host: EVERY rank restores the same full state from the
             # shared checkpoint dir BEFORE enable_multihost places it on
@@ -166,6 +232,7 @@ class TrainingServer:
                 restore_algorithm(self.algorithm, self._checkpoint_dir)
                 print(f"[TrainingServer] resumed at version "
                       f"{self.algorithm.version}", flush=True)
+                self._load_ledger_sidecar(self.algorithm.version)
             except FileNotFoundError:
                 print("[TrainingServer] no checkpoint to resume; fresh start",
                       flush=True)
@@ -397,7 +464,15 @@ class TrainingServer:
                         checkpoint_algorithm(self.algorithm,
                                              self._checkpoint_dir, wait=True,
                                              overwrite=True)
+                        self._save_ledger_sidecar(self.algorithm.version)
                     except Exception as e:
+                        self._m_ckpt_failures.inc()
+                        from relayrl_tpu import telemetry
+
+                        telemetry.emit("checkpoint_failed",
+                                       version=self.algorithm.version,
+                                       error=repr(e), consecutive=1,
+                                       dir=str(self._checkpoint_dir))
                         print(f"[TrainingServer] final checkpoint skipped: "
                               f"{e!r}", flush=True)
             finally:
@@ -419,53 +494,106 @@ class TrainingServer:
         watch it to size ingest_staging_threads)."""
         with self._timings_lock:
             self.stats["dropped"] += n
-            self._drop_event_pending += n
             total = self.stats["dropped"]
-            pending = self._drop_event_pending
-            due = time.monotonic() - self._drop_event_last >= 1.0
-            if due:
-                self._drop_event_pending = 0
-                self._drop_event_last = time.monotonic()
+            due = self._drop_events.add(n)
         self._m_dropped.inc(n)
         if due:
-            # Journal marker, coalesced to <=1/s — the counter above is
-            # the ledger; the event is the greppable breadcrumb. The
-            # tail of a burst (accumulated but not yet due) is flushed
-            # by _flush_drop_event on drain/shutdown so a 500-drop
-            # incident never journals as n=1.
             from relayrl_tpu import telemetry
 
-            telemetry.emit("drop", n=pending, total=total)
+            telemetry.emit("drop", n=due, total=total)
 
     def _flush_drop_event(self) -> None:
-        """Emit any drop count still coalescing (quiesce paths: drain
-        success, disable_server) — without this, drops accumulated in
-        the 1-s window after the last emitted event would never reach
+        """Emit any drop/duplicate count still coalescing (quiesce paths:
+        drain success, disable_server) — without this, counts accumulated
+        in the 1-s window after the last emitted event would never reach
         the journal."""
         with self._timings_lock:
-            pending = self._drop_event_pending
+            pending = self._drop_events.flush()
             total = self.stats["dropped"]
-            if pending:
-                self._drop_event_pending = 0
-                self._drop_event_last = time.monotonic()
-        if pending:
+            dup_pending = self._dup_events.flush()
+        if pending or dup_pending:
             from relayrl_tpu import telemetry
 
-            telemetry.emit("drop", n=pending, total=total)
+            if pending:
+                telemetry.emit("drop", n=pending, total=total)
+            if dup_pending:
+                telemetry.emit("duplicate_drop", n=dup_pending)
+
+    def _count_duplicate(self, n: int = 1) -> None:
+        """Duplicate-drop accounting, coalesced to <=1 journal event/s
+        (a replay burst after a reconnect is hundreds of lines
+        otherwise)."""
+        self._m_duplicates.inc(n)
+        with self._timings_lock:
+            due = self._dup_events.add(n)
+        if due:
+            from relayrl_tpu import telemetry
+
+            telemetry.emit("duplicate_drop", n=due)
+
+    def _admit_seq(self, agent_id: str) -> tuple[str, int | None, bool]:
+        """Split a sequence-tagged envelope id and consult the dedup
+        ledger: ``(clean_agent_id, seq, admit)``. Untagged ids (raw
+        transport users, pre-spool fleets) always admit with seq None."""
+        from relayrl_tpu.transport.base import split_agent_seq
+
+        clean_id, seq = split_agent_seq(agent_id)
+        if seq is None or self._ingest_ledger is None:
+            return clean_id, None, True
+        if not self._ingest_ledger.accept(clean_id, seq):
+            self._count_duplicate()
+            return clean_id, seq, False
+        return clean_id, seq, True
 
     def _on_trajectory(self, agent_id: str, payload: bytes) -> None:
+        if self._fault_ingest is not None:
+            # chaos plane: drop/delay/duplicate/corrupt AFTER the wire —
+            # the frame arrived but the server mishandles it (actor
+            # replay + dedup must make the loop whole again).
+            for delay_s, part in self._fault_ingest.inject(payload):
+                if delay_s > 0:
+                    time.sleep(delay_s)
+                self._ingest_one(agent_id, part)
+            return
+        self._ingest_one(agent_id, payload)
+
+    def _ingest_one(self, agent_id: str, payload: bytes) -> None:
+        agent_id, seq, admit = self._admit_seq(agent_id)
+        if not admit:
+            return
         try:
             self._ingest.put_nowait((agent_id, payload))
         except queue.Full:
+            if seq is not None and self._ingest_ledger is not None:
+                # un-see the seq: the actor's replay must be able to land
+                # this trajectory later — a Full drop is loss, not dedup.
+                self._ingest_ledger.retract(agent_id, seq)
             self._count_dropped()
 
     def _on_trajectory_decoded(self, batch) -> None:
         """Pre-decoded columnar trajectory batch from the native drain —
-        skips the staging thread entirely (one queue entry per drain)."""
+        skips the staging thread entirely (one queue entry per drain).
+        Sequence tags ride the decoded items' agent ids through the C++
+        core; they are split + deduped here, and the clean id is written
+        back so per-agent attribution stays tag-free downstream."""
+        admitted = []
+        for item in batch:
+            clean_id, seq, admit = self._admit_seq(item.agent_id)
+            if not admit:
+                continue
+            if clean_id != item.agent_id:
+                item.agent_id = clean_id
+            admitted.append((item, seq))
+        if not admitted:
+            return
         try:
-            self._decoded.put_nowait(batch)
+            self._decoded.put_nowait([item for item, _ in admitted])
         except queue.Full:
-            self._count_dropped(len(batch))
+            if self._ingest_ledger is not None:
+                for item, seq in admitted:
+                    if seq is not None:
+                        self._ingest_ledger.retract(item.agent_id, seq)
+            self._count_dropped(len(admitted))
 
     def _get_model(self) -> tuple[int, bytes]:
         """Current full model as v1 bundle bytes (handshakes, artifact
@@ -963,6 +1091,67 @@ class TrainingServer:
             time.sleep(0.05)
         return False
 
+    # -- idempotent-ingest ledger persistence (crash-recovery plane) --
+    def _ledger_sidecar_path(self, version: int) -> str:
+        return os.path.join(self._checkpoint_dir,
+                            f"ingest_ledger_{int(version)}.json")
+
+    def _save_ledger_sidecar(self, version: int) -> None:
+        """Snapshot the dedup ledger next to the checkpoint at
+        ``version`` (atomic write; older sidecars pruned to the
+        checkpoint retention depth). Keyed BY VERSION so a resume
+        restores exactly the dedup state consistent with the restored
+        params — a newer ledger would dedup (lose) trajectories whose
+        updates rolled back; an older one would double-train."""
+        if self._ingest_ledger is None or not self._checkpoint_dir:
+            return
+        try:
+            self._ingest_ledger.save(self._ledger_sidecar_path(version))
+            import glob
+
+            sidecars = sorted(
+                glob.glob(os.path.join(self._checkpoint_dir,
+                                       "ingest_ledger_*.json")),
+                key=lambda p: int(p.rsplit("_", 1)[1].split(".")[0]))
+            for stale in sidecars[:-max(2, self._ckpt_keep)]:
+                os.remove(stale)
+        except (OSError, ValueError) as e:
+            print(f"[TrainingServer] ingest-ledger sidecar write failed: "
+                  f"{e!r}", flush=True)
+
+    def _load_ledger_sidecar(self, version: int) -> None:
+        """Restore the ledger matching the resumed version; a missing
+        sidecar (pre-recovery checkpoints) starts empty — replays of
+        already-trained trajectories then train again, which the runbook
+        documents as the bounded cost of a ledgerless resume."""
+        if self._ingest_ledger is None or not self._checkpoint_dir:
+            return
+        path = self._ledger_sidecar_path(version)
+        try:
+            from relayrl_tpu.runtime.spool import SequenceLedger
+
+            self._ingest_ledger = SequenceLedger.load(path)
+            print(f"[TrainingServer] ingest ledger restored "
+                  f"({len(self._ingest_ledger.counts())} agent(s), "
+                  f"version {version})", flush=True)
+        except FileNotFoundError:
+            print(f"[TrainingServer] no ingest-ledger sidecar at version "
+                  f"{version}; dedup starts empty (replays of "
+                  f"already-trained trajectories will re-train)",
+                  flush=True)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"[TrainingServer] ingest-ledger sidecar unreadable: "
+                  f"{e!r}; dedup starts empty", flush=True)
+
+    def ingest_accounting(self) -> dict:
+        """Sequence accounting for drills/benches: per-agent
+        ``{max_seq, accepted, contiguous}`` + duplicate count. Empty when
+        dedup is disabled."""
+        if self._ingest_ledger is None:
+            return {"agents": {}, "duplicates": 0}
+        return {"agents": self._ingest_ledger.counts(),
+                "duplicates": self._ingest_ledger.total_duplicates()}
+
     def _write_model_artifact(self, raw: bytes, version: int) -> None:
         """Periodic on-disk model bytes (ref: server reads the .pt file to
         serve agents, training_zmq.rs:905-919; for us handshakes are
@@ -1006,10 +1195,10 @@ class TrainingServer:
                     # The native core answers handshakes from pushed
                     # bytes; a v2 publish rides with the v1 bundle for
                     # set_model.
-                    self.transport.publish_model(
+                    self._faulted_publish(
                         version, frame, handshake_bytes=self._get_model()[1])
                 else:
-                    self.transport.publish_model(version, frame)
+                    self._faulted_publish(version, frame)
                 telemetry.emit("model_publish", version=version,
                                bytes=info["frame_bytes"], kind=info["kind"],
                                raw_bytes=info["raw_bytes"])
@@ -1021,13 +1210,27 @@ class TrainingServer:
                 with self._bundle_lock:
                     self._bundle_bytes = raw
                     self._bundle_version = int(version)
-                self.transport.publish_model(version, raw)
+                self._faulted_publish(version, raw)
                 telemetry.emit("model_publish", version=version,
                                bytes=len(raw))
         finally:
             # Distance-gated; a transient publish error must not starve
             # the on-disk artifact (the multi-host path always wrote it).
             self._write_model_artifact(None, version)
+
+    def _faulted_publish(self, version: int, frame: bytes,
+                         **kwargs) -> None:
+        """Model broadcast through the ``server.publish`` fault site:
+        drop loses the frame for the whole fleet (keyframe cadence or
+        resync recovers), corrupt lands in every actor's CRC check,
+        delay stalls the publisher thread. No plan → straight through."""
+        if self._fault_publish is None:
+            self.transport.publish_model(version, frame, **kwargs)
+            return
+        for delay_s, part in self._fault_publish.inject(frame):
+            if delay_s > 0:
+                time.sleep(delay_s)
+            self.transport.publish_model(version, part, **kwargs)
 
     def _publish(self) -> None:
         """Synchronous publish on the learner thread — the multi-host
@@ -1089,11 +1292,18 @@ class TrainingServer:
             telemetry.emit("checkpoint", version=self.algorithm.version,
                            include_aux=include_aux,
                            dir=str(self._checkpoint_dir))
+            # The dedup ledger rides every checkpoint as a per-version
+            # sidecar, so a crash-resume restores dedup state consistent
+            # with the restored params (see _save_ledger_sidecar).
+            self._save_ledger_sidecar(self.algorithm.version)
             # Count after submit so a SYNCHRONOUS failure (same-step
             # collision, bad tree) doesn't consume the aux slot. Saves
             # are async, so a deferred write failure surfaces at the
             # NEXT call and that slot is still lost — best effort only.
             self._ckpt_saves += 1
+            if self._ckpt_consecutive_failures:
+                self._ckpt_consecutive_failures = 0
+                self._m_ckpt_consecutive.set(0)
         except Exception as e:
             # A step collision happens after a signal-path final save
             # bumped past this version (see manager.save overwrite) —
@@ -1103,7 +1313,25 @@ class TrainingServer:
                       f"(post-resume overlap with a bumped final save)",
                       flush=True)
             else:
-                print(f"[TrainingServer] checkpoint failed: {e!r}", flush=True)
+                # Satellite (ISSUE 6): a failed save used to leave NO
+                # trace beyond this line while _ckpt_version advanced
+                # past it — operators could lose a whole resume window
+                # silently. Counter + consecutive-failure gauge + journal
+                # event make it alarmable.
+                self._ckpt_consecutive_failures += 1
+                self._m_ckpt_failures.inc()
+                self._m_ckpt_consecutive.set(
+                    self._ckpt_consecutive_failures)
+                from relayrl_tpu import telemetry
+
+                telemetry.emit(
+                    "checkpoint_failed", version=self.algorithm.version,
+                    error=repr(e),
+                    consecutive=self._ckpt_consecutive_failures,
+                    dir=str(self._checkpoint_dir))
+                print(f"[TrainingServer] checkpoint failed "
+                      f"(#{self._ckpt_consecutive_failures} consecutive): "
+                      f"{e!r}", flush=True)
 
     # -- lifecycle (ref: training_zmq.rs:322-465 / o3_training_server.rs:153-272) --
     def enable_server(self) -> None:

@@ -38,8 +38,18 @@ EVENT_TYPES = (
     "agent_reconnect",   # agent-side transport rebuilt (restart/heal)
     "drop",              # ingest-plane loss (coalesced: carries n)
     "checkpoint",        # full-state checkpoint written
+    "checkpoint_failed",  # a periodic/final save raised (carries the
+                          # error + consecutive-failure count)
     "drain",             # pipeline quiesced to empty
     "heartbeat",         # liveness state transition (alive/slow/dead)
+    # -- crash-recovery plane (ISSUE 6) --
+    "fault_injected",    # a FaultPlan rule fired at a hook site
+    "retry_exhausted",   # a RetryPolicy op spent its deadline/attempts
+    "breaker_open",      # circuit breaker tripped (consecutive failures)
+    "breaker_close",     # breaker closed again (successful probe/send)
+    "spool_replay",      # actor re-shipped its retained trajectory window
+    "duplicate_drop",    # idempotent ingest dropped replayed sequences
+                         # (coalesced: carries n)
 )
 
 

@@ -226,6 +226,10 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
                        if should_probe else _resolve_auto())
     elif should_probe:
         _verify_agent_protocol(server_type, config, overrides)
+    # transport.retry: the unified handshake/connect backoff policy all
+    # three backends share (transport/retry.py); an explicit override
+    # dict wins over the config section.
+    retry_cfg = overrides.get("retry", config.get_transport_params()["retry"])
     if server_type == "zmq":
         from relayrl_tpu.transport.zmq_backend import ZmqAgentTransport
 
@@ -236,6 +240,7 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
             model_sub_addr=overrides.get(
                 "model_sub_addr", config.get_train_server().address),
             identity=overrides.get("identity"),
+            retry=retry_cfg,
         )
     if server_type == "grpc":
         from relayrl_tpu.transport.grpc_backend import GrpcAgentTransport
@@ -244,6 +249,7 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
             server_addr=_agent_handshake_addr("grpc", config, overrides),
             identity=overrides.get("identity"),
             poll_timeout_s=config.get_grpc_idle_timeout_s() + 5.0,
+            retry=retry_cfg,
         )
     from relayrl_tpu.transport.native_backend import NativeAgentTransport
 
@@ -254,6 +260,7 @@ def make_agent_transport(server_type: str, config: ConfigLoader,
         # start_model_listener); an explicit override wins.
         heartbeat_s=overrides.get(
             "heartbeat_s", config.get_transport_params()["heartbeat_s"]),
+        retry=retry_cfg,
     )
 
 

@@ -50,6 +50,32 @@ def unpack_trajectory_envelope(buf: bytes) -> tuple[str, bytes]:
     return str(env.get("id", "?")), env["traj"]
 
 
+# -- delivery sequence tags (crash-recovery plane, runtime/spool.py) --
+#
+# Per-agent monotonic sequence numbers ride as a SUFFIX on the envelope
+# agent id ("<agent_id>#s<seq>") rather than a new envelope key: the id
+# is an opaque attribution string through every backend INCLUDING the
+# native C++ columnar fast path (codec.cc decode_envelope_to_blob carries
+# the id verbatim but would drop an unknown envelope key on the decoded
+# path), so one tagging scheme survives all three transports unchanged.
+# The server's ingest funnel strips the tag before attribution and feeds
+# the seq to its dedup ledger; ids without a tag (raw transport users,
+# pre-spool fleets) pass through untouched.
+_SEQ_TAG = "#s"
+
+
+def tag_agent_seq(agent_id: str, seq: int) -> str:
+    return f"{agent_id}{_SEQ_TAG}{int(seq)}"
+
+
+def split_agent_seq(agent_id: str) -> tuple[str, int | None]:
+    """``"a#s42" -> ("a", 42)``; untagged ids -> ``(agent_id, None)``."""
+    base, sep, tail = agent_id.rpartition(_SEQ_TAG)
+    if sep and tail.isdigit():
+        return base, int(tail)
+    return agent_id, None
+
+
 def pack_model_frame(version: int, bundle_bytes: bytes,
                      pub_ns: int | None = None) -> bytes:
     """``pub_ns`` is the publisher's CLOCK_MONOTONIC stamp (same-host
@@ -77,6 +103,58 @@ def unpack_model_frame_ex(buf: bytes) -> tuple[int, bytes, int | None]:
 def unpack_model_frame(buf: bytes) -> tuple[int, bytes]:
     version, model, _ = unpack_model_frame_ex(buf)
     return version, model
+
+
+# -- receive-loop decode-error narrowing (ISSUE 6 satellite) --
+#
+# The receive loops used to eat EVERY exception from a frame decode
+# ("malformed frame: drop, never crash ingest"), which also swallowed
+# genuine bugs. Decode sites now classify: data-shaped errors (anything a
+# hostile/corrupt frame can provoke from msgpack/struct/np slicing) are
+# dropped with a counter + one log line per site/type; everything else —
+# AttributeError, NameError, OSError, MemoryError: states a corrupt frame
+# cannot reach — re-raises and takes the loop down loudly.
+TRANSIENT_DECODE_ERRORS = (
+    ValueError,            # msgpack FormatError subclasses this; int() etc.
+    KeyError,              # missing envelope keys
+    TypeError,             # wrong msgpack container shapes
+    IndexError,            # truncated frames
+    OverflowError,
+    UnicodeDecodeError,
+    msgpack.exceptions.UnpackException,
+    msgpack.exceptions.StackError,
+)
+
+_swallow_logged: set[tuple[str, str, str]] = set()
+_swallow_lock = threading.Lock()
+
+
+def swallow_decode_error(backend: str, site: str, exc: Exception) -> None:
+    """Account for (or refuse to swallow) one receive-loop decode error.
+
+    Transient, data-shaped errors increment
+    ``relayrl_transport_swallowed_errors_total{backend,site}`` and log
+    once per (backend, site, type); anything else re-raises — a
+    programming error must not be laundered as a malformed frame.
+    """
+    if not isinstance(exc, TRANSIENT_DECODE_ERRORS):
+        raise exc
+    from relayrl_tpu import telemetry
+
+    telemetry.get_registry().counter(
+        "relayrl_transport_swallowed_errors_total",
+        "malformed frames dropped by receive loops",
+        {"backend": backend, "site": site}).inc()
+    key = (backend, site, type(exc).__name__)
+    with _swallow_lock:
+        first = key not in _swallow_logged
+        if first:
+            _swallow_logged.add(key)
+    if first:
+        print(f"[{backend}] {site}: dropped malformed frame "
+              f"({type(exc).__name__}: {exc}) — counted in "
+              f"relayrl_transport_swallowed_errors_total; further "
+              f"occurrences logged only to the counter", flush=True)
 
 
 class ReceiptLedger:
@@ -250,6 +328,28 @@ class AgentTransport(abc.ABC):
 
     def __init__(self):
         self.on_model: Callable[[int, bytes], None] = lambda *_: None
+        # Reconnect notification (crash-recovery plane): fired from a
+        # transport thread when this connection demonstrably healed after
+        # a break — zmq via a socket-monitor CONNECTED-after-DISCONNECTED
+        # pair, grpc on the first successful poll after a broken channel,
+        # native on a ping-heal redial. The agent hooks it to replay its
+        # trajectory spool (runtime/spool.py); the server's idempotent
+        # ingest makes that replay safe.
+        self.on_reconnect: Callable[[], None] = lambda: None
+
+    def _notify_reconnect(self) -> None:
+        """Count + forward one observed heal (shared by the backends so
+        the reconnect metric and the callback can never drift apart);
+        callback errors are isolated — a replay bug must not kill the
+        transport thread that noticed the heal."""
+        m = getattr(self, "_m", None)
+        if m is not None:
+            m["reconnects"].inc()
+        try:
+            self.on_reconnect()
+        except Exception as e:
+            print(f"[transport] on_reconnect handler failed: {e!r}",
+                  flush=True)
 
     @abc.abstractmethod
     def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:

@@ -39,8 +39,10 @@ from relayrl_tpu.transport.base import (
     ServerTransport,
     agent_wire_metrics,
     server_wire_metrics,
+    swallow_decode_error,
     unpack_trajectory_envelope,
 )
+from relayrl_tpu.transport.retry import RetryPolicy
 
 _SERVICE = "relayrl.RelayRLRoute"
 
@@ -58,7 +60,11 @@ class _Servicer:
         self._owner._m["recv_bytes"].inc(len(request))
         try:
             agent_id, payload = unpack_trajectory_envelope(request)
-        except Exception:
+        except Exception as e:
+            # data-shaped decode errors drop with a counter; programming
+            # errors re-raise (grpc surfaces them to the caller as an
+            # RPC error instead of a silent code-0 ack).
+            swallow_decode_error("grpc", "trajectory_ingest", e)
             return msgpack.packb({"code": 0, "error": "malformed envelope"})
         self._owner.on_trajectory(agent_id, payload)
         return msgpack.packb({"code": 1})
@@ -184,25 +190,21 @@ class GrpcServerTransport(ServerTransport):
 
 class GrpcAgentTransport(AgentTransport):
     def __init__(self, server_addr: str, identity: str | None = None,
-                 poll_timeout_s: float = 35.0):
+                 poll_timeout_s: float = 35.0, retry: dict | None = None):
         super().__init__()
         import os
         import secrets
 
+        from relayrl_tpu import faults
+
+        self._retry = RetryPolicy.from_dict(retry)
+        self._fault_send = faults.site("agent.send")
+        self._fault_model = faults.site("agent.model")
         self.identity = identity or f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}"
         self._addr = server_addr
         self._poll_timeout_s = poll_timeout_s
-        self._channel = grpc.insecure_channel(
-            server_addr,
-            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
-        )
-        self._send = self._channel.unary_unary(
-            f"/{_SERVICE}/SendActions",
-            request_serializer=_identity, response_deserializer=_identity)
-        self._poll = self._channel.unary_unary(
-            f"/{_SERVICE}/ClientPoll",
-            request_serializer=_identity, response_deserializer=_identity)
+        self._channel_lock = threading.Lock()
+        self._make_channel()
         self._known_version = -1
         self._inflight = None
         self._stop = threading.Event()
@@ -212,10 +214,53 @@ class GrpcAgentTransport(AgentTransport):
         # count a HEAL (first successful poll after a break), not every
         # failed retry — a 60s server restart is ONE reconnect, not 60.
         self._poll_broken = False
+        self._poll_fail_streak = 0
         # Pre-decode receipt ledger (base.ReceiptLedger), same surface
         # as the native C++ and zmq ledgers — soak fan-out accounting is
         # backend-uniform.
         self._ledger = ReceiptLedger()
+
+    def _make_channel(self) -> None:
+        """(Re)build the channel + stubs. Reconnect backoff is bounded by
+        the SAME retry policy that drives the handshake: grpc's default
+        channel backoff grows to ~2 minutes between dial attempts, so a
+        learner restart could sit unreachable for the whole recovery
+        window (observed in the SIGKILL drill)."""
+        backoff_min_ms = max(50, int(self._retry.base_delay_s * 1000))
+        backoff_max_ms = max(backoff_min_ms,
+                             int(self._retry.max_delay_s * 1000))
+        self._channel = grpc.insecure_channel(
+            self._addr,
+            options=[("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                     ("grpc.initial_reconnect_backoff_ms", backoff_min_ms),
+                     ("grpc.min_reconnect_backoff_ms", backoff_min_ms),
+                     ("grpc.max_reconnect_backoff_ms", backoff_max_ms)],
+        )
+        self._send = self._channel.unary_unary(
+            f"/{_SERVICE}/SendActions",
+            request_serializer=_identity, response_deserializer=_identity)
+        self._poll = self._channel.unary_unary(
+            f"/{_SERVICE}/ClientPoll",
+            request_serializer=_identity, response_deserializer=_identity)
+
+    def _rebuild_channel(self) -> None:
+        """Replace a persistently-broken channel with a fresh one. A
+        grpc-core channel whose server died mid-long-poll can wedge its
+        subchannel in connect-timeout loops ("FD Shutdown") and never
+        reach the restarted server even though a fresh dial succeeds
+        immediately — observed in the learner SIGKILL drill. In-flight
+        calls on the old channel fail over to the new one on their next
+        attempt (retry/spool paths)."""
+        with self._channel_lock:
+            old = self._channel
+            self._make_channel()
+        try:
+            old.close()
+        except Exception:
+            pass
+        print(f"[grpc] channel to {self._addr} rebuilt after persistent "
+              f"connection failure", flush=True)
 
     def _poll_once(self, first: bool, timeout_s: float,
                    known_version: int | None = None, record: bool = False):
@@ -248,26 +293,29 @@ class GrpcAgentTransport(AgentTransport):
         return None
 
     def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
-        """Bounded connect/handshake retry (the reference's init retry loop
-        never decrements its counter and can spin forever,
-        agent_grpc.rs:151-171)."""
+        """Bounded connect/handshake retry under the unified RetryPolicy
+        (the reference's init retry loop never decrements its counter and
+        can spin forever, agent_grpc.rs:151-171; the old flat 0.2s sleep
+        dialect here is replaced by the shared jittered backoff)."""
         deadline = time.monotonic() + timeout_s
-        last_err: Exception | None = None
-        while time.monotonic() < deadline:
-            try:
-                # ver=-1 regardless of _known_version: a handshake wants
-                # the bundle unconditionally — without it, a re-handshake
-                # on a transport already at the server's version would
-                # draw the metadata-only ack and spin to timeout.
-                result = self._poll_once(first=True, timeout_s=min(
-                    5.0, max(0.1, deadline - time.monotonic())),
-                    known_version=-1)
-                if result is not None:
-                    return result[0], result[1]
-            except grpc.RpcError as e:
-                last_err = e
-                time.sleep(0.2)
-        raise TimeoutError(f"gRPC model handshake timed out: {last_err}")
+
+        def attempt():
+            # ver=-1 regardless of _known_version: a handshake wants
+            # the bundle unconditionally — without it, a re-handshake
+            # on a transport already at the server's version would
+            # draw the metadata-only ack and spin to timeout.
+            result = self._poll_once(first=True, timeout_s=min(
+                5.0, max(0.1, deadline - time.monotonic())),
+                known_version=-1)
+            return None if result is None else (result[0], result[1])
+
+        try:
+            return self._retry.call(attempt, op="grpc.handshake",
+                                    deadline_s=timeout_s,
+                                    retry_on=(grpc.RpcError,))
+        except (grpc.RpcError, TimeoutError) as e:
+            raise TimeoutError(
+                f"gRPC model handshake timed out: {e}") from None
 
     def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
         # The connection identity registers via the first_time ClientPoll
@@ -294,13 +342,26 @@ class GrpcAgentTransport(AgentTransport):
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
         env = pack_trajectory_envelope(agent_id or self.identity, payload)
+        if self._fault_send is not None:
+            parts = self._fault_send.inject(env)
+            if not parts:
+                # On an ack'd transport a lost request surfaces as a
+                # timeout — raise so the caller (spool) retries/buffers,
+                # the same failure shape a real drop produces.
+                raise TimeoutError("fault-injected trajectory drop (grpc)")
+        else:
+            parts = ((0.0, env),)
         t0 = time.monotonic()
-        resp = msgpack.unpackb(self._send(env, timeout=30.0), raw=False)
+        for delay_s, part in parts:
+            if delay_s > 0:
+                time.sleep(delay_s)
+            resp = msgpack.unpackb(self._send(part, timeout=30.0), raw=False)
+            self._m["send_total"].inc()
+            self._m["send_bytes"].inc(len(part))
+            if resp.get("code") != 1:
+                raise RuntimeError(
+                    f"trajectory rejected: {resp.get('error')}")
         self._m["send_seconds"].observe(time.monotonic() - t0)
-        self._m["send_total"].inc()
-        self._m["send_bytes"].inc(len(env))
-        if resp.get("code") != 1:
-            raise RuntimeError(f"trajectory rejected: {resp.get('error')}")
 
     def start_model_listener(self) -> None:
         if self._listener is not None:
@@ -319,9 +380,12 @@ class GrpcAgentTransport(AgentTransport):
                 if self._poll_broken:
                     # First successful poll after a break: that is the
                     # one reconnect (native counts heals the same way —
-                    # semantics must match across backends).
+                    # semantics must match across backends). The shared
+                    # notifier counts it AND fires on_reconnect (spool
+                    # replay).
                     self._poll_broken = False
-                    self._m["reconnects"].inc()
+                    self._notify_reconnect()
+                self._poll_fail_streak = 0
             except (grpc.RpcError, grpc.FutureCancelledError) as e:
                 # FutureCancelledError: close() cancelled the parked poll.
                 # A DEADLINE_EXCEEDED is the benign empty long-poll; any
@@ -332,12 +396,28 @@ class GrpcAgentTransport(AgentTransport):
                         and code != grpc.StatusCode.DEADLINE_EXCEEDED
                         and not self._stop.is_set()):
                     self._poll_broken = True
+                    self._poll_fail_streak += 1
+                    if self._poll_fail_streak >= 5:
+                        # grpc-core can wedge a killed server's channel
+                        # permanently — rebuild (see _rebuild_channel).
+                        self._poll_fail_streak = 0
+                        self._rebuild_channel()
                 if self._stop.wait(1.0):
                     break
                 continue
             if result is not None:
                 version, bundle, rx_ns = result
-                self.on_model(version, bundle)
+                if self._fault_model is not None:
+                    # chaos plane: lose/delay/corrupt the delivery after
+                    # the poll returned (a dropped pull just re-polls; a
+                    # corrupted one dies in the actor's decode guards
+                    # and triggers the resync path).
+                    for delay_s, part in self._fault_model.inject(bundle):
+                        if delay_s > 0:
+                            time.sleep(delay_s)
+                        self.on_model(version, part)
+                else:
+                    self.on_model(version, bundle)
                 self._m["model_deliver_seconds"].observe(
                     (time.monotonic_ns() - rx_ns) / 1e9)
 

@@ -15,6 +15,7 @@ import time
 from relayrl_tpu.transport.base import (
     AgentTransport,
     ServerTransport,
+    swallow_decode_error,
     unpack_trajectory_envelope,
 )
 from relayrl_tpu.transport.probe import parse_host_port as _parse_host_port
@@ -273,8 +274,11 @@ class NativeServerTransportImpl(ServerTransport):
                         try:
                             agent_id, payload = unpack_trajectory_envelope(
                                 payload)
-                        except Exception:
-                            pass  # truly malformed; Python decode will drop
+                        except Exception as e:
+                            # truly malformed; Python decode will drop —
+                            # but count it, and re-raise non-data errors
+                            swallow_decode_error("native",
+                                                 "trajectory_ingest", e)
                     self.on_trajectory(agent_id, payload)
                 elif isinstance(item, Registration):
                     self.on_register(item.agent_id)
@@ -303,7 +307,8 @@ class NativeServerTransportImpl(ServerTransport):
             if ev_type.value == _EV_TRAJECTORY:
                 try:
                     agent_id, traj = unpack_trajectory_envelope(payload)
-                except Exception:
+                except Exception as e:
+                    swallow_decode_error("native", "trajectory_ingest", e)
                     continue
                 self.on_trajectory(agent_id, traj)
             elif ev_type.value == _EV_REGISTER:
@@ -318,17 +323,30 @@ class NativeAgentTransportImpl(AgentTransport):
     _HB_ALIVE, _HB_SLOW, _HB_DEAD = 0, 1, 2
 
     def __init__(self, lib_path: str, server_addr: str,
-                 identity: str | None = None, heartbeat_s: float = 5.0):
+                 identity: str | None = None, heartbeat_s: float = 5.0,
+                 retry: dict | None = None):
         super().__init__()
         import os
         import secrets
 
+        from relayrl_tpu import faults
         from relayrl_tpu.transport.base import agent_wire_metrics
+        from relayrl_tpu.transport.retry import RetryPolicy
 
+        self._retry = RetryPolicy.from_dict(retry)
+        self._fault_send = faults.site("agent.send")
+        self._fault_model = faults.site("agent.model")
         self._lib = _load(lib_path)
         self.identity = identity or f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}"
         self._host, self._port = _parse_host_port(server_addr)
         self._ctrl = None
+        self._had_ctrl = False  # distinguishes first connect from redial
+        # Serializes every C call on the ctrl handle against the
+        # fault-plane _kill_ctrl close: without it, a kill_connection
+        # injection could free the handle mid-ping/send on another
+        # thread (use-after-free in the C library, a REAL crash the
+        # drill did not intend). Ping holds it <= its 1s timeout.
+        self._ctrl_lock = threading.Lock()
         self._sub = None
         # transport.heartbeat_s config knob (was a hard-coded 5.0 in
         # start_model_listener); <= 0 disables the beat entirely.
@@ -345,18 +363,28 @@ class NativeAgentTransportImpl(AgentTransport):
             {"backend": "native"})
 
     def _ensure_ctrl(self, timeout_s: float):
+        """Control-channel connect under the unified RetryPolicy (was a
+        flat 0.2s sleep loop — the third per-backend retry dialect this
+        policy replaces)."""
         if self._ctrl is None:
-            deadline = time.monotonic() + timeout_s
-            while self._ctrl is None:
-                self._ctrl = self._lib.rl_client_connect(
+            def attempt():
+                handle = self._lib.rl_client_connect(
                     self._host.encode(), self._port, 2000)
-                if self._ctrl:
-                    break
-                if time.monotonic() >= deadline:
-                    raise TimeoutError(
-                        f"native transport: cannot connect to "
-                        f"{self._host}:{self._port}")
-                time.sleep(0.2)
+                return handle or None
+
+            try:
+                self._ctrl = self._retry.call(attempt, op="native.connect",
+                                              deadline_s=timeout_s)
+            except TimeoutError:
+                raise TimeoutError(
+                    f"native transport: cannot connect to "
+                    f"{self._host}:{self._port}") from None
+            if self._had_ctrl:
+                # A REDIAL, not the first connect: the server reaped the
+                # old connection's registrations on kernel close — the
+                # owner must re-register its lanes and replay the spool.
+                self._notify_reconnect()
+            self._had_ctrl = True
         return self._ctrl
 
     def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
@@ -367,8 +395,10 @@ class NativeAgentTransportImpl(AgentTransport):
         while True:
             remaining = max(100, int((deadline - time.monotonic()) * 1000))
             buf = (ctypes.c_uint8 * cap)()
-            n = self._lib.rl_client_get_model(ctrl, min(remaining, 5000),
-                                              ctypes.byref(version), buf, cap)
+            with self._ctrl_lock:
+                n = self._lib.rl_client_get_model(
+                    ctrl, min(remaining, 5000), ctypes.byref(version),
+                    buf, cap)
             if 0 <= n <= cap:
                 return int(version.value), bytes(buf[: int(n)])
             if n > cap:
@@ -382,30 +412,64 @@ class NativeAgentTransportImpl(AgentTransport):
 
     def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
         ctrl = self._ensure_ctrl(timeout_s)
-        rc = self._lib.rl_client_register(
-            ctrl, (agent_id or self.identity).encode(), int(timeout_s * 1000))
+        with self._ctrl_lock:
+            rc = self._lib.rl_client_register(
+                ctrl, (agent_id or self.identity).encode(),
+                int(timeout_s * 1000))
         return rc == 0
 
     def send_trajectory(self, payload: bytes,
                         agent_id: str | None = None) -> None:
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
-        ctrl = self._ensure_ctrl(5.0)
         env = pack_trajectory_envelope(agent_id or self.identity, payload)
-        data = _buf(env)
+        if self._fault_send is not None:
+            if self._fault_send.take_kill_connection():
+                self._kill_ctrl()
+            parts = self._fault_send.inject(env)
+            if not parts:
+                # ack'd transport: a lost frame surfaces as a failed
+                # send — raise so the spool buffers and replays it.
+                raise RuntimeError("fault-injected trajectory drop (native)")
+        else:
+            parts = ((0.0, env),)
+        ctrl = self._ensure_ctrl(5.0)
         t0 = time.monotonic()
-        if self._lib.rl_client_send_traj(ctrl, data, len(env)) != 0:
-            raise RuntimeError("native trajectory send failed")
+        for delay_s, part in parts:
+            if delay_s > 0:
+                time.sleep(delay_s)
+            data = _buf(part)
+            with self._ctrl_lock:
+                if self._ctrl is not ctrl:  # killed mid-batch: redial
+                    raise RuntimeError(
+                        "native trajectory send failed (connection "
+                        "killed mid-send)")
+                rc = self._lib.rl_client_send_traj(ctrl, data, len(part))
+            if rc != 0:
+                raise RuntimeError("native trajectory send failed")
+            self._m["send_total"].inc()
+            self._m["send_bytes"].inc(len(part))
         self._m["send_seconds"].observe(time.monotonic() - t0)
-        self._m["send_total"].inc()
-        self._m["send_bytes"].inc(len(env))
+
+    def _kill_ctrl(self) -> None:
+        """Fault-plane connection kill: drop the control channel the way
+        a crash would; the next send redials through _ensure_ctrl (and
+        the server's kernel-close reaping unregisters this agent). The
+        close happens under _ctrl_lock so no other thread can be inside
+        a C call on the handle being freed."""
+        with self._ctrl_lock:
+            ctrl, self._ctrl = self._ctrl, None
+            if ctrl:
+                self._lib.rl_client_close(ctrl)
 
     def ping(self, timeout_s: float = 2.0) -> int:
         """Liveness probe on the control channel: 0 alive, 2 slow (no pong
         inside the timeout, connection kept), 1 hard failure healed by
         redial, -1 dead even after redial."""
         ctrl = self._ensure_ctrl(timeout_s)
-        return int(self._lib.rl_client_ping(ctrl, int(timeout_s * 1000)))
+        with self._ctrl_lock:
+            return int(self._lib.rl_client_ping(ctrl,
+                                                int(timeout_s * 1000)))
 
     def start_model_listener(self, heartbeat_s: float | None = None) -> None:
         """``heartbeat_s=None`` uses the constructor's value (the
@@ -463,13 +527,18 @@ class NativeAgentTransportImpl(AgentTransport):
             if (self._heartbeat_s > 0
                     and time.monotonic() - last_beat >= self._heartbeat_s):
                 last_beat = time.monotonic()
-                if self._ctrl:
-                    rc = int(self._lib.rl_client_ping(self._ctrl, 1000))
+                with self._ctrl_lock:
+                    ctrl = self._ctrl
+                    rc = (int(self._lib.rl_client_ping(ctrl, 1000))
+                          if ctrl else None)
+                if rc is not None:
                     # rc: 0 alive, 2 slow (no pong in window), 1 hard
                     # failure healed by redial (counts as a reconnect,
-                    # lands alive), -1 dead even after redial.
+                    # lands alive, and fires on_reconnect so the owner
+                    # re-registers + replays its spool), -1 dead even
+                    # after redial.
                     if rc == 1:
-                        self._m["reconnects"].inc()
+                        self._notify_reconnect()
                     self._m_liveness.set(
                         self._HB_ALIVE if rc in (0, 1)
                         else self._HB_SLOW if rc == 2
@@ -488,7 +557,17 @@ class NativeAgentTransportImpl(AgentTransport):
             if blob is None:
                 continue  # mid-chunk: deliver on the final part
             self._m["model_recv_total"].inc()
-            self.on_model(int(version.value), blob)
+            if self._fault_model is not None:
+                # chaos plane: the C++ ledger already stamped the
+                # receipt; the injected fault hits the delivery layer —
+                # corrupt dies in the actor's decode/CRC guards, drop
+                # waits out the keyframe cadence.
+                for delay_s, part in self._fault_model.inject(blob):
+                    if delay_s > 0:
+                        time.sleep(delay_s)
+                    self.on_model(int(version.value), part)
+            else:
+                self.on_model(int(version.value), blob)
             self._m["model_deliver_seconds"].observe(
                 max(0.0, (time.monotonic_ns() - int(rx_ns.value)) / 1e9))
 

@@ -42,10 +42,12 @@ from relayrl_tpu.transport.base import (
     agent_wire_metrics,
     pack_model_frame,
     server_wire_metrics,
+    swallow_decode_error,
     unpack_model_frame,
     unpack_model_frame_ex,
     unpack_trajectory_envelope,
 )
+from relayrl_tpu.transport.retry import RetryPolicy
 
 _POLL_MS = 100  # shutdown-check cadence for otherwise-blocking polls
 
@@ -180,8 +182,12 @@ class ZmqServerTransport(ServerTransport):
                 self._m["recv_bytes"].inc(len(buf))
                 try:
                     agent_id, payload = unpack_trajectory_envelope(buf)
-                except Exception:
-                    continue  # malformed frame: drop, never crash ingest
+                except Exception as e:
+                    # Malformed frame: drop WITH a trace (counter + one
+                    # log line); non-data errors re-raise — see
+                    # base.swallow_decode_error.
+                    swallow_decode_error("zmq", "trajectory_ingest", e)
+                    continue
                 self.on_trajectory(agent_id, payload)
         finally:
             sock.close(linger=0)
@@ -191,10 +197,13 @@ class ZmqAgentTransport(AgentTransport):
     """DEALER handshake + PUSH trajectories + SUB model updates."""
 
     def __init__(self, agent_listener_addr: str, trajectory_addr: str,
-                 model_sub_addr: str, identity: str | None = None):
+                 model_sub_addr: str, identity: str | None = None,
+                 retry: dict | None = None):
         super().__init__()
         import os
         import secrets
+
+        from relayrl_tpu import faults
 
         self._identity = (identity or
                           f"AGENT_ID-{os.getpid()}{secrets.token_hex(4)}").encode()
@@ -205,11 +214,30 @@ class ZmqAgentTransport(AgentTransport):
         self._dealer.connect(agent_listener_addr)
         self._push = self._ctx.socket(zmq.PUSH)
         self._push.connect(trajectory_addr)
+        # Reconnect detection for a broadcast-plane transport with no
+        # request/response back-channel: a zmq socket monitor on the PUSH
+        # pipe reports DISCONNECTED/CONNECTED transitions from libzmq's
+        # own reconnect machinery — a CONNECTED after a DISCONNECTED is
+        # the server-restart signal that fires on_reconnect (spool
+        # replay). Polled from the model-listener thread.
+        self._push_monitor: zmq.Socket | None = None
+        try:
+            self._push_monitor = self._push.get_monitor_socket(
+                zmq.EVENT_CONNECTED | zmq.EVENT_DISCONNECTED)
+        except (zmq.ZMQError, AttributeError):
+            pass  # monitor unsupported: replay falls back to explicit paths
+        self._push_broken = False
         self._push_lock = threading.Lock()
+        self._dealer_lock = threading.Lock()
         self._sub: zmq.Socket | None = None
         self._listener: threading.Thread | None = None
         self._stop = threading.Event()
         self._m = agent_wire_metrics("zmq")
+        # Unified retry policy (transport.retry config) drives the
+        # handshake re-poll cadence; fault sites are None without a plan.
+        self._retry = RetryPolicy.from_dict(retry)
+        self._fault_send = faults.site("agent.send")
+        self._fault_model = faults.site("agent.model")
         # Pre-decode receipt ledger (base.ReceiptLedger — the native C++
         # ledger's Python mirror): (version, rx_mono_ns) stamped the
         # moment recv returns, BEFORE the frame is decoded or the swap
@@ -236,31 +264,51 @@ class ZmqAgentTransport(AgentTransport):
         of a later ID_LOGGED — request/response pairing on a DEALER is by
         reply type, not ordering.
         """
-        deadline = time.monotonic() + timeout_s
-        poller = zmq.Poller()
-        poller.register(self._dealer, zmq.POLLIN)
-        self._dealer.send_multipart(frames)
-        while time.monotonic() < deadline:
-            if dict(poller.poll(_POLL_MS)):
-                reply = self._dealer.recv_multipart()
-                if reply and reply[0] == want:
-                    return reply
-        return None
+        # _dealer_lock: zmq sockets are not thread-safe, and reconnect-
+        # time re-registration (Agent._on_reconnect, fired from a
+        # listener thread) may race a handshake on the caller thread.
+        with self._dealer_lock:
+            deadline = time.monotonic() + timeout_s
+            poller = zmq.Poller()
+            poller.register(self._dealer, zmq.POLLIN)
+            self._dealer.send_multipart(frames)
+            while time.monotonic() < deadline:
+                if dict(poller.poll(_POLL_MS)):
+                    # deliberate blocking-under-lock: the lock EXISTS to
+                    # serialize whole request/reply exchanges on the
+                    # non-thread-safe DEALER; poll() above guarantees
+                    # recv returns immediately, and the hold is bounded
+                    # by the caller's timeout_s.
+                    reply = self._dealer.recv_multipart()  # jaxlint: disable=CONC01
+                    if reply and reply[0] == want:
+                        return reply
+            return None
 
     def fetch_model(self, timeout_s: float = 60.0) -> tuple[int, bytes]:
-        """Retrying GET_MODEL handshake (ref: agent_zmq.rs:316-442 retries
-        every 1 s forever; here the caller bounds it)."""
+        """Retrying GET_MODEL handshake under the unified RetryPolicy
+        (ref: agent_zmq.rs:316-442 retries every 1 s forever; previously
+        a hand-rolled fixed-2s re-poll dialect here — now the one
+        jittered-backoff policy all three backends share)."""
         deadline = time.monotonic() + timeout_s
-        while True:
+
+        def attempt():
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise TimeoutError(
-                    f"model handshake timed out after {timeout_s}s "
-                    f"(server at {self._addrs[0]} unreachable?)")
-            reply = self._dealer_request([CMD_GET_MODEL], min(remaining, 2.0),
+                return None
+            reply = self._dealer_request([CMD_GET_MODEL],
+                                         min(remaining, 2.0),
                                          want=REPLY_MODEL)
             if reply and len(reply) > 1:
                 return unpack_model_frame(reply[1])
+            return None
+
+        try:
+            return self._retry.call(attempt, op="zmq.handshake",
+                                    deadline_s=timeout_s)
+        except TimeoutError:
+            raise TimeoutError(
+                f"model handshake timed out after {timeout_s}s "
+                f"(server at {self._addrs[0]} unreachable?)") from None
 
     def register(self, agent_id: str | None = None, timeout_s: float = 10.0) -> bool:
         reply = self._dealer_request(
@@ -273,12 +321,45 @@ class ZmqAgentTransport(AgentTransport):
         from relayrl_tpu.transport.base import pack_trajectory_envelope
 
         env = pack_trajectory_envelope(agent_id or self.identity, payload)
+        if self._fault_send is not None:
+            if self._fault_send.take_kill_connection():
+                self._kill_push()
+            parts = self._fault_send.inject(env)
+        else:
+            parts = ((0.0, env),)
         t0 = time.monotonic()
-        with self._push_lock:
-            self._push.send(env)
+        for delay_s, part in parts:
+            if delay_s > 0:
+                time.sleep(delay_s)  # before the lock: a chaos delay
+                #                      must not serialize sibling senders
+            with self._push_lock:
+                self._push.send(part)
+            self._m["send_total"].inc()
+            self._m["send_bytes"].inc(len(part))
         self._m["send_seconds"].observe(time.monotonic() - t0)
-        self._m["send_total"].inc()
-        self._m["send_bytes"].inc(len(env))
+
+    def _kill_push(self) -> None:
+        """Fault-plane connection kill: tear down the PUSH socket the way
+        a TCP RST would (queued frames lost) and reconnect fresh — the
+        recovery the spool's replay-on-reconnect covers."""
+        with self._push_lock:
+            if self._push_monitor is not None:
+                try:
+                    self._push_monitor.close(linger=0)
+                except zmq.ZMQError:
+                    pass
+            self._push_monitor = None
+            self._push.close(linger=0)
+            self._push = self._ctx.socket(zmq.PUSH)
+            # zmq connect is asynchronous (returns before any TCP
+            # handshake) — not a blocking call, and the swap must be
+            # atomic against concurrent senders holding this lock.
+            self._push.connect(self._addrs[1])  # jaxlint: disable=CONC01
+            try:
+                self._push_monitor = self._push.get_monitor_socket(
+                    zmq.EVENT_CONNECTED | zmq.EVENT_DISCONNECTED)
+            except (zmq.ZMQError, AttributeError):
+                pass
 
     def start_model_listener(self) -> None:
         if self._listener is not None:
@@ -305,35 +386,74 @@ class ZmqAgentTransport(AgentTransport):
         poller = zmq.Poller()
         poller.register(self._sub, zmq.POLLIN)
         while not self._stop.is_set():
+            self._drain_monitor()
             if not dict(poller.poll(_POLL_MS)):
                 continue
             frames = self._sub.recv_multipart()
             rx_ns = time.monotonic_ns()  # pre-decode receipt stamp
             if len(frames) != 2 or frames[0] != MODEL_TOPIC:
                 continue
-            try:
-                version, bundle, pub_ns = unpack_model_frame_ex(frames[1])
-            except Exception:
-                continue
-            self._m["model_recv_bytes"].inc(len(frames[1]))
-            bundle = self._reasm.feed(bundle)
-            if bundle is None:
-                continue  # mid-chunk: the receipt stamps on the last part
-            self._ledger.append(version, rx_ns)
-            self._m["model_recv_total"].inc()
-            if pub_ns is not None and 0 <= rx_ns - pub_ns < int(300e9):
-                # Same-host monotonic pair only. CLOCK_MONOTONIC is
-                # per-boot, so a cross-host pair is off by the uptime
-                # difference in EITHER direction — the negative half is
-                # obvious, but the positive half would pin every sample
-                # in the +Inf bucket. Anything beyond 300s cannot be a
-                # real fan-out latency on this plane; treat it as skew
-                # and drop the sample.
-                self._m["receipt_latency_seconds"].observe(
-                    (rx_ns - pub_ns) / 1e9)
-            self.on_model(version, bundle)
-            self._m["model_deliver_seconds"].observe(
-                (time.monotonic_ns() - rx_ns) / 1e9)
+            raw_frames = [frames[1]]
+            if self._fault_model is not None:
+                # chaos plane: drop/delay/corrupt/duplicate the model
+                # frame between the wire and the decode — a corrupted
+                # frame must die in the CRC/decode guards below, a
+                # dropped one waits out the keyframe cadence.
+                raw_frames = []
+                for delay_s, part in self._fault_model.inject(frames[1]):
+                    if delay_s > 0:
+                        time.sleep(delay_s)
+                    raw_frames.append(part)
+            for raw in raw_frames:
+                self._deliver_model_frame(raw, rx_ns)
+
+    def _deliver_model_frame(self, raw: bytes, rx_ns: int) -> None:
+        try:
+            version, bundle, pub_ns = unpack_model_frame_ex(raw)
+        except Exception as e:
+            swallow_decode_error("zmq", "model_listener", e)
+            return
+        self._m["model_recv_bytes"].inc(len(raw))
+        bundle = self._reasm.feed(bundle)
+        if bundle is None:
+            return  # mid-chunk: the receipt stamps on the last part
+        self._ledger.append(version, rx_ns)
+        self._m["model_recv_total"].inc()
+        if pub_ns is not None and 0 <= rx_ns - pub_ns < int(300e9):
+            # Same-host monotonic pair only. CLOCK_MONOTONIC is
+            # per-boot, so a cross-host pair is off by the uptime
+            # difference in EITHER direction — the negative half is
+            # obvious, but the positive half would pin every sample
+            # in the +Inf bucket. Anything beyond 300s cannot be a
+            # real fan-out latency on this plane; treat it as skew
+            # and drop the sample.
+            self._m["receipt_latency_seconds"].observe(
+                (rx_ns - pub_ns) / 1e9)
+        self.on_model(version, bundle)
+        self._m["model_deliver_seconds"].observe(
+            (time.monotonic_ns() - rx_ns) / 1e9)
+
+    def _drain_monitor(self) -> None:
+        """Process queued PUSH-socket monitor events (model-listener
+        thread): a CONNECTED following a DISCONNECTED is a healed
+        trajectory pipe — the replay-on-reconnect trigger for this
+        backend, which otherwise has no failure signal at all (PUSH
+        sends never error; libzmq re-queues silently)."""
+        mon = self._push_monitor
+        if mon is None:
+            return
+        try:
+            from zmq.utils.monitor import recv_monitor_message
+
+            while mon.poll(0):
+                evt = recv_monitor_message(mon)["event"]
+                if evt == zmq.EVENT_DISCONNECTED:
+                    self._push_broken = True
+                elif evt == zmq.EVENT_CONNECTED and self._push_broken:
+                    self._push_broken = False
+                    self._notify_reconnect()
+        except (zmq.ZMQError, KeyError, OSError):
+            pass  # monitor died (socket rebuilt): detection degrades
 
     def drain_receipts(self, max_n: int = 65536) -> list[tuple[int, int]]:
         """Drain the pre-decode receipt ledger: ``[(version,
@@ -347,7 +467,9 @@ class ZmqAgentTransport(AgentTransport):
         if self._listener is not None:
             self._listener.join(timeout=5)
             self._listener = None
-        for sock in (self._dealer, self._push, self._sub):
+        for sock in (self._dealer, self._push, self._sub,
+                     self._push_monitor):
             if sock is not None:
                 sock.close(linger=0)
         self._sub = None
+        self._push_monitor = None

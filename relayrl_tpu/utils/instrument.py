@@ -23,10 +23,12 @@ def instrument_agent(agent) -> dict:
     inner_send = agent.transport.send_trajectory
     inner_step = agent.request_for_action
 
-    def counting_send(raw: bytes):
+    def counting_send(raw: bytes, agent_id: str | None = None):
+        # agent_id: the transports' logical-lane attribution kwarg — the
+        # spool also rides its sequence tag on it; forward verbatim.
         counters["bytes"] += len(raw)
         counters["sends"] += 1
-        return inner_send(raw)
+        return inner_send(raw, agent_id=agent_id)
 
     def counting_step(obs, **kw):
         counters["steps"] += 1

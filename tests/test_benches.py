@@ -109,6 +109,28 @@ def test_bench_soak_quick_slos(tmp_path):
     assert traj["value"] == soak["server_stats"]["trajectories"]
 
 
+def test_bench_soak_chaos_quick_smoke(tmp_path):
+    """Fast --chaos soak smoke (ISSUE 6): the learner SIGKILL/resume
+    drill under the standard fault plan must hold its SLOs (asserted
+    in-script: zero-loss accounting, full spool flush, MTTR measured,
+    faults actually injected) and emit a well-formed chaos row carrying
+    the injection ledger + recovery counters."""
+    lines = _run_bench("bench_soak.py", tmp_path, "--chaos", timeout=600)
+    row = next(r for r in lines if r["bench"].startswith("chaos_soak"))
+    assert row["accounting"]["zero_loss"] is True
+    assert row["accounting"]["zero_double_train"] is True
+    assert row["agents_crashed"] == 0
+    assert row["mttr_s"] is not None and row["mttr_s"] >= 0
+    assert row["config"]["fault_plan"]["rules"], "no fault plan committed"
+    injected = sum(v for k, v in row["worker_fault_counters"].items()
+                   if k.startswith("relayrl_faults_injected_total"))
+    assert injected > 0, "chaos row ran fault-free"
+    # every agent's ledger line must reconcile against its sent count
+    for ident, n in row["accounting"]["sent_totals"].items():
+        ledger = row["accounting"]["agents"][ident]
+        assert ledger["max_seq"] == n and ledger["contiguous"], ledger
+
+
 @pytest.mark.telemetry
 def test_bench_telemetry_quick_asserts_hotpath_cost(tmp_path):
     # The microbench carries its own ceiling asserts (disabled-path inc

@@ -228,6 +228,10 @@ class TestAlgorithmResume:
         pair, save→load preserves the survivor set in chronological order,
         and the restored ring's future overwrite behavior matches a buffer
         that had lived through the same history."""
+        pytest.importorskip(
+            "hypothesis",
+            reason="property test needs the [test] extra (pip install "
+                   "relayrl-tpu[test])")
         from hypothesis import given, settings, strategies as st
 
         from relayrl_tpu.data.step_buffer import StepReplayBuffer

@@ -8,6 +8,14 @@ shapes the unit tests pin.
 """
 
 import numpy as np
+import pytest
+
+# A clean env (no [test] extra) must still COLLECT with zero errors
+# (ISSUE 6 satellite): skip, don't explode, when hypothesis is absent.
+pytest.importorskip(
+    "hypothesis",
+    reason="fuzz suite needs the [test] extra (pip install "
+           "relayrl-tpu[test])")
 from hypothesis import given, settings, strategies as st
 
 from relayrl_tpu.types.action import ActionRecord
