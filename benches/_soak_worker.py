@@ -1,6 +1,6 @@
 """Worker process for the multi-actor ZMQ soak bench.
 
-Two modes, selected by ``cfg["vector"]``:
+Three modes, selected by ``cfg["vector"]`` / ``cfg["anakin"]``:
 
 * process-per-agent (default): N real :class:`relayrl_tpu.runtime.Agent`
   instances in threads (each with its own DEALER/PUSH/SUB sockets — the
@@ -16,6 +16,13 @@ Two modes, selected by ``cfg["vector"]``:
   agents and per-lane trajectory streams; the result file still carries
   one row per logical agent (receipts live on the lane-0 row, the
   connection's shared subscription).
+* anakin (``"anakin": true``): ONE VectorAgent in fused-rollout mode —
+  the env itself (``cfg["jax_env"]``, default CartPole-v1) runs on-device
+  inside a ``jit(vmap(lax.scan))`` dispatch producing an
+  ``[agents_per_proc, unroll_length]`` trajectory window per call
+  (runtime/anakin.py). No synthetic env loop at all: real episodes, real
+  terminal markers, autoreset in-scan. Server-side view identical to
+  vector mode (N logical agents, N attributed streams).
 
 Usage: _soak_worker.py <json-config>  (see bench_soak.py)
 Writes a JSON result file: per-agent step counts + model receipt times.
@@ -98,6 +105,51 @@ def drain_receipt_grace(transport, receipts: list, has_ledger: bool,
         time.sleep(0.2)
 
 
+def install_receipt_probe(agent, receipts: list) -> bool:
+    """Receipt observation for one agent connection. All three in-tree
+    backends expose a pre-decode receipt ledger (stamps taken in the I/O
+    thread the moment a model frame leaves the socket, so GIL pressure on
+    the decode/swap path can never eat receipts — the ISSUE 4 zmq
+    64-actor investigation); returns True when one exists so the caller
+    drains it. Custom transports without a ledger fall back to stamping
+    in on_model (post-decode). One implementation for every fleet mode so
+    the probe can never skew a mode-vs-mode receipt-rate comparison."""
+    has_ledger = hasattr(agent.transport, "drain_receipts")
+    if not has_ledger:
+        orig_on_model = agent.transport.on_model
+
+        def on_model(version, bundle_bytes):
+            receipts.append((int(version), time.monotonic_ns()))
+            orig_on_model(version, bundle_bytes)
+
+        agent.transport.on_model = on_model
+    return has_ledger
+
+
+def batched_lane_rows(agent, *, steps: int, episodes_per_lane: list,
+                      receipts: list, sub_ts: int, window_start_ns: int,
+                      window_end_ns: int, unsub_ts: int,
+                      crashed: str | None) -> list[dict]:
+    """One result row per logical lane of a batched host (vector/anakin)
+    so the coordinator's accounting stays topology-blind.
+    Shared-subscription accounting: the connection received each publish
+    ONCE, so receipts ride the lane-0 row and lanes 1..N-1 report a
+    zero-width receipt window — the coordinator neither expects nor
+    counts duplicates for them."""
+    return [{
+        "identity": agent.agent_ids[lane],
+        "steps": steps,
+        "episodes": episodes_per_lane[lane],
+        "final_version": agent.model_version,
+        "receipts": receipts if lane == 0 else [],
+        "sub_ts": sub_ts if lane == 0 else unsub_ts,
+        "window_start_ns": window_start_ns,
+        "window_end_ns": window_end_ns,
+        "unsub_ts": unsub_ts,
+        "crashed": crashed,
+    } for lane in range(len(agent.agent_ids))]
+
+
 def chaos_setup(cfg: dict) -> None:
     """Chaos-mode worker plumbing (bench_soak --chaos): install the
     fault plan via the env hook BEFORE any Agent is constructed, and a
@@ -172,21 +224,7 @@ def agent_loop(cfg: dict, agent_idx: int, out: dict, barrier: threading.Barrier)
     # (publish, agent) pair as expected only if this agent subscribed
     # before the publish.
     sub_ts = time.monotonic_ns()
-    # All three backends now expose a pre-decode receipt ledger (the
-    # native C++ reader's, mirrored in the zmq/grpc listener threads) —
-    # stamps are taken the moment the frame leaves the socket, so GIL
-    # pressure on the decode/swap path can no longer eat receipts
-    # (ISSUE 4 satellite: the zmq 64-actor 0.433 investigation). The
-    # on_model fallback below stays for custom transports without one.
-    has_ledger = hasattr(agent.transport, "drain_receipts")
-    if not has_ledger:
-        orig_on_model = agent.transport.on_model
-
-        def on_model(version, bundle_bytes):
-            receipts.append((int(version), time.monotonic_ns()))
-            orig_on_model(version, bundle_bytes)
-
-        agent.transport.on_model = on_model
+    has_ledger = install_receipt_probe(agent, receipts)
 
     rng = np.random.default_rng(agent_idx)
     obs_dim, ep_len = cfg["obs_dim"], cfg["episode_len"]
@@ -293,15 +331,7 @@ def vector_host_loop(cfg: dict) -> list[dict]:
     )
     receipts: list[tuple[int, int]] = []
     sub_ts = time.monotonic_ns()
-    has_ledger = hasattr(agent.transport, "drain_receipts")
-    if not has_ledger:
-        orig_on_model = agent.transport.on_model
-
-        def on_model(version, bundle_bytes):
-            receipts.append((int(version), time.monotonic_ns()))
-            orig_on_model(version, bundle_bytes)
-
-        agent.transport.on_model = on_model
+    has_ledger = install_receipt_probe(agent, receipts)
 
     rng = np.random.default_rng(cfg["worker_id"])
     obs_dim, ep_len = cfg["obs_dim"], cfg["episode_len"]
@@ -330,26 +360,77 @@ def vector_host_loop(cfg: dict) -> list[dict]:
     window_end_ns = time.monotonic_ns()
     drain_receipt_grace(agent.transport, receipts, has_ledger,
                         cfg.get("receipt_grace_s", 8.0))
-    unsub_ts = time.monotonic_ns()
-    rows = []
-    for lane in range(n_lanes):
-        rows.append({
-            "identity": agent.agent_ids[lane],
-            "steps": steps,
-            "episodes": episodes,
-            "final_version": agent.model_version,
-            # Shared-subscription accounting: the connection received each
-            # publish ONCE; lanes 1..N-1 report a zero-width window so the
-            # coordinator neither expects nor counts duplicates for them.
-            "receipts": receipts if lane == 0 else [],
-            "sub_ts": sub_ts if lane == 0 else unsub_ts,
-            "window_start_ns": window_start_ns,
-            "window_end_ns": window_end_ns,
-            "unsub_ts": unsub_ts,
-            "crashed": crashed,
-        })
+    rows = batched_lane_rows(
+        agent, steps=steps, episodes_per_lane=[episodes] * n_lanes,
+        receipts=receipts, sub_ts=sub_ts, window_start_ns=window_start_ns,
+        window_end_ns=window_end_ns, unsub_ts=time.monotonic_ns(),
+        crashed=crashed)
     # Chaos accounting rides the lane-0 row (ONE spool per connection
     # covering all lanes — sent_counts is keyed per lane id already).
+    chaos_finish(agent, rows[0], cfg)
+    agent.disable_agent()
+    return rows
+
+
+def anakin_host_loop(cfg: dict) -> list[dict]:
+    """Anakin mode: one VectorAgent hosting ``agents_per_proc`` lanes of
+    an ON-DEVICE env, driven by fused rollout windows until the deadline.
+    Result rows mirror vector mode (one per logical agent; shared
+    subscription's receipts on lane 0), plus per-window dispatch/unstack
+    timing aggregates so the committed soak row separates device compute
+    from host unstack from transport."""
+    from relayrl_tpu.runtime.agent import VectorAgent
+
+    n_lanes = cfg["agents_per_proc"]
+    ident = f"soak-{cfg['worker_id']}-anakin"
+    addr_overrides = transport_addr_overrides(cfg)
+    agent = VectorAgent(
+        num_envs=n_lanes,
+        model_path=os.path.join(cfg["scratch"], f"model_{ident}.msgpack"),
+        config_path=cfg.get("config_path"),
+        seed=cfg["worker_id"] * 1000,
+        handshake_timeout_s=cfg["handshake_timeout_s"],
+        server_type=cfg.get("server_type", "zmq"),
+        identity=ident,
+        host_mode="anakin",
+        jax_env=cfg.get("jax_env", "CartPole-v1"),
+        unroll_length=cfg.get("unroll_length", 32),
+        **addr_overrides,
+    )
+    receipts: list[tuple[int, int]] = []
+    sub_ts = time.monotonic_ns()
+    has_ledger = install_receipt_probe(agent, receipts)
+
+    start_barrier_wait(cfg, ident, publish_ready=True)
+    window_start_ns = time.monotonic_ns()
+    deadline = time.time() + cfg["duration_s"]
+    crashed = None
+    windows = 0
+    dispatch_s = unstack_s = 0.0
+    try:
+        while time.time() < deadline:
+            stats = agent.rollout()
+            windows += 1
+            dispatch_s += stats["dispatch_s"]
+            unstack_s += stats["unstack_s"]
+    except Exception as e:
+        crashed = repr(e)
+    window_end_ns = time.monotonic_ns()
+    drain_receipt_grace(agent.transport, receipts, has_ledger,
+                        cfg.get("receipt_grace_s", 8.0))
+    rows = batched_lane_rows(
+        agent, steps=windows * agent.unroll_length,
+        episodes_per_lane=[len(r) for r in agent.host.episode_returns],
+        receipts=receipts, sub_ts=sub_ts, window_start_ns=window_start_ns,
+        window_end_ns=window_end_ns, unsub_ts=time.monotonic_ns(),
+        crashed=crashed)
+    # Engine-plane timing evidence rides the lane-0 row (one engine per
+    # connection, like the spool accounting in chaos mode).
+    rows[0]["anakin"] = {
+        "windows": windows, "unroll_length": agent.unroll_length,
+        "dispatch_s_total": round(dispatch_s, 4),
+        "unstack_s_total": round(unstack_s, 4),
+    }
     chaos_finish(agent, rows[0], cfg)
     agent.disable_agent()
     return rows
@@ -364,6 +445,11 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     chaos_setup(cfg)
 
+    if cfg.get("anakin"):
+        rows = anakin_host_loop(cfg)
+        with open(cfg["result_path"], "w") as f:
+            json.dump({"worker_id": cfg["worker_id"], "agents": rows}, f)
+        return
     if cfg.get("vector"):
         rows = vector_host_loop(cfg)
         with open(cfg["result_path"], "w") as f:

@@ -1,0 +1,186 @@
+"""Fused on-device rollout (runtime/anakin.py) vs the host-bound vector
+actor — the rollout-plane shootout behind the anakin tier's headline.
+
+Apples to apples: SAME policy arch, SAME env dynamics (CartPole), SAME
+lane count, both in-process with a no-op send hook (no transport — the
+transport-inclusive picture is bench_soak --anakin). Three rates per
+configuration:
+
+* ``vector``: env-steps/s of VectorActorHost + SyncVectorEnv — one
+  batched jitted policy dispatch per env step, numpy env loop per lane,
+  per-step ActionRecord assembly. The host-bound ceiling being attacked.
+* ``anakin rollout``: window production rate of the fused dispatch alone
+  (device compute; steps / Σ dispatch_s) — how fast trajectories are
+  PRODUCED on-device. This is the Podracer number and the committed
+  headline ratio.
+* ``anakin e2e``: steps / wall including the host unstack + wire codec —
+  what a driver process actually sustains. The gap between this and the
+  rollout rate is pure host-side unstack/serialize cost, reported
+  separately because it is the NEXT bottleneck (per-step Python record
+  assembly), not a property of the fused dispatch.
+
+The scaling curve sweeps unroll_length × lanes: the dispatch amortizes
+with unroll (until the window outgrows cache) and batches with lanes;
+the vector baseline only batches with lanes.
+
+Writes ``results/anakin_rollout.json`` with --write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from common import bench_cwd, emit, quick, setup_platform
+
+setup_platform()
+
+
+def _bundle(obs_dim=4, act_dim=2, hidden=(32, 32)):
+    import jax
+
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    arch = {"kind": "mlp_discrete", "obs_dim": obs_dim, "act_dim": act_dim,
+            "hidden_sizes": list(hidden)}
+    policy = build_policy(arch)
+    return ModelBundle(version=0, arch=arch,
+                       params=policy.init_params(jax.random.PRNGKey(0)))
+
+
+def run_vector_baseline(lanes: int, min_steps: int = 4000,
+                        min_wall_s: float = 2.0) -> dict:
+    """Host-bound reference: VectorActorHost over SyncVectorEnv CartPole,
+    measured over whole run_vector_gym_loop batches (includes the numpy
+    env loop and per-step record assembly — the real per-step cost a
+    driver pays on this path)."""
+    from relayrl_tpu.envs import CartPoleEnv, SyncVectorEnv
+    from relayrl_tpu.runtime.vector_actor import (
+        VectorActorHost,
+        run_vector_gym_loop,
+    )
+
+    sink = []
+    host = VectorActorHost(_bundle(), num_envs=lanes,
+                           on_send=lambda lane, p: sink.append(len(p)))
+    venv = SyncVectorEnv([CartPoleEnv for _ in range(lanes)])
+    run_vector_gym_loop(host, venv, steps=32, seed=0)  # warmup + compile
+    steps = total = 0
+    t0 = time.perf_counter()
+    while total < min_steps or time.perf_counter() - t0 < min_wall_s:
+        chunk = 256
+        run_vector_gym_loop(host, venv, steps=chunk, seed=None)
+        steps += chunk
+        total += chunk * lanes
+    wall = time.perf_counter() - t0
+    return {"lanes": lanes, "env_steps_total": total,
+            "env_steps_per_sec": round(total / wall, 1),
+            "payloads": len(sink)}
+
+
+def run_anakin(lanes: int, unroll: int, min_steps: int = 20000,
+               min_wall_s: float = 2.0) -> dict:
+    """Fused rollout at (lanes, unroll): dispatch-plane rate (device) and
+    e2e rate (incl. unstack + wire)."""
+    from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+    sink = []
+    host = AnakinActorHost(_bundle(), "CartPole-v1", num_envs=lanes,
+                           unroll_length=unroll,
+                           on_send=lambda lane, p: sink.append(len(p)),
+                           seed=0)
+    host.rollout()  # warmup + compile
+    total = windows = 0
+    dispatch_s = unstack_s = 0.0
+    t0 = time.perf_counter()
+    while total < min_steps or time.perf_counter() - t0 < min_wall_s:
+        stats = host.rollout()
+        total += stats["steps"]
+        windows += 1
+        dispatch_s += stats["dispatch_s"]
+        unstack_s += stats["unstack_s"]
+    wall = time.perf_counter() - t0
+    return {
+        "lanes": lanes, "unroll_length": unroll,
+        "windows": windows, "env_steps_total": total,
+        "rollout_steps_per_sec": round(total / dispatch_s, 1),
+        "e2e_steps_per_sec": round(total / wall, 1),
+        "dispatch_ms_per_window": round(1e3 * dispatch_s / windows, 3),
+        "unstack_ms_per_window": round(1e3 * unstack_s / windows, 3),
+        "payloads": len(sink),
+    }
+
+
+def main():
+    bench_cwd()
+    is_quick = quick()
+    lanes_grid = [4, 16] if is_quick else [4, 16, 64]
+    unroll_grid = [8, 32] if is_quick else [8, 32, 128, 512]
+    rows = []
+
+    vector_rates: dict[int, float] = {}
+    for lanes in lanes_grid:
+        row = run_vector_baseline(
+            lanes, min_steps=1000 if is_quick else 4000,
+            min_wall_s=0.5 if is_quick else 2.0)
+        vector_rates[lanes] = row["env_steps_per_sec"]
+        emit("anakin_vector_baseline", {"lanes": lanes},
+             row["env_steps_per_sec"], "env_steps/s")
+        rows.append({"bench": "anakin_vector_baseline", **row})
+
+    best = None
+    for lanes in lanes_grid:
+        for unroll in unroll_grid:
+            row = run_anakin(
+                lanes, unroll, min_steps=2000 if is_quick else 20000,
+                min_wall_s=0.5 if is_quick else 2.0)
+            row["speedup_rollout_vs_vector"] = round(
+                row["rollout_steps_per_sec"] / vector_rates[lanes], 1)
+            row["speedup_e2e_vs_vector"] = round(
+                row["e2e_steps_per_sec"] / vector_rates[lanes], 1)
+            emit("anakin_fused_rollout",
+                 {"lanes": lanes, "unroll": unroll},
+                 row["rollout_steps_per_sec"], "env_steps/s")
+            rows.append({"bench": "anakin_fused_rollout", **row})
+            if best is None or (row["rollout_steps_per_sec"]
+                                > best["rollout_steps_per_sec"]):
+                best = row
+
+    headline = {
+        "bench": "anakin_headline",
+        "config": {"env": "CartPole-v1", "policy": "mlp_discrete 32x32",
+                   "host_cores": os.cpu_count(),
+                   "comparison": "equal lane count, in-process, no "
+                                 "transport on either side"},
+        "vector_env_steps_per_sec": vector_rates,
+        "best_rollout": best,
+        # The acceptance ratio: fused window production vs the host-bound
+        # vector actor at the SAME lane count.
+        "speedup_rollout_at_equal_lanes": {
+            str(lanes): round(
+                max(r["rollout_steps_per_sec"] for r in rows
+                    if r["bench"] == "anakin_fused_rollout"
+                    and r["lanes"] == lanes) / vector_rates[lanes], 1)
+            for lanes in lanes_grid},
+        "note": ("e2e rate is bounded by the host unstack (per-step "
+                 "Python record assembly + msgpack) — the next "
+                 "bottleneck after this PR, reported honestly in every "
+                 "row as unstack_ms_per_window"),
+    }
+    print(json.dumps(headline))
+    rows.append(headline)
+
+    if "--write" in sys.argv:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "anakin_rollout.json")
+        with open(out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,15 +69,34 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
              traj_per_epoch: int = 64, algorithm: str = "REINFORCE",
-             transport: str = "zmq", vector: bool = False) -> dict:
+             transport: str = "zmq", vector: bool = False,
+             anakin: bool = False, unroll_length: int = 32,
+             jax_env: str = "CartPole-v1") -> dict:
     """``vector=True`` runs the fleet as vector actor hosts: each worker
     process is ONE VectorAgent stepping ``agents_per_proc`` logical
     agents through a single batched jitted policy dispatch (the
     ``actor.host_mode="vector"`` topology) — n_actors stays the number of
     LOGICAL agents the server sees, so rows are directly comparable with
-    process-per-actor rows at the same n_actors."""
+    process-per-actor rows at the same n_actors.
+
+    ``anakin=True`` runs the fleet as FUSED on-device rollout hosts
+    (``actor.host_mode="anakin"``, runtime/anakin.py): the env itself
+    (``jax_env``) steps inside one jit(vmap(lax.scan)) dispatch per
+    [lanes, unroll_length] window. Unlike the other two modes there is no
+    synthetic env — obs/act dims come from the real on-device env, so the
+    server model is sized to it and per-agent episode counts reflect real
+    (autoreset) episode boundaries. Rows stay comparable on the transport
+    plane: n_actors logical agents, per-lane attribution, the same SLO
+    fields."""
     from relayrl_tpu.runtime.server import TrainingServer
 
+    if anakin:
+        from relayrl_tpu.envs.jax import make_jax
+
+        env_probe = make_jax(jax_env)
+        obs_dim = env_probe.obs_dim
+        act_dim = int(getattr(env_probe.action_space, "n", 0)
+                      or env_probe.action_space.shape[0])
     _fresh_bench_registry(f"soak-{transport}-{n_actors}")
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
@@ -189,7 +208,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             # host, and a worker's SUB threads may see nothing until the
             # last stragglers stop competing for the GIL.
             "receipt_grace_s": max(8.0, n_actors / 10.0),
-            "result_path": result_path, "vector": vector, **worker_addrs,
+            "result_path": result_path, "vector": vector,
+            "anakin": anakin, "unroll_length": unroll_length,
+            "jax_env": jax_env, **worker_addrs,
         }
         procs.append(subprocess.Popen(
             [sys.executable,
@@ -237,6 +258,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
 
     total_steps = sum(a["steps"] for a in agents)
     total_episodes = sum(a["episodes"] for a in agents)
+    # Anakin engine-plane aggregates (lane-0 rows carry one entry per
+    # worker): how much of the wall was device compute vs host unstack.
+    anakin_rows = [a["anakin"] for a in agents if a.get("anakin")]
     # Window alignment: with the start barrier the per-agent measured
     # windows should span ~duration_s; the span reports how true that is
     # (it replaces wall_s as the honesty metric — wall_s now measures
@@ -269,13 +293,17 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                  if v in pub_times and _counts(a, pub_times[v])]
     expected = sum(1 for _, pub_ns in publishes for a in agents
                    if _counts(a, pub_ns))
+    mode = "anakin" if anakin else "vector" if vector else "process"
     result = {
         "bench": (f"soak_multi_actor_{transport}"
-                  + ("_vector" if vector else "")),
+                  + ("" if mode == "process" else f"_{mode}")),
         "config": {"actors": n_actors, "algorithm": algorithm,
                    "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
-                   "mode": "vector" if vector else "process",
+                   "mode": mode,
+                   **({"unroll_length": unroll_length, "jax_env": jax_env,
+                       "obs_dim": obs_dim, "act_dim": act_dim}
+                      if anakin else {}),
                    "processes": n_procs,
                    "agents_per_proc": agents_per_proc,
                    "host_cores": os.cpu_count()},
@@ -287,6 +315,13 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                                    if agents else 0),
         "env_steps_total": total_steps,
         "env_steps_per_sec": round(total_steps / mean_window_s, 1),
+        **({"anakin_engine": {
+            "windows": sum(r["windows"] for r in anakin_rows),
+            "dispatch_s_total": round(sum(r["dispatch_s_total"]
+                                          for r in anakin_rows), 3),
+            "unstack_s_total": round(sum(r["unstack_s_total"]
+                                         for r in anakin_rows), 3),
+        }} if anakin_rows else {}),
         "mean_window_s": round(mean_window_s, 1),
         "episodes_total": total_episodes,
         "server_stats": stats,
@@ -1079,6 +1114,7 @@ def _write_results(outfile: str, lines: list[dict]) -> None:
 def main():
     quick = "--quick" in sys.argv
     vector = "--vector" in sys.argv
+    anakin = "--anakin" in sys.argv
     bench_cwd()
     transport = ("native" if "--native" in sys.argv
                  else "grpc" if "--grpc" in sys.argv else "zmq")
@@ -1129,20 +1165,21 @@ def main():
         # the two curves' 64-actor rows face off directly: process mode
         # fork-bombs the host there; vector mode makes it a batch width.
         rows = []
+        batched = vector or anakin
         for n in ([4, 16] if quick else [4, 8, 16, 32, 64]):
             r = run_soak(n_actors=n,
-                         agents_per_proc=min(16, n) if vector else min(8, n),
+                         agents_per_proc=min(16, n) if batched else min(8, n),
                          duration_s=10.0 if quick else 20.0,
-                         transport=transport, vector=vector)
+                         transport=transport, vector=vector, anakin=anakin)
             print(json.dumps(r))
             assert r["server_stats"]["dropped"] == 0
             assert r["agents_crashed"] == 0
             assert r["agents_completed"] == n, "fleet silently shrank"
             rows.append(r)
         if "--write" in sys.argv:
+            suffix = "_anakin" if anakin else "_vector" if vector else ""
             _write_results(
-                f"soak_scaling_{transport}"
-                + ("_vector" if vector else "") + ".json", rows)
+                f"soak_scaling_{transport}{suffix}.json", rows)
         return
     if "--blast-one" in sys.argv:
         # Subprocess worker for run_blast_matrix: one isolated row.
@@ -1154,6 +1191,15 @@ def main():
         return
     if "--blast" in sys.argv:
         run_blast_matrix(quick)
+        return
+    if anakin:
+        # The fused-rollout e2e row: 64 logical agents as 4 processes x
+        # 16 on-device lanes (quick: 8 as 2x4), real CartPole episodes.
+        result = run_soak(n_actors=8 if quick else 64,
+                          agents_per_proc=4 if quick else 16,
+                          duration_s=8.0 if quick else 30.0,
+                          transport=transport, anakin=True)
+        _finish(result, f"soak64_{transport}_anakin.json")
         return
     if vector:
         # The north-star row as a configuration: 64 logical agents in 4

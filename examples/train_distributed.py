@@ -52,7 +52,8 @@ _ENV_IDS = {"cartpole": "CartPole-v1",
 
 def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
                episodes: int, max_steps: int, greedy_eval: int, queue,
-               eval_barrier, num_envs: int = 1):
+               eval_barrier, num_envs: int = 1, host_mode: str = "process",
+               unroll_length: int = 32):
     from relayrl_tpu.utils.hostpin import pin_cpu
 
     pin_cpu()  # actors are CPU hosts
@@ -71,7 +72,29 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
             exporter = telemetry.serve(port=0)
             print(f"[actor {tag}] telemetry at {exporter.url}", flush=True)
 
-    if num_envs > 1:
+    if host_mode == "anakin":
+        # Fused on-device topology (actor.host_mode="anakin"): the env
+        # runs as pure JAX inside the policy dispatch; each rollout()
+        # produces a [num_envs, unroll_length] trajectory window. The
+        # server-side view (N logical agents, N streams) is identical to
+        # vector mode.
+        from relayrl_tpu.runtime.agent import VectorAgent
+
+        agent = VectorAgent(num_envs=num_envs, server_type=server_type,
+                            seed=idx, host_mode="anakin",
+                            jax_env=_ENV_IDS[env_id],
+                            unroll_length=unroll_length, **agent_addrs)
+        _serve_actor_telemetry(f"{idx} anakin")
+        t0 = time.time()
+        while min(len(r) for r in agent.host.episode_returns) < episodes:
+            agent.rollout()
+        train_s = time.time() - t0
+        queue.put((idx, [ret for lane in agent.host.episode_returns
+                         for ret in lane],
+                   agent.model_version, [], train_s))
+        agent.disable_agent()
+        return
+    if num_envs > 1 or host_mode == "vector":
         # Vector topology (actor.host_mode="vector" / --num-envs): this
         # process hosts num_envs logical agents behind one batched jitted
         # policy step; ``episodes`` stays the per-LANE target so rows are
@@ -80,8 +103,11 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
         from relayrl_tpu.runtime.agent import VectorAgent
         from relayrl_tpu.runtime.vector_actor import run_vector_gym_loop
 
+        # host_mode is pinned explicitly: VectorAgent falls back to config
+        # actor.host_mode, so a config saying "anakin" would otherwise
+        # override the driver's resolved vector topology.
         agent = VectorAgent(num_envs=num_envs, server_type=server_type,
-                            seed=idx, **agent_addrs)
+                            seed=idx, host_mode="vector", **agent_addrs)
         _serve_actor_telemetry(f"{idx} vector")
         venv = make_vector(_ENV_IDS[env_id], num_envs)
         t0 = time.time()
@@ -131,7 +157,17 @@ def main():
                     help="env lanes per actor process (vector host, "
                          "runtime/vector_actor.py); default comes from "
                          "config actor.num_envs when actor.host_mode is "
-                         "\"vector\", else 1 (process mode)")
+                         "\"vector\" or \"anakin\", else 1 (process mode)")
+    ap.add_argument("--host-mode", default=None,
+                    choices=["process", "vector", "anakin"],
+                    help="actor topology override: \"anakin\" fuses env + "
+                         "policy into one on-device lax.scan dispatch per "
+                         "[num-envs, unroll-length] window "
+                         "(runtime/anakin.py; the env must be in the JAX "
+                         "registry, envs.list_envs()['jax'])")
+    ap.add_argument("--unroll-length", type=int, default=None, metavar="U",
+                    help="anakin mode: env steps per lane per fused "
+                         "dispatch (default: config actor.unroll_length)")
     ap.add_argument("--episodes", type=int, default=200,
                     help="episodes PER actor (per lane in vector mode)")
     ap.add_argument("--max-steps", type=int, default=500)
@@ -196,12 +232,25 @@ def main():
     from relayrl_tpu.config import ConfigLoader
 
     actor_params = ConfigLoader(create_if_missing=False).get_actor_params()
+    host_mode = (args.host_mode if args.host_mode is not None
+                 else actor_params["host_mode"])
     num_envs = (args.num_envs if args.num_envs is not None
                 else (actor_params["num_envs"]
-                      if actor_params["host_mode"] == "vector" else 1))
-    if num_envs > 1 and args.greedy_eval > 0:
-        print("[driver] --greedy-eval ignored in vector mode (no batched "
-              "greedy path)", flush=True)
+                      if host_mode in ("vector", "anakin") else 1))
+    if host_mode == "process" and num_envs > 1:
+        host_mode = "vector"  # --num-envs N>1 implies the vector host
+    unroll_length = (args.unroll_length if args.unroll_length is not None
+                     else actor_params["unroll_length"])
+    if host_mode == "anakin":
+        from relayrl_tpu.envs import list_envs
+
+        if _ENV_IDS[args.env] not in list_envs()["jax"]:
+            raise SystemExit(
+                f"--host-mode anakin needs an env in the JAX registry "
+                f"(envs.list_envs()['jax']); {args.env!r} is host-only")
+    if host_mode != "process" and args.greedy_eval > 0:
+        print(f"[driver] --greedy-eval ignored in {host_mode} mode (no "
+              "batched greedy path)", flush=True)
 
     server = TrainingServer(
         args.algo, obs_dim=obs_dim, act_dim=act_dim,
@@ -215,7 +264,8 @@ def main():
         ctx.Process(target=actor_proc,
                     args=(i, args.transport, agent_addrs, args.env,
                           args.episodes, args.max_steps, args.greedy_eval,
-                          queue, eval_barrier, num_envs))
+                          queue, eval_barrier, num_envs, host_mode,
+                          unroll_length))
         for i in range(args.actors)
     ]
     for p in procs:
@@ -256,7 +306,7 @@ def main():
           f"{elapsed:.1f}s ({total_eps / elapsed:.1f} eps/s); final returns "
           f"per actor: {[round(x, 1) for x in last]}; server version "
           f"{server.algorithm.version}", flush=True)
-    if args.greedy_eval > 0:
+    if args.greedy_eval > 0 and host_mode == "process":
         greedy = [g for _, _, _, gs, _ in results for g in gs]
         print(f"[distributed] greedy eval ({args.greedy_eval} eps/actor): "
               f"avg {sum(greedy) / len(greedy):.1f}  "

@@ -164,11 +164,26 @@ DEFAULT_CONFIG: dict = {
         # as e.g. 4 processes x 16 lanes instead of 64 processes.
         "num_envs": 1,
         # "process" = one Agent per env (reference parity);
-        # "vector" = VectorAgent host stepping num_envs lanes.
+        # "vector" = VectorAgent host stepping num_envs lanes;
+        # "anakin" = fused on-device rollout (runtime/anakin.py): the env
+        # itself runs as pure JAX (actor.jax_env) and one
+        # jit(vmap(lax.scan)) dispatch produces num_envs x unroll_length
+        # env steps — the fastest tier, for envs in the JAX registry.
         # examples/train_distributed.py reads it to pick the actor
         # topology (--num-envs overrides); benches/bench_soak.py's
-        # --vector flag is the bench-plane equivalent.
+        # --vector/--anakin flags are the bench-plane equivalents.
         "host_mode": "process",
+        # -- anakin tier (actor.host_mode: "anakin") --
+        # Env steps per lane per fused dispatch: each dispatch returns a
+        # [num_envs, unroll_length] trajectory window. Bigger amortizes
+        # the dispatch further but widens the model-staleness window (a
+        # hot-swap lands between windows, never inside one) and the
+        # host-side unstack burst. 32 is past the knee of the committed
+        # scaling curve (benches/results/anakin_rollout.json).
+        "unroll_length": 32,
+        # On-device env id for the anakin tier, resolved through the JAX
+        # env registry (envs/jax/__init__.py; see envs.list_envs()).
+        "jax_env": "CartPole-v1",
         # -- trajectory spool (runtime/spool.py, crash-recovery plane) --
         # Outbound trajectories are retained in a bounded window and
         # replayed on reconnect; the server's sequence-number dedup makes

@@ -190,7 +190,8 @@ class ConfigLoader:
         return params
 
     def get_actor_params(self) -> dict[str, Any]:
-        """Actor-plane knobs (``actor.num_envs`` / ``actor.host_mode``),
+        """Actor-plane knobs (``actor.num_envs`` / ``actor.host_mode`` /
+        the anakin pair ``actor.unroll_length`` + ``actor.jax_env``),
         defaults merged under user overrides like every other section —
         malformed values degrade to the one-env-per-process default."""
         params = dict(DEFAULT_CONFIG["actor"])
@@ -199,8 +200,16 @@ class ConfigLoader:
             params["num_envs"] = max(1, int(params.get("num_envs", 1)))
         except (TypeError, ValueError):
             params["num_envs"] = 1
-        if params.get("host_mode") not in ("process", "vector"):
+        if params.get("host_mode") not in ("process", "vector", "anakin"):
             params["host_mode"] = "process"
+        try:
+            params["unroll_length"] = max(1, int(
+                params.get("unroll_length", 32)))
+        except (TypeError, ValueError):
+            params["unroll_length"] = 32
+        jax_env = params.get("jax_env")
+        params["jax_env"] = (str(jax_env) if jax_env
+                             else DEFAULT_CONFIG["actor"]["jax_env"])
         try:
             # 0 legitimately disables the spool; negatives clamp to 0.
             params["spool_entries"] = max(0, int(

@@ -27,6 +27,37 @@ _BUILTIN = {
 }
 
 
+def list_envs() -> dict[str, list[str]]:
+    """One view of every env the framework can resolve, keyed by plane:
+
+    * ``"builtin"`` — the host-side numpy built-ins (``envs.make``).
+    * ``"jax"`` — the on-device pure-JAX registry (``envs.make_jax``, the
+      fused-rollout plane of ``runtime/anakin.py``); empty on hosts
+      without jax installed.
+    * ``"gymnasium"`` — installed Gymnasium ids when the package is
+      importable (the full registry, typically hundreds of ids; callers
+      that print it should summarize, as ``make``'s error message does).
+
+    The JAX subpackage imports lazily so ``relayrl_tpu.envs`` stays
+    jax-free for host-only consumers (same reason the built-ins are pure
+    numpy)."""
+    try:
+        from relayrl_tpu.envs.jax import JAX_ENVS
+
+        jax_ids = sorted(JAX_ENVS)
+    except ImportError:  # host-only consumer: no on-device plane
+        jax_ids = []
+
+    out = {"builtin": sorted(_BUILTIN), "jax": jax_ids}
+    try:
+        import gymnasium
+
+        out["gymnasium"] = sorted(gymnasium.registry)
+    except ImportError:
+        pass
+    return out
+
+
 def make(env_id: str, **kwargs):
     """Create an env by id — Gymnasium if installed, else the built-in."""
     try:
@@ -40,12 +71,25 @@ def make(env_id: str, **kwargs):
         return gymnasium.make(env_id, **kwargs)
     if env_id in _BUILTIN:
         return _BUILTIN[env_id](**kwargs)
+    known = list_envs()
+    gym_note = ("" if gymnasium else " [gymnasium not installed]")
     raise ValueError(
-        f"unknown env {env_id!r} (not in gymnasium{'' if gymnasium else ' [not installed]'}); "
-        f"built-ins: {sorted(_BUILTIN)}"
+        f"unknown env {env_id!r}{gym_note}; built-ins: {known['builtin']}, "
+        f"on-device (jax): {known['jax']}"
+        + (f", gymnasium: {len(known['gymnasium'])} ids"
+           if "gymnasium" in known else "")
     )
 
 
-__all__ = ["make", "make_atari", "AtariPreprocessing", "SyntheticPixelEnv",
+def make_jax(env_id: str, **kwargs):
+    """Create an on-device pure-JAX env by id (lazy import: keeps plain
+    ``import relayrl_tpu.envs`` free of the jax dependency)."""
+    from relayrl_tpu.envs.jax import make_jax as _make_jax
+
+    return _make_jax(env_id, **kwargs)
+
+
+__all__ = ["make", "make_jax", "list_envs", "make_atari",
+           "AtariPreprocessing", "SyntheticPixelEnv",
            "CartPoleEnv", "PendulumEnv", "RecallEnv", "Box", "Discrete",
            "SyncVectorEnv", "make_vector"]

@@ -1,0 +1,37 @@
+"""On-device environment registry (pure-JAX twins of the built-ins).
+
+Entries here are first-class registry citizens alongside the numpy
+built-ins: ``envs.list_envs()`` folds both in, ``envs.make_jax`` resolves
+an id to a :class:`~relayrl_tpu.envs.jax.base.JaxEnv` instance, and the
+fused rollout engine (``runtime/anakin.py``, ``actor.jax_env`` knob) looks
+envs up through this one table. Ids deliberately match the host twins so a
+config can flip ``actor.host_mode`` between ``"vector"`` and ``"anakin"``
+without renaming the task.
+"""
+
+from relayrl_tpu.envs.jax.base import JaxEnv, step_autoreset, tree_where
+from relayrl_tpu.envs.jax.cartpole import CartPoleState, JaxCartPole
+from relayrl_tpu.envs.jax.pendulum import JaxPendulum, PendulumState
+from relayrl_tpu.envs.jax.recall import JaxRecall, RecallState
+
+JAX_ENVS = {
+    "CartPole-v1": JaxCartPole,
+    "Pendulum-v1": JaxPendulum,
+    "Recall-v0": JaxRecall,
+}
+
+
+def make_jax(env_id: str, **kwargs) -> JaxEnv:
+    """Create an on-device env by id (the JAX-side ``envs.make``)."""
+    if env_id not in JAX_ENVS:
+        from relayrl_tpu.envs import list_envs
+
+        raise ValueError(
+            f"unknown JAX env {env_id!r}; on-device envs: "
+            f"{sorted(JAX_ENVS)} (full registry: {list_envs()})")
+    return JAX_ENVS[env_id](**kwargs)
+
+
+__all__ = ["JaxEnv", "JAX_ENVS", "make_jax", "step_autoreset", "tree_where",
+           "JaxCartPole", "CartPoleState", "JaxPendulum", "PendulumState",
+           "JaxRecall", "RecallState"]

@@ -31,10 +31,14 @@ class SyncVectorEnv:
         self.num_envs = len(self.envs)
         self.observation_space = self.envs[0].observation_space
         self.action_space = self.envs[0].action_space
+        self._base_seed: int | None = None
+        self._episode = [0] * self.num_envs  # per-lane episode index
 
     def reset(self, seed: int | None = None):
         """Reset every lane; per-lane seeds are ``seed + lane`` so lanes
         decorrelate while the whole stack stays reproducible."""
+        self._base_seed = None if seed is None else int(seed)
+        self._episode = [0] * self.num_envs
         obs_rows, infos = [], []
         for lane, env in enumerate(self.envs):
             obs, info = env.reset(
@@ -43,21 +47,36 @@ class SyncVectorEnv:
             infos.append(info)
         return np.stack(obs_rows), infos
 
+    def _autoreset_seed(self, lane: int) -> int | None:
+        """Derived per-lane seed for episode ``e`` of lane ``k``:
+        ``base + k + num_envs * e`` — episode 0 is exactly ``reset(seed)``'s
+        ``seed + lane`` contract, and the stride keeps every (lane,
+        episode) seed distinct, so a seeded vector stack is reproducible
+        across its WHOLE run, not just the first episode per lane.
+        Unseeded stacks keep the old behavior (entropy-seeded resets)."""
+        if self._base_seed is None:
+            return None
+        return self._base_seed + lane + self.num_envs * self._episode[lane]
+
     def step(self, actions):
         """Step every lane; finished lanes autoreset in place.
 
         Returns ``(obs[N,...], rewards[N], terminated[N], truncated[N],
         infos)`` where a finished lane's ``obs`` row is already the reset
         observation of its NEXT episode and its info dict carries
-        ``final_observation`` (the pre-reset obs).
+        ``final_observation`` (the pre-reset obs) plus ``reset_info``
+        (the info dict of the autoreset — previously discarded, which
+        lost e.g. Gymnasium envs' reset-time seeds/options echo).
         """
         obs_rows, rewards, terms, truncs, infos = [], [], [], [], []
-        for env, action in zip(self.envs, actions):
+        for lane, (env, action) in enumerate(zip(self.envs, actions)):
             obs, reward, terminated, truncated, info = env.step(action)
             if terminated or truncated:
                 info = dict(info)
                 info["final_observation"] = np.asarray(obs)
-                obs, _ = env.reset()
+                self._episode[lane] += 1
+                obs, reset_info = env.reset(seed=self._autoreset_seed(lane))
+                info["reset_info"] = reset_info
             obs_rows.append(np.asarray(obs))
             rewards.append(reward)
             terms.append(bool(terminated))

@@ -281,6 +281,16 @@ class VectorAgent:
     context manager/``model_version``); the action surface is batched
     (``request_for_actions`` / per-lane ``flag_last_action``) because
     that is the point.
+
+    ``host_mode="anakin"`` (or config ``actor.host_mode: "anakin"``)
+    swaps the per-step batched host for the fused on-device rollout
+    engine (:class:`~relayrl_tpu.runtime.anakin.AnakinActorHost`): the
+    env itself runs as pure JAX (``actor.jax_env``) and the action
+    surface becomes :meth:`rollout` — one dispatch per
+    ``num_envs × actor.unroll_length`` window. Everything network-side
+    is IDENTICAL: N logical lane registrations, N attributed trajectory
+    streams through the same spool, one model subscription, one atomic
+    swap gate — the server cannot tell the tiers apart.
     """
 
     def __init__(
@@ -293,6 +303,9 @@ class VectorAgent:
         seed: int | None = None,
         start: bool = True,
         identity: str | None = None,
+        host_mode: str | None = None,
+        jax_env: str | None = None,
+        unroll_length: int | None = None,
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
@@ -305,6 +318,16 @@ class VectorAgent:
                             else actor_params.get("num_envs", 1))
         if self.num_envs < 1:
             raise ValueError(f"num_envs must be >= 1, got {self.num_envs}")
+        self.host_mode = str(host_mode if host_mode is not None
+                             else actor_params["host_mode"])
+        if self.host_mode not in ("vector", "anakin"):
+            # A VectorAgent *is* the vector topology; "process" configs
+            # constructing one explicitly just mean the batched default.
+            self.host_mode = "vector"
+        self.jax_env = str(jax_env if jax_env is not None
+                           else actor_params["jax_env"])
+        self.unroll_length = int(unroll_length if unroll_length is not None
+                                 else actor_params["unroll_length"])
         self.server_type = server_type
         self._addr_overrides = addr_overrides
         self._identity = identity
@@ -347,13 +370,26 @@ class VectorAgent:
                           for k in range(self.num_envs)]
         _bind_spool_impl(self, self._identity or "vector")
         if self.host is None:
-            self.host = VectorActorHost(
-                bundle,
-                num_envs=self.num_envs,
-                max_traj_length=self.config.get_max_traj_length(),
-                on_send=self._send_lane,
-                seed=self._seed,
-            )
+            if self.host_mode == "anakin":
+                from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+                self.host = AnakinActorHost(
+                    bundle,
+                    env=self.jax_env,
+                    num_envs=self.num_envs,
+                    unroll_length=self.unroll_length,
+                    max_traj_length=self.config.get_max_traj_length(),
+                    on_send=self._send_lane,
+                    seed=self._seed,
+                )
+            else:
+                self.host = VectorActorHost(
+                    bundle,
+                    num_envs=self.num_envs,
+                    max_traj_length=self.config.get_max_traj_length(),
+                    on_send=self._send_lane,
+                    seed=self._seed,
+                )
         else:
             self.host.maybe_swap(bundle)
         # One registration round-trip per logical lane, all over the one
@@ -397,14 +433,36 @@ class VectorAgent:
     # -- batched action API --
     def request_for_actions(self, obs, masks=None, rewards=None):
         self._require_active()
+        if self.host_mode == "anakin":
+            raise RuntimeError(
+                "anakin host: the env steps on-device inside rollout() — "
+                "there is no per-step action request surface")
         return self.host.request_for_actions(obs, masks=masks,
                                              rewards=rewards)
+
+    # -- fused rollout API (host_mode="anakin") --
+    def rollout(self) -> dict:
+        """One fused ``[num_envs, unroll_length]`` on-device window:
+        dispatch + unstack into the N logical-agent trajectory streams
+        (see :meth:`AnakinActorHost.rollout`)."""
+        self._require_active()
+        if self.host_mode != "anakin":
+            raise RuntimeError(
+                "rollout() is the anakin-host surface; this agent runs "
+                f"host_mode={self.host_mode!r} (per-step "
+                "request_for_actions)")
+        return self.host.rollout()
 
     def flag_last_action(self, lane: int, reward: float = 0.0,
                          truncated: bool = False, final_obs=None,
                          terminated: bool | None = None,
                          final_mask=None) -> None:
         self._require_active()
+        if self.host_mode == "anakin":
+            raise RuntimeError(
+                "anakin host: episode boundaries happen in-scan "
+                "(autoreset) — terminal markers are emitted by the "
+                "window unstacker, not by the driver")
         self.host.flag_last_action(lane, reward, truncated=truncated,
                                    final_obs=final_obs,
                                    terminated=terminated,

@@ -1,0 +1,313 @@
+"""Fused on-device rollout engine — the Anakin tier of the actor plane.
+
+The vector actor host (``runtime/vector_actor.py``) batched the POLICY:
+one ``jit(vmap(step))`` dispatch serves N env lanes. But each lane's env
+still steps on the host, one Python call per step, so the system pays one
+device dispatch + one numpy env loop + one ActionRecord build *per env
+step* — ~30k env-steps/s end to end. The Podracer Anakin architecture
+(arXiv:2104.06272) fuses the other half: with env dynamics as pure JAX
+(``envs/jax/``), an entire ``[lanes, unroll]`` trajectory window becomes
+ONE dispatch of
+
+    jit(vmap_over_lanes(lax.scan(env.step ∘ policy.step)))
+
+with per-lane PRNG keys split from one seed, in-scan autoreset
+(``envs.jax.base.step_autoreset`` — lanes never leave the device between
+episodes), and the whole carry (keys + env states + observations) donated
+back to the next window. Amortized per env step, the dispatch cost tends
+to zero as ``unroll_length`` grows; the scaling curve lives in
+``benches/bench_anakin.py`` and the committed results row.
+
+The host side of the engine is an **unstacker**: one ``device_get`` of
+the stacked window, then a replay of the window into the existing
+per-lane :class:`~relayrl_tpu.types.trajectory.Trajectory` streams —
+byte-compatible with what a live ``VectorActorHost`` loop would have put
+on the wire (reward-credit placement, terminal markers,
+terminated-beats-truncated precedence, time-limit bootstrap
+observations), so the spool/sequence/transport plane and the server's
+ingest funnel work unchanged. This is a new fastest tier, not a
+replacement: the gym/vector paths remain for host-bound envs
+(Gymnasium, Atari) and external simulators.
+
+Model hot-swap shares the exact gates of the other two actor hosts
+(``apply_bundle_swap`` / ``apply_wire_swap`` — same attribute contract),
+and the fused step reads ``params`` once per window under the lock: every
+step of a window is computed by ONE model version by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from relayrl_tpu.envs.jax.base import JaxEnv, step_autoreset
+from relayrl_tpu.models import build_policy, validate_policy
+from relayrl_tpu.runtime.policy_actor import (
+    apply_bundle_swap,
+    apply_wire_swap,
+)
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle, exploration_kwargs
+from relayrl_tpu.types.trajectory import Trajectory
+
+
+def resolve_jax_env(env, **env_kwargs) -> JaxEnv:
+    """Env argument → :class:`JaxEnv` instance: ids go through the
+    on-device registry (``envs.jax.JAX_ENVS`` — the same table
+    ``envs.list_envs()`` reports), instances pass through."""
+    if isinstance(env, JaxEnv):
+        return env
+    from relayrl_tpu.envs.jax import make_jax
+
+    return make_jax(str(env), **env_kwargs)
+
+
+def make_fused_rollout(policy, env: JaxEnv, unroll_length: int):
+    """Build the one-dispatch window producer:
+
+    ``fn(params, explore, carry) -> (carry, window)`` where ``carry`` is
+    the stacked per-lane ``(policy_key, env_key, env_state, obs)`` and
+    ``window`` is a dict of ``[lanes, unroll, ...]`` arrays (obs, act,
+    rew, term, trunc, final_obs, aux). The policy composition per step is
+    exactly the vector host's (``split`` inside the trace, params
+    broadcast, exploration knobs as traced scalars so annealing never
+    retraces); the env composition is :func:`step_autoreset`, so episode
+    boundaries stay on-device. The carry is donated on accelerator
+    backends — the window producer is a ring, not an allocator.
+    """
+    def lane_rollout(params, explore, carry):
+        def body(c, _):
+            pkey, ekey, state, obs = c
+            pkey, sub = jax.random.split(pkey)
+            act, aux = policy.step(params, sub, obs, None, **explore)
+            (ekey, state, next_obs, rew, term, trunc,
+             final_obs) = step_autoreset(env, ekey, state, act)
+            out = {"obs": obs, "act": act, "rew": rew, "term": term,
+                   "trunc": trunc, "final_obs": final_obs, "aux": aux}
+            return (pkey, ekey, state, next_obs), out
+
+        return jax.lax.scan(body, carry, None, length=unroll_length)
+
+    vect = jax.vmap(lane_rollout, in_axes=(None, None, 0))
+    # Donation is honored on TPU/GPU; CPU hosts would warn per dispatch.
+    donate = (2,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(vect, donate_argnums=donate)
+
+
+class AnakinActorHost:
+    """N on-device env lanes × ``unroll_length`` steps per fused dispatch.
+
+    Same logical-agent surface as :class:`VectorActorHost` — N per-lane
+    trajectory streams through ``on_send(lane, payload)``, one atomic
+    model gate for all lanes — but the action API is :meth:`rollout`:
+    there is no per-step request because the env lives inside the
+    dispatch. ``rng_keys`` (stacked ``[N, 2]``) overrides the default
+    per-lane policy-key derivation, mirroring VectorActorHost's parity
+    hook.
+    """
+
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        env,
+        num_envs: int,
+        unroll_length: int = 32,
+        max_traj_length: int = 1000,
+        on_send=None,
+        seed: int = 0,
+        validate: bool = True,
+        rng_keys=None,
+        **env_kwargs,
+    ):
+        if num_envs < 1:
+            raise ValueError(f"num_envs must be >= 1, got {num_envs}")
+        if unroll_length < 1:
+            raise ValueError(
+                f"unroll_length must be >= 1, got {unroll_length}")
+        self._lock = threading.Lock()
+        self.num_envs = int(num_envs)
+        self.unroll_length = int(unroll_length)
+        self.env = resolve_jax_env(env, **env_kwargs)
+        self.arch = dict(bundle.arch)
+        obs_dim = int(self.arch["obs_dim"])
+        if obs_dim != self.env.obs_dim:
+            raise ValueError(
+                f"model obs_dim {obs_dim} != env obs_dim "
+                f"{self.env.obs_dim} — the fused rollout feeds the env's "
+                f"observation straight into the policy")
+        self.policy = build_policy(self.arch)
+        if validate:
+            validate_policy(self.policy, bundle.params)
+        if self.policy.step_window is not None:
+            raise ValueError(
+                "sequence policies are not supported by the fused rollout "
+                "engine yet (the scan carry would need the rolling window "
+                "pytree); use actor.host_mode=\"vector\"")
+        self.params = bundle.params
+        self.version = bundle.version
+        self._explore_kwargs = exploration_kwargs(self.arch)
+        self._wire_decoder = None  # one decoder, all lanes (see VectorActorHost)
+        self._rollout_fn = make_fused_rollout(
+            self.policy, self.env, self.unroll_length)
+
+        # Per-lane key derivation matches VectorActorHost (policy keys
+        # split from PRNGKey(seed)); env reset/autoreset keys come from an
+        # independent fold so policy and env streams never alias.
+        if rng_keys is not None:
+            keys = jnp.asarray(np.asarray(rng_keys))
+            if keys.shape[0] != self.num_envs:
+                raise ValueError(
+                    f"rng_keys has {keys.shape[0]} rows for "
+                    f"{self.num_envs} lanes")
+            pol_keys = keys
+        else:
+            pol_keys = jax.random.split(
+                jax.random.PRNGKey(seed), self.num_envs)
+        env_root = jax.random.fold_in(jax.random.PRNGKey(seed), 0x0E74)
+        reset_keys = jax.random.split(env_root, 2 * self.num_envs)
+        init_keys, carry_keys = (reset_keys[: self.num_envs],
+                                 reset_keys[self.num_envs:])
+        states, obs = jax.jit(jax.vmap(self.env.reset))(init_keys)
+        self._carry = (pol_keys, carry_keys, states, obs)
+
+        self.trajectories = [
+            Trajectory(
+                max_length=max_traj_length,
+                on_send=(None if on_send is None
+                         else (lambda payload, _lane=lane:
+                               on_send(_lane, payload))))
+            for lane in range(self.num_envs)
+        ]
+        # Per-lane episode accounting (drivers read these like
+        # run_vector_gym_loop's return value).
+        self._ep_ret = np.zeros(self.num_envs, np.float64)
+        self.episode_returns: list[list[float]] = [
+            [] for _ in range(self.num_envs)]
+
+        from relayrl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_steps = reg.counter(
+            "relayrl_actor_env_steps_total",
+            "policy steps served (one per env step per lane)")
+        self._m_dispatches = reg.counter(
+            "relayrl_actor_rollout_dispatches_total",
+            "fused rollout dispatches (each serves lanes x unroll steps)")
+        self._m_dispatch_s = reg.histogram(
+            "relayrl_actor_rollout_dispatch_seconds",
+            "fused rollout: device compute per [lanes, unroll] window")
+        self._m_unstack_s = reg.histogram(
+            "relayrl_actor_rollout_unstack_seconds",
+            "fused rollout: host unstack of one window into trajectories")
+        reg.gauge("relayrl_actor_lanes",
+                  "env lanes per batched dispatch on this host").set(
+                      self.num_envs)
+        reg.gauge("relayrl_actor_unroll_length",
+                  "env steps per lane per fused rollout dispatch").set(
+                      self.unroll_length)
+
+    # -- fused action API --
+    def rollout(self) -> dict:
+        """ONE device dispatch producing ``lanes × unroll`` env steps,
+        then the host unstack into the per-lane trajectory streams.
+
+        Returns ``{"steps", "episodes", "dispatch_s", "unstack_s"}`` for
+        the calling driver's accounting; completed episode returns
+        accumulate on :attr:`episode_returns` per lane.
+        """
+        t0 = time.monotonic()
+        with self._lock:
+            # ONE params/explore read under the lock for the whole
+            # window: every step of this window is computed by a single
+            # model version (maybe_swap's atomicity across lanes AND
+            # unroll steps).
+            self._carry, window = self._rollout_fn(
+                self.params, self._explore_kwargs, self._carry)
+        window = jax.block_until_ready(window)
+        t1 = time.monotonic()
+        host_window = jax.device_get(window)
+        episodes = self._unstack(host_window)
+        t2 = time.monotonic()
+        steps = self.num_envs * self.unroll_length
+        self._m_steps.inc(steps)
+        self._m_dispatches.inc()
+        self._m_dispatch_s.observe(t1 - t0)
+        self._m_unstack_s.observe(t2 - t1)
+        return {"steps": steps, "episodes": episodes,
+                "dispatch_s": t1 - t0, "unstack_s": t2 - t1}
+
+    def _unstack(self, w: dict) -> int:
+        """Replay one host-side window into the per-lane trajectories,
+        reproducing the live loop's wire shape exactly: reward r_t lands
+        on the record of the action that EARNED it (``reward_updated``
+        set only for nonzero rewards, as ``update_reward`` would have),
+        the final action of an episode keeps rew=0 with its reward riding
+        the terminal marker (``flag_last_action`` semantics), terminated
+        beats truncated, and a pure time-limit ending ships the pre-reset
+        observation for the value bootstrap."""
+        obs, act, rew = w["obs"], w["act"], w["rew"]
+        term, trunc, final_obs = w["term"], w["trunc"], w["final_obs"]
+        aux = w["aux"]
+        aux_items = list(aux.items())
+        episodes = 0
+        for lane in range(self.num_envs):
+            traj = self.trajectories[lane]
+            for t in range(self.unroll_length):
+                done = bool(term[lane, t]) or bool(trunc[lane, t])
+                r = float(rew[lane, t])
+                self._ep_ret[lane] += r
+                record = ActionRecord(
+                    obs=obs[lane, t],
+                    act=np.asarray(act[lane, t]),
+                    mask=None,
+                    rew=0.0 if done else r,
+                    reward_updated=bool(not done and r != 0.0),
+                    data={k: np.asarray(v[lane, t]) for k, v in aux_items},
+                    done=False,
+                )
+                traj.add_action(record, send_if_done=True)
+                if done:
+                    terminated = bool(term[lane, t])
+                    time_limited = not terminated
+                    marker = ActionRecord(
+                        obs=(np.asarray(final_obs[lane, t], np.float32)
+                             if time_limited else None),
+                        rew=r, done=True, truncated=time_limited)
+                    traj.add_action(marker, send_if_done=True)
+                    self.episode_returns[lane].append(
+                        float(self._ep_ret[lane]))
+                    self._ep_ret[lane] = 0.0
+                    episodes += 1
+        return episodes
+
+    # -- model hot-swap (one gate, all lanes, whole windows) --
+    def maybe_swap(self, bundle: ModelBundle) -> bool:
+        """Install a newer model for every lane atomically; a window in
+        flight finishes on the old version, the next reads the new one
+        (shared gate with PolicyActor/VectorActorHost)."""
+        return apply_bundle_swap(self, bundle)
+
+    def swap_from_bytes(self, buf: bytes) -> bool:
+        return self.maybe_swap(
+            ModelBundle.from_bytes(buf, params_template=ModelBundle.RAW_TREE))
+
+    def swap_from_wire(self, version: int, blob: bytes):
+        """Wire-v2-aware swap shared with the other actor hosts."""
+        return apply_wire_swap(self, version, blob)
+
+
+def run_anakin_loop(host, windows: int) -> list[list[float]]:
+    """Drive ``windows`` fused dispatches through an
+    :class:`AnakinActorHost` (or the networked anakin-mode
+    ``VectorAgent`` — same ``rollout()`` surface). Returns per-lane
+    completed episode returns, mirroring ``run_vector_gym_loop``."""
+    for _ in range(windows):
+        host.rollout()
+    returns = getattr(host, "episode_returns", None)
+    if returns is None:  # networked facade: reach through to the host
+        returns = host.host.episode_returns
+    return [list(r) for r in returns]

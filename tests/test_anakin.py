@@ -1,0 +1,449 @@
+"""The fused Anakin rollout engine (runtime/anakin.py): window unstack
+wire semantics, swap gates, cross-process determinism, config knobs, the
+networked VectorAgent anakin tier end-to-end on zmq, and THE acceptance
+drill — a chaos-style learner SIGKILL/restart with anakin actors, zero
+loss through the spool/dedup plane.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from _util import free_port
+
+pytestmark = pytest.mark.anakin
+
+BENCHES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benches")
+
+
+def _bundle(obs_dim=4, act_dim=2, seed=0, version=0):
+    """Deterministic MLP bundle (no algorithm state, so two processes
+    building it get bit-identical params)."""
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    arch = {"kind": "mlp_discrete", "obs_dim": obs_dim, "act_dim": act_dim,
+            "hidden_sizes": [16]}
+    policy = build_policy(arch)
+    params = policy.init_params(jax.random.PRNGKey(seed))
+    return ModelBundle(version=version, arch=arch, params=params)
+
+
+class TestUnstackWireSemantics:
+    def test_episode_stream_matches_live_loop_shape(self, tmp_cwd):
+        """Each shipped episode ends in a terminal marker carrying the
+        final step's reward; every non-terminal record holds the reward
+        its own action earned with the live path's ``reward_updated``
+        side channel; the final action record keeps rew=0 (its reward
+        rides the marker, exactly like ``flag_last_action``)."""
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+        from relayrl_tpu.types.trajectory import deserialize_actions
+
+        sent: list[tuple[int, bytes]] = []
+        host = AnakinActorHost(
+            _bundle(), "CartPole-v1", num_envs=4, unroll_length=64,
+            on_send=lambda lane, p: sent.append((lane, p)), seed=2)
+        host.rollout()
+        assert {lane for lane, _ in sent} == {0, 1, 2, 3}
+        for _, payload in sent:
+            acts = deserialize_actions(payload)
+            marker, steps = acts[-1], acts[:-1]
+            assert marker.done and marker.act is None
+            assert marker.rew == 1.0  # CartPole: every step pays 1.0
+            assert not marker.truncated  # random policy falls, not times out
+            assert marker.obs is None  # genuine terminal: no bootstrap obs
+            for rec in steps[:-1]:
+                assert rec.rew == 1.0 and rec.reward_updated
+                assert rec.obs.shape == (4,) and rec.obs.dtype == np.float32
+                assert set(rec.data) == {"logp_a", "v"}
+            assert steps[-1].rew == 0.0 and not steps[-1].reward_updated
+
+    def test_truncation_ships_bootstrap_obs(self, tmp_cwd):
+        """A time-limit ending must ship truncated=True plus the
+        pre-reset observation (the value bootstrap needs the successor
+        state), with terminated-beats-truncated precedence preserved."""
+        from relayrl_tpu.envs.jax import JaxCartPole
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+        from relayrl_tpu.types.trajectory import deserialize_actions
+
+        sent: list[bytes] = []
+        host = AnakinActorHost(
+            _bundle(), JaxCartPole(max_steps=5), num_envs=2,
+            unroll_length=40, on_send=lambda lane, p: sent.append(p),
+            seed=0)
+        host.rollout()
+        truncated_markers = terminal_markers = 0
+        for payload in sent:
+            marker = deserialize_actions(payload)[-1]
+            assert marker.done
+            if marker.truncated:
+                truncated_markers += 1
+                assert marker.obs is not None and marker.obs.shape == (4,)
+            else:
+                terminal_markers += 1
+                assert marker.obs is None
+        # max_steps=5 under a random policy: overwhelmingly time limits.
+        assert truncated_markers >= 5
+
+    def test_episode_returns_match_shipped_rewards(self, tmp_cwd):
+        """The host's per-lane episode accounting equals the sum of
+        rewards on the wire for each shipped episode."""
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+        from relayrl_tpu.types.trajectory import deserialize_actions
+
+        per_lane: dict[int, list[bytes]] = {}
+        host = AnakinActorHost(
+            _bundle(), "CartPole-v1", num_envs=3, unroll_length=50,
+            on_send=lambda lane, p: per_lane.setdefault(lane, []).append(p),
+            seed=5)
+        host.rollout()
+        host.rollout()
+        for lane, payloads in per_lane.items():
+            wire_returns = [
+                sum(a.rew for a in deserialize_actions(p))
+                for p in payloads]
+            # completed episodes only (a window can end mid-episode, and
+            # max_traj_length can split one episode into chunks — CartPole
+            # under the default 1000-cap never splits here)
+            assert wire_returns == pytest.approx(
+                host.episode_returns[lane][:len(wire_returns)])
+
+    def test_run_anakin_loop_returns_per_lane(self, tmp_cwd):
+        from relayrl_tpu.runtime.anakin import AnakinActorHost, run_anakin_loop
+
+        host = AnakinActorHost(_bundle(), "CartPole-v1", num_envs=2,
+                               unroll_length=60, seed=1)
+        returns = run_anakin_loop(host, windows=2)
+        assert len(returns) == 2
+        assert all(len(lane_returns) >= 1 for lane_returns in returns)
+        assert all(r >= 1.0 for lane in returns for r in lane)
+
+
+class TestSwapGates:
+    def test_swap_between_windows_and_stale_rejection(self, tmp_cwd):
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+        host = AnakinActorHost(_bundle(version=3), "CartPole-v1",
+                               num_envs=2, unroll_length=8, seed=0)
+        host.rollout()
+        assert not host.maybe_swap(_bundle(version=3))  # stale: same ver
+        newer = _bundle(seed=9, version=7)
+        assert host.maybe_swap(newer)
+        assert host.version == 7
+        host.rollout()  # next window runs on the new params
+        with pytest.raises(ValueError, match="arch"):
+            host.maybe_swap(_bundle(obs_dim=4, act_dim=3, version=9))
+
+    def test_swap_from_bytes_roundtrip(self, tmp_cwd):
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+        host = AnakinActorHost(_bundle(version=0), "CartPole-v1",
+                               num_envs=1, unroll_length=4, seed=0)
+        assert host.swap_from_bytes(_bundle(seed=4, version=2).to_bytes())
+        assert host.version == 2
+
+    def test_env_model_dim_mismatch_raises(self, tmp_cwd):
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+        with pytest.raises(ValueError, match="obs_dim"):
+            AnakinActorHost(_bundle(obs_dim=6), "CartPole-v1",
+                            num_envs=1, unroll_length=4)
+
+    def test_sequence_policy_refused(self, tmp_cwd):
+        from relayrl_tpu.models import build_policy
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        arch = {"kind": "transformer_discrete", "obs_dim": 4, "act_dim": 2,
+                "d_model": 16, "n_layers": 1, "n_heads": 2,
+                "max_seq_len": 16}
+        policy = build_policy(arch)
+        bundle = ModelBundle(version=0, arch=arch,
+                             params=policy.init_params(jax.random.PRNGKey(0)))
+        with pytest.raises(ValueError, match="sequence"):
+            AnakinActorHost(bundle, "CartPole-v1", num_envs=1,
+                            unroll_length=4, validate=False)
+
+
+_DETERMINISM_SCRIPT = """
+import hashlib, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+from relayrl_tpu.models import build_policy
+from relayrl_tpu.types.model_bundle import ModelBundle
+from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+arch = {"kind": "mlp_discrete", "obs_dim": 4, "act_dim": 2,
+        "hidden_sizes": [16]}
+policy = build_policy(arch)
+bundle = ModelBundle(version=0, arch=arch,
+                     params=policy.init_params(jax.random.PRNGKey(0)))
+h = hashlib.sha256()
+host = AnakinActorHost(bundle, "CartPole-v1", num_envs=4, unroll_length=32,
+                       on_send=lambda lane, p: h.update(p), seed=123)
+host.rollout()
+host.rollout()
+h.update(repr(host.episode_returns).encode())
+print("WINDOW_SHA", h.hexdigest())
+"""
+
+
+def test_cross_process_determinism(tmp_path):
+    """Same seed ⇒ byte-identical trajectory windows across two FRESH
+    processes: the fused rollout (policy sampling, env dynamics, in-scan
+    autoresets, unstacker, wire codec) is a pure function of
+    (params, seed). This is the determinism half of the golden
+    acceptance; the numpy-parity half lives in tests/test_jax_envs.py."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(BENCHES)
+    digests = []
+    for _ in range(2):
+        out = subprocess.run([sys.executable, "-c", _DETERMINISM_SCRIPT],
+                             capture_output=True, text=True, timeout=300,
+                             env=env, cwd=str(tmp_path))
+        assert out.returncode == 0, out.stderr[-2000:]
+        digests.append(out.stdout.split("WINDOW_SHA")[1].strip())
+    assert digests[0] == digests[1]
+
+
+class TestConfigKnobs:
+    def test_actor_params_anakin(self, tmp_path):
+        from relayrl_tpu.config import ConfigLoader
+
+        path = tmp_path / "cfg.json"
+        path.write_text(json.dumps({"actor": {
+            "host_mode": "anakin", "num_envs": 8,
+            "unroll_length": 128, "jax_env": "Pendulum-v1"}}))
+        params = ConfigLoader(None, str(path)).get_actor_params()
+        assert params["host_mode"] == "anakin"
+        assert params["unroll_length"] == 128
+        assert params["jax_env"] == "Pendulum-v1"
+
+    def test_actor_params_anakin_defaults_and_clamps(self, tmp_path):
+        from relayrl_tpu.config import ConfigLoader
+
+        path = tmp_path / "cfg.json"
+        path.write_text(json.dumps({"actor": {
+            "host_mode": "warp", "unroll_length": "bogus",
+            "jax_env": None}}))
+        params = ConfigLoader(None, str(path)).get_actor_params()
+        assert params["host_mode"] == "process"  # unknown mode degrades
+        assert params["unroll_length"] == 32
+        assert params["jax_env"] == "CartPole-v1"
+
+
+class TestNetworkedAnakinZmq:
+    def test_lanes_register_stream_and_hot_swap(self, tmp_cwd):
+        """The networked anakin tier against a live zmq TrainingServer:
+        N logical lanes register over one connection, every lane's
+        trajectories arrive attributed and dedup-accounted, the learner
+        trains, and the published model hot-swaps back into the fused
+        host (version advances between windows)."""
+        from relayrl_tpu.runtime.agent import VectorAgent
+        from relayrl_tpu.runtime.server import TrainingServer
+
+        addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        agent_addrs = {
+            "agent_listener_addr": addrs["agent_listener_addr"],
+            "trajectory_addr": addrs["trajectory_addr"],
+            "model_sub_addr": addrs["model_pub_addr"],
+        }
+        server = TrainingServer(
+            "REINFORCE", obs_dim=4, act_dim=2, env_dir=str(tmp_cwd),
+            hyperparams={"traj_per_epoch": 4, "hidden_sizes": [16],
+                         "with_vf_baseline": True},
+            **addrs)
+        try:
+            agent = VectorAgent(
+                num_envs=4, server_type="zmq", handshake_timeout_s=30,
+                seed=0, probe=False, host_mode="anakin",
+                jax_env="CartPole-v1", unroll_length=32,
+                identity="anakin-e2e", **agent_addrs)
+            try:
+                assert agent.host_mode == "anakin"
+                v0 = agent.model_version
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    agent.rollout()
+                    if (agent.model_version > v0
+                            and server.stats["updates"] >= 2):
+                        break
+                assert agent.model_version > v0, \
+                    "fused host never hot-swapped a published model"
+                server.drain(timeout=30)
+                acct = server.ingest_accounting()
+                lane_rows = {aid: row for aid, row in acct["agents"].items()
+                             if aid.startswith("anakin-e2e.lane")}
+                assert len(lane_rows) == 4  # every lane attributed
+                for aid, row in lane_rows.items():
+                    assert row["accepted"] >= 1 and row["contiguous"], (
+                        aid, row)
+                # guard rails of the anakin surface
+                with pytest.raises(RuntimeError, match="rollout"):
+                    agent.request_for_actions(np.zeros((4, 4), np.float32))
+                with pytest.raises(RuntimeError, match="in-scan"):
+                    agent.flag_last_action(0, 1.0)
+            finally:
+                agent.disable_agent()
+        finally:
+            server.disable_server()
+
+
+def _read_status(scratch: str) -> dict | None:
+    try:
+        with open(os.path.join(scratch, "status.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _wait_status(scratch, proc, pred, timeout_s, what) -> dict:
+    deadline = time.monotonic() + timeout_s
+    status = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise AssertionError(
+                f"chaos server died waiting for {what} "
+                f"(rc={proc.returncode}):\n{out[-3000:]}")
+        status = _read_status(scratch)
+        if status is not None and pred(status):
+            return status
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}; last={status}")
+
+
+def test_learner_sigkill_restart_with_anakin_actors_zero_loss(tmp_path,
+                                                              tmp_cwd):
+    """The acceptance drill: SIGKILL the learner mid-run while a fused
+    anakin host keeps producing windows INTO the outage (the env lives
+    on the actor's device — env-steps never stop), restart with resume,
+    and assert zero loss / zero double-train per LANE through the
+    existing spool → replay → sequence-dedup plane, plus model-version
+    continuity across the crash."""
+    scratch = str(tmp_path)
+    ports = [free_port() for _ in range(3)]
+    server_addrs = {"agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
+                    "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
+                    "model_pub_addr": f"tcp://127.0.0.1:{ports[2]}"}
+    agent_addrs = {"agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
+                   "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
+                   "model_sub_addr": f"tcp://127.0.0.1:{ports[2]}"}
+
+    def spawn(resume: bool) -> subprocess.Popen:
+        cfg = {
+            "algorithm": "REINFORCE", "obs_dim": 4, "act_dim": 2,
+            "hyperparams": {"traj_per_epoch": 4, "hidden_sizes": [16, 16],
+                            "with_vf_baseline": False},
+            "server_type": "zmq", "scratch": scratch,
+            "checkpoint_every": 1, "resume": resume,
+            "status_path": os.path.join(scratch, "status.json"),
+            **server_addrs,
+        }
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.path.dirname(BENCHES)
+        return subprocess.Popen(
+            [sys.executable, os.path.join(BENCHES, "_chaos_server.py"),
+             json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+    proc = spawn(resume=False)
+    agent = None
+    try:
+        _wait_status(scratch, proc, lambda s: True, 120, "server up")
+        from relayrl_tpu.runtime.agent import VectorAgent
+
+        agent = VectorAgent(
+            num_envs=2, server_type="zmq", handshake_timeout_s=60,
+            seed=0, probe=False, host_mode="anakin",
+            jax_env="CartPole-v1", unroll_length=16,
+            identity="anakin-chaos", **agent_addrs)
+        # Phase 1: train until a checkpoint base exists.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            agent.rollout()
+            status = _read_status(scratch)
+            if (status and status["version"] >= 2
+                    and status["accounting"]["agents"]):
+                break
+            time.sleep(0.05)
+        status = _read_status(scratch)
+        assert status and status["version"] >= 2, "no training before kill"
+        v_before = status["version"]
+        agent_v_before = agent.model_version
+
+        # Phase 2: SIGKILL — no shutdown path.
+        proc.kill()
+        proc.wait(timeout=30)
+
+        # Phase 3: the fused host keeps rolling into the outage; windows
+        # land in the spool (zmq PUSH is fire-and-forget into a dead pipe,
+        # the spool retains them).
+        for _ in range(6):
+            agent.rollout()
+        sent_during_outage = dict(agent.spool.sent_counts())
+        assert sum(sent_during_outage.values()) > 0
+
+        # Phase 4: restart with resume; the agent heals and trains past
+        # the pre-kill version.
+        proc = spawn(resume=True)
+        _wait_status(scratch, proc, lambda s: True, 120, "server restart")
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            agent.rollout()
+            status = _read_status(scratch)
+            if (status and status["version"] > v_before
+                    and agent.model_version > agent_v_before):
+                break
+            time.sleep(0.05)
+        assert status["version"] > v_before, (
+            f"server never trained past the crash: {status['version']} "
+            f"<= {v_before}")
+        assert agent.model_version > agent_v_before, (
+            "fused host never resynced to the post-crash model line")
+
+        # Phase 5: full replay, then per-LANE zero-loss accounting.
+        agent.spool.replay()
+        sent_counts = agent.spool.sent_counts()
+        lane_ids = [aid for aid in sent_counts
+                    if aid.startswith("anakin-chaos.lane")]
+        assert len(lane_ids) == 2
+
+        def recovered(s):
+            rows = s["accounting"]["agents"]
+            return all(
+                rows.get(aid, {}).get("max_seq") == sent_counts[aid]
+                and rows[aid]["contiguous"] for aid in lane_ids)
+
+        status = _wait_status(scratch, proc, recovered, 120,
+                              "zero-loss accounting for every lane")
+        for aid in lane_ids:
+            row = status["accounting"]["agents"][aid]
+            assert row["accepted"] == sent_counts[aid], (
+                f"loss or double-train on {aid}: {row} "
+                f"vs sent={sent_counts[aid]}")
+        assert status["accounting"]["duplicates"] >= 1  # replay surplus
+    finally:
+        if agent is not None:
+            agent.disable_agent()
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -131,6 +131,59 @@ def test_bench_soak_chaos_quick_smoke(tmp_path):
         assert ledger["max_seq"] == n and ledger["contiguous"], ledger
 
 
+@pytest.mark.anakin
+def test_bench_soak_anakin_quick_smoke(tmp_path):
+    """Fast bench_soak --anakin smoke (ISSUE 7): a tiny fused-rollout
+    fleet (one process, on-device CartPole lanes) must land >= 1 REAL
+    trajectory per logical agent with per-lane attribution, zero drops,
+    and a row carrying the engine-plane timing block + the server
+    /snapshot schema."""
+    import os
+
+    sys.path.insert(0, str(BENCH_DIR))
+    monkey_cwd = os.getcwd()
+    try:
+        import bench_soak
+
+        os.chdir(tmp_path)
+        result = bench_soak.run_soak(
+            n_actors=4, agents_per_proc=4, duration_s=3.0,
+            traj_per_epoch=8, anakin=True, unroll_length=16)
+    finally:
+        os.chdir(monkey_cwd)
+        sys.path.pop(0)
+    assert result["config"]["mode"] == "anakin"
+    assert result["config"]["obs_dim"] == 4  # sized to the REAL env
+    assert result["agents_completed"] == 4
+    assert result["agents_crashed"] == 0
+    assert result["server_stats"]["dropped"] == 0
+    assert result["min_episodes_per_agent"] >= 1
+    assert result["distinct_traj_agents"] == 4  # per-lane attribution
+    engine = result["anakin_engine"]
+    assert engine["windows"] >= 1
+    assert engine["dispatch_s_total"] > 0
+    snap = result["telemetry"]
+    assert snap["schema"] == "relayrl-telemetry-v1"
+    names = {m["name"] for m in snap["metrics"]}
+    assert "relayrl_server_trajectories_total" in names
+
+
+@pytest.mark.anakin
+def test_bench_anakin_quick_emits_json(tmp_path):
+    """bench_anakin --quick: baseline + fused rate lines for every grid
+    point, and a headline carrying the equal-lane-count speedup map plus
+    the best fused row's dispatch/unstack split (the full per-row detail
+    goes to the results file under --write)."""
+    lines = _run_bench("bench_anakin.py", tmp_path, timeout=420)
+    base = [r for r in lines if r.get("bench") == "anakin_vector_baseline"]
+    fused = [r for r in lines if r.get("bench") == "anakin_fused_rollout"]
+    assert base and fused
+    headline = next(r for r in lines if r.get("bench") == "anakin_headline")
+    for lanes, speedup in headline["speedup_rollout_at_equal_lanes"].items():
+        assert speedup > 1.0, (lanes, speedup)
+    assert headline["best_rollout"]["rollout_steps_per_sec"] > 0
+
+
 @pytest.mark.telemetry
 def test_bench_telemetry_quick_asserts_hotpath_cost(tmp_path):
     # The microbench carries its own ceiling asserts (disabled-path inc

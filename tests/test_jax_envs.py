@@ -1,0 +1,279 @@
+"""Dynamics-parity goldens: each on-device JAX env vs its numpy built-in,
+plus the in-scan autoreset and the unified env registry.
+
+Parity contract (see the precision note in ``envs/jax/base.py``): every
+discrete field — rewards where integral, terminated/truncated flags, step
+counters, and Recall's ENTIRE observation — must match the numpy twin
+EXACTLY; continuous observations must match to float32 precision
+(``atol=rtol=2e-6``) per step. Full float bitwise equality between the
+two planes is not physically achievable on this backend: XLA contracts
+mul+add chains into FMAs and its cos/sin differ from libm's by 1 ulp
+(both measured — see the probe test), so the goldens pin the strongest
+true invariant instead: per-step agreement from IDENTICAL injected
+states, so errors never compound, across termination, truncation, and
+autoreset boundaries. Byte-exact reproducibility WITHIN the JAX plane is
+pinned separately (tests/test_anakin.py cross-process determinism).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relayrl_tpu.envs import CartPoleEnv, PendulumEnv, RecallEnv, list_envs
+
+pytestmark = pytest.mark.anakin
+from relayrl_tpu.envs.jax import (
+    JAX_ENVS,
+    make_jax,
+    step_autoreset,
+)
+
+ATOL = RTOL = 2e-6  # float32-grade per-step agreement
+
+
+def test_xla_float_parity_bound_probe():
+    """The evidence for the parity contract above: XLA's jitted float32
+    math agrees with numpy's to ~1 ulp but NOT bitwise (FMA contraction
+    + transcendental implementations). If this ever starts failing, the
+    backend's float behavior changed and the golden tolerances need a
+    fresh look."""
+    xs = np.linspace(-3.2, 3.2, 4001, dtype=np.float32)
+    jit_cos = np.asarray(jax.jit(jnp.cos)(xs))
+    ulp = np.abs(jit_cos.view(np.int32).astype(np.int64)
+                 - np.cos(xs).view(np.int32).astype(np.int64)).max()
+    assert ulp <= 4, f"XLA cos drifted {ulp} ulp from libm"
+
+
+class TestCartPoleParity:
+    def test_per_step_dynamics_across_boundaries(self):
+        """400 steps of per-step injected parity under a fixed action
+        stream: before every step the numpy twin is set to the JAX env's
+        exact state, both step, and all five return fields are compared.
+        Episodes end by termination (pole falls under random actions) and
+        the JAX lane autoresets in the same call chain the fused rollout
+        uses, so the comparison crosses many episode boundaries."""
+        jenv = make_jax("CartPole-v1")
+        nenv = CartPoleEnv()
+        nenv.reset(seed=0)  # state is overwritten by injection below
+        step = jax.jit(jenv.step)
+        rng = np.random.default_rng(7)
+        key = jax.random.PRNGKey(7)
+        key, sub = jax.random.split(key)
+        state, _ = jenv.reset(sub)
+        episodes = 0
+        for _ in range(400):
+            nenv._state = np.asarray(state.state, np.float64).copy()
+            nenv._t = int(state.t)
+            action = int(rng.integers(2))
+            state, jobs, jrew, jterm, jtrunc = step(state, jnp.int32(action))
+            nobs, nrew, nterm, ntrunc, _ = nenv.step(action)
+            np.testing.assert_allclose(np.asarray(jobs), nobs,
+                                       atol=ATOL, rtol=RTOL)
+            assert float(jrew) == nrew == 1.0
+            assert bool(jterm) == nterm and bool(jtrunc) == ntrunc
+            if bool(jterm) or bool(jtrunc):
+                episodes += 1
+                key, sub = jax.random.split(key)
+                state, _ = jenv.reset(sub)
+        assert episodes >= 5, "golden never crossed an episode boundary"
+
+    def test_truncation_flag_parity(self):
+        """Time-limit endings: a short max_steps forces truncation; the
+        flag must fire on the same step with the same independent-flags
+        semantics as the numpy twin (both-true is representable)."""
+        jenv = make_jax("CartPole-v1", max_steps=6)
+        nenv = CartPoleEnv(max_steps=6)
+        nenv.reset(seed=1)
+        step = jax.jit(jenv.step)
+        state, _ = jenv.reset(jax.random.PRNGKey(1))
+        for i in range(6):
+            nenv._state = np.asarray(state.state, np.float64).copy()
+            nenv._t = int(state.t)
+            action = i % 2
+            state, _, _, jterm, jtrunc = step(state, jnp.int32(action))
+            _, _, nterm, ntrunc, _ = nenv.step(action)
+            assert bool(jterm) == nterm and bool(jtrunc) == ntrunc
+        assert bool(jtrunc), "max_steps=6 must truncate on step 6"
+
+    def test_reset_distribution(self):
+        """Seeded resets land in CartPole's U(-0.05, 0.05) init box and
+        differ across keys (the PRNG streams are necessarily different
+        between the planes; the CONTRACT is the distribution)."""
+        jenv = make_jax("CartPole-v1")
+        a = np.asarray(jenv.reset(jax.random.PRNGKey(0))[1])
+        b = np.asarray(jenv.reset(jax.random.PRNGKey(1))[1])
+        assert np.abs(a).max() <= 0.05 and np.abs(b).max() <= 0.05
+        assert not np.array_equal(a, b)
+        # same key ⇒ same init, the reproducibility half
+        c = np.asarray(jenv.reset(jax.random.PRNGKey(0))[1])
+        np.testing.assert_array_equal(a, c)
+
+
+class TestPendulumParity:
+    def test_per_step_dynamics_and_reward(self):
+        jenv = make_jax("Pendulum-v1", max_steps=25)
+        nenv = PendulumEnv(max_steps=25)
+        nenv.reset(seed=0)
+        step = jax.jit(jenv.step)
+        rng = np.random.default_rng(3)
+        key = jax.random.PRNGKey(3)
+        key, sub = jax.random.split(key)
+        state, _ = jenv.reset(sub)
+        truncations = 0
+        for _ in range(120):
+            nenv._theta = float(np.float32(state.theta))
+            nenv._theta_dot = float(np.float32(state.theta_dot))
+            nenv._t = int(state.t)
+            action = np.float32(rng.uniform(-2.5, 2.5))  # incl. clip range
+            state, jobs, jrew, jterm, jtrunc = step(
+                state, jnp.asarray([action]))
+            nobs, nrew, nterm, ntrunc, _ = nenv.step([action])
+            np.testing.assert_allclose(np.asarray(jobs), nobs,
+                                       atol=ATOL, rtol=RTOL)
+            np.testing.assert_allclose(float(jrew), nrew,
+                                       atol=ATOL, rtol=RTOL)
+            assert not bool(jterm) and not nterm  # pendulum never terminates
+            assert bool(jtrunc) == ntrunc
+            if bool(jtrunc):
+                truncations += 1
+                key, sub = jax.random.split(key)
+                state, _ = jenv.reset(sub)
+        assert truncations >= 3
+
+    def test_obs_is_cos_sin_thetadot(self):
+        jenv = make_jax("Pendulum-v1")
+        _, obs = jenv.reset(jax.random.PRNGKey(0))
+        obs = np.asarray(obs)
+        assert obs.shape == (3,)
+        assert abs(obs[0] ** 2 + obs[1] ** 2 - 1.0) < 1e-5
+
+
+class TestRecallParity:
+    def test_full_bitwise_parity(self):
+        """Recall's observation is integer-derived (one-hot, flag, and a
+        power-of-two phase division), so here the parity claim is the
+        full one: obs, reward, and flags are ALL bit-equal to the numpy
+        twin, across several episodes with injected cues."""
+        horizon, n_cues = 8, 3
+        jenv = make_jax("Recall-v0", horizon=horizon, n_cues=n_cues)
+        nenv = RecallEnv(horizon=horizon, n_cues=n_cues)
+        nenv.reset(seed=0)
+        step = jax.jit(jenv.step)
+        rng = np.random.default_rng(11)
+        key = jax.random.PRNGKey(11)
+        key, sub = jax.random.split(key)
+        state, jobs = jenv.reset(sub)
+        # reset obs parity for the injected cue
+        nenv._cue, nenv._t = int(state.cue), 0
+        np.testing.assert_array_equal(np.asarray(jobs), nenv._obs())
+        for _ in range(5 * horizon):
+            nenv._cue, nenv._t = int(state.cue), int(state.t)
+            action = int(rng.integers(n_cues))
+            state, jobs, jrew, jterm, jtrunc = step(state, jnp.int32(action))
+            nobs, nrew, nterm, ntrunc, _ = nenv.step(action)
+            np.testing.assert_array_equal(np.asarray(jobs), nobs)
+            assert float(jrew) == nrew
+            assert bool(jterm) == nterm and bool(jtrunc) == ntrunc
+            if bool(jterm):
+                key, sub = jax.random.split(key)
+                state, jobs = jenv.reset(sub)
+                nenv._cue, nenv._t = int(state.cue), 0
+                np.testing.assert_array_equal(np.asarray(jobs), nenv._obs())
+
+    def test_memoryless_cap_and_query_reward(self):
+        """The task's defining property carries over: only the query step
+        pays, and it pays iff the action matches the episode's cue."""
+        jenv = make_jax("Recall-v0", horizon=4, n_cues=2)
+        state, _ = jenv.reset(jax.random.PRNGKey(0))
+        cue = int(state.cue)
+        step = jax.jit(jenv.step)
+        rewards = []
+        for t in range(4):
+            state, _, rew, term, _ = step(state, jnp.int32(cue))
+            rewards.append(float(rew))
+        assert rewards == [0.0, 0.0, 0.0, 1.0] and bool(term)
+
+
+class TestInScanAutoreset:
+    def test_lanes_never_leave_device(self):
+        """The fused composition: 600 scanned steps cross many episode
+        boundaries; each boundary hands back the NEXT episode's reset
+        observation (inside CartPole's init box) while the pre-reset
+        observation rides final_obs — and the scanned flags exactly match
+        a step-by-step replay of the same program."""
+        env = make_jax("CartPole-v1")
+
+        def body(c, _):
+            key, state, obs = c
+            (key, state, obs, rew, term, trunc,
+             final_obs) = step_autoreset(env, key, state, jnp.int32(1))
+            return (key, state, obs), {"obs": obs, "rew": rew,
+                                       "term": term, "trunc": trunc,
+                                       "final_obs": final_obs}
+
+        key = jax.random.PRNGKey(5)
+        rkey, ikey = jax.random.split(key)
+        state, obs = env.reset(ikey)
+        _, w = jax.jit(lambda c: jax.lax.scan(body, c, None, length=600))(
+            (rkey, state, obs))
+        term = np.asarray(w["term"])
+        obs_w = np.asarray(w["obs"])
+        final = np.asarray(w["final_obs"])
+        assert term.sum() >= 10, "constant-push cartpole must fall often"
+        done_idx = np.flatnonzero(term)
+        # At a boundary t the emitted obs row is ALREADY the next
+        # episode's reset (inside the init box) — the SyncVectorEnv
+        # autoreset convention — while final_obs[t] is the fallen state
+        # (outside it).
+        for t in done_idx:
+            assert np.abs(obs_w[t]).max() <= 0.05
+            assert np.abs(final[t]).max() > 0.05
+        assert bool((np.asarray(w["rew"]) == 1.0).all())
+
+    def test_fixed_seed_reproducibility(self):
+        """Same carry seed ⇒ identical scanned window, byte for byte —
+        the in-process half of the determinism contract (the
+        cross-process half lives in tests/test_anakin.py)."""
+        env = make_jax("Recall-v0", horizon=8, n_cues=2)
+
+        def run(seed):
+            def body(c, _):
+                key, state, obs = c
+                (key, state, obs, rew, *_rest) = step_autoreset(
+                    env, key, state, jnp.int32(0))
+                return (key, state, obs), obs
+
+            key = jax.random.PRNGKey(seed)
+            rkey, ikey = jax.random.split(key)
+            state, obs = env.reset(ikey)
+            return np.asarray(jax.jit(
+                lambda c: jax.lax.scan(body, c, None, length=64))(
+                    (rkey, state, obs))[1])
+
+        np.testing.assert_array_equal(run(9), run(9))
+        assert not np.array_equal(run(9), run(10))
+
+
+class TestRegistry:
+    def test_jax_registry_covers_builtins(self):
+        assert set(JAX_ENVS) == {"CartPole-v1", "Pendulum-v1", "Recall-v0"}
+
+    def test_list_envs_has_both_planes(self):
+        known = list_envs()
+        assert known["builtin"] == sorted(known["builtin"])
+        assert "CartPole-v1" in known["jax"]
+
+    def test_make_jax_unknown_id_lists_registry(self):
+        with pytest.raises(ValueError, match="CartPole-v1"):
+            make_jax("NoSuchEnv-v0")
+
+    def test_make_error_message_lists_both_planes(self):
+        from relayrl_tpu.envs import make
+
+        with pytest.raises(ValueError, match="on-device"):
+            make("NoSuchEnv-v0")
+
+    def test_make_jax_forwards_kwargs(self):
+        env = make_jax("Recall-v0", horizon=16, n_cues=4)
+        assert env.horizon == 16 and env.obs_dim == 6
