@@ -95,6 +95,7 @@ def main() -> None:
                     "version": int(server.latest_model_version),
                     "stats": dict(server.stats),
                     "accounting": server.ingest_accounting(),
+                    "guardrails": server.guardrails_accounting(),
                     "registered": len(server.agent_ids),
                     "telemetry": telemetry.get_registry().snapshot(),
                 })

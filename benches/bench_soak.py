@@ -65,6 +65,33 @@ def _fresh_bench_registry(run_id: str):
     return registry
 
 
+def _transport_addrs(transport: str, server_type_in_server: bool = True
+                     ) -> tuple[dict, dict]:
+    """``(server_addrs, worker_addrs)`` for one live-transport bench row:
+    fresh ephemeral ports, the worker dict keyed the way _soak_worker
+    expects (``model_sub_addr`` on zmq). ``server_type_in_server=False``
+    for hosts that take the transport kind out-of-band (_chaos_server)."""
+    if transport in ("native", "grpc"):
+        port = free_port()
+        server = {"bind_addr": f"127.0.0.1:{port}"}
+        if server_type_in_server:
+            server["server_type"] = transport
+        worker = {"server_type": transport,
+                  "server_addr": f"127.0.0.1:{port}"}
+    else:
+        server = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        worker = {
+            "agent_listener_addr": server["agent_listener_addr"],
+            "trajectory_addr": server["trajectory_addr"],
+            "model_sub_addr": server["model_pub_addr"],
+        }
+    return server, worker
+
+
 def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
@@ -100,27 +127,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     _fresh_bench_registry(f"soak-{transport}-{n_actors}")
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
-    if transport == "native":
-        port = free_port()
-        addrs = {"server_type": "native", "bind_addr": f"127.0.0.1:{port}"}
-        worker_addrs = {"server_type": "native",
-                        "server_addr": f"127.0.0.1:{port}"}
-    elif transport == "grpc":
-        port = free_port()
-        addrs = {"server_type": "grpc", "bind_addr": f"127.0.0.1:{port}"}
-        worker_addrs = {"server_type": "grpc",
-                        "server_addr": f"127.0.0.1:{port}"}
-    else:
-        addrs = {
-            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
-            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
-            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
-        }
-        worker_addrs = {
-            "agent_listener_addr": addrs["agent_listener_addr"],
-            "trajectory_addr": addrs["trajectory_addr"],
-            "model_sub_addr": addrs["model_pub_addr"],
-        }
+    addrs, worker_addrs = _transport_addrs(transport)
     # IMPALA is the async-fleet north star (BASELINE.md "256 IMPALA
     # actors"): staleness-corrected, so a big fleet on old versions is the
     # intended regime, not an edge case.
@@ -413,15 +420,9 @@ def run_ingest_blast(n_traj: int = 2000, episode_len: int = 25,
 
     _fresh_bench_registry(f"blast-{transport}-{n_traj}")
     scratch = tempfile.mkdtemp(prefix="relayrl_blast_")
+    addrs, _ = _transport_addrs(transport)
     if transport in ("native", "grpc"):
-        port = free_port()
-        addrs = {"server_type": transport, "bind_addr": f"127.0.0.1:{port}"}
-    else:
-        addrs = {
-            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
-            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
-            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
-        }
+        port = int(addrs["bind_addr"].rsplit(":", 1)[1])
     # Default traj_per_epoch > n_traj: pure ingest+decode+store, no update
     # in the timed window (the update path is the headline bench's
     # subject). Pass a real traj_per_epoch for the profile variant — the
@@ -798,23 +799,8 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
     surviving server line of history, replay surplus landing in the
     duplicate counter."""
     scratch = tempfile.mkdtemp(prefix="relayrl_chaos_")
-    if transport in ("native", "grpc"):
-        port = free_port()
-        server_addrs = {"bind_addr": f"127.0.0.1:{port}"}
-        worker_addrs = {"server_type": transport,
-                        "server_addr": f"127.0.0.1:{port}"}
-    else:
-        ports = [free_port() for _ in range(3)]
-        server_addrs = {
-            "agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
-            "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
-            "model_pub_addr": f"tcp://127.0.0.1:{ports[2]}",
-        }
-        worker_addrs = {
-            "agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
-            "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
-            "model_sub_addr": f"tcp://127.0.0.1:{ports[2]}",
-        }
+    server_addrs, worker_addrs = _transport_addrs(
+        transport, server_type_in_server=False)
     plan = _chaos_fault_plan()
     plan_path = os.path.join(scratch, "fault_plan.json")
     with open(plan_path, "w") as f:
@@ -1062,6 +1048,11 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
         },
         "server_stats": (status or {}).get("stats"),
         "server_version_final": (status or {}).get("version"),
+        # Training-health plane (ISSUE 8): validation/quarantine/
+        # watchdog/shed accounting from the surviving server line —
+        # under the standard plan nothing should trip (corrupt frames
+        # die at the CRC, not the validator), which is itself evidence.
+        "guardrails": (status or {}).get("guardrails"),
         # Server-plane snapshot (post-restart line of history) + the
         # aggregated worker-side fault/retry/spool/breaker counters.
         "telemetry": (status or {}).get("telemetry"),
@@ -1072,6 +1063,284 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
              "relayrl_transport_reconnects")),
     }
     return result
+
+
+def run_guardrail_drill(transport: str = "zmq", n_lanes: int = 4,
+                        duration_s: float = 60.0,
+                        reward_target: float | None = 125.0,
+                        unroll_length: int = 32) -> dict:
+    """Guardrail chaos drill (ISSUE 8 acceptance): a live fleet trains
+    REINFORCE on on-device CartPole while a fault-injected actor streams
+    NaN-poisoned trajectories at it. The server runs the deliberately-
+    torn defense-in-depth posture (``ingest_validation: "warn"`` — the
+    validator counts + strikes but ADMITS, and the per-algorithm finite
+    belt stands down), so the drill exercises the whole chain:
+
+      poison admitted → params go non-finite → device probes trip at the
+      fence → auto-rollback to the newest healthy checkpoint (+ ledger
+      sidecar, + forced keyframe so actors resync off the poisoned delta
+      chain) → meanwhile 3 strikes quarantined the poison agent → the
+      restored line trains clean → the run reaches the reward target.
+
+    The publish gate holds the other end: any non-finite snapshot racing
+    the rollback is BLOCKED, so zero non-finite params ever reach the
+    wire (asserted via the blocked counter vs. the publish count and the
+    workers' final finite swap versions)."""
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    _fresh_bench_registry(f"guard-drill-{transport}")
+    scratch = tempfile.mkdtemp(prefix="relayrl_guard_")
+    # server_type rides addrs: the drill constructs TrainingServer
+    # directly (unlike --chaos, whose _chaos_server takes the kind
+    # out-of-band), and the constructor defaults to zmq without it.
+    addrs, worker_addrs = _transport_addrs(transport)
+    guard_cfg = {
+        "ingest_validation": "warn",   # the torn first layer (see above)
+        "strike_threshold": 3,
+        "strike_window_s": 120.0,
+        "quarantine_cooldown_s": 600.0,  # no parole inside the window
+        "watchdog": True, "probes": True, "update_norm_probe": True,
+        "rollback": True, "checkpoint_ring": 5,
+        # a poison burst admitted before the 3rd strike can straddle
+        # several epochs — each one trips and rolls back; the budget
+        # must cover the burst (bounded-retries is still the contract)
+        "max_rollbacks": 5, "rollback_window_s": 600.0,
+    }
+    config_path = os.path.join(scratch, "server_config.json")
+    with open(config_path, "w") as f:
+        json.dump({
+            "learner": {
+                "checkpoint_dir": os.path.join(scratch, "checkpoints"),
+                "checkpoint_every_epochs": 2,
+            },
+            "guardrails": guard_cfg,
+            "telemetry": {"enabled": True, "port": 0},
+        }, f)
+    # CartPole-v1 dims (the on-device env the clean lanes run).
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2, env_dir=scratch,
+        config_path=config_path,
+        hyperparams={"traj_per_epoch": 64, "hidden_sizes": [32, 32],
+                     "with_vf_baseline": True, "train_vf_iters": 5},
+        **addrs)
+    warmed = server.wait_warmup(timeout=120)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(_HERE)
+
+    # Clean fleet: one anakin host, n_lanes logical agents on on-device
+    # CartPole (the PR 7 convergence topology).
+    clean_result = os.path.join(scratch, "worker_0.json")
+    clean_cfg = {
+        "worker_id": 0, "agents_per_proc": n_lanes,
+        "duration_s": duration_s, "episode_len": 25, "obs_dim": 4,
+        "scratch": scratch, "handshake_timeout_s": 180.0,
+        "start_barrier": True, "go_timeout_s": 360.0,
+        "receipt_grace_s": 4.0, "result_path": clean_result,
+        "anakin": True, "unroll_length": unroll_length,
+        "jax_env": "CartPole-v1", **worker_addrs,
+    }
+    clean_proc = subprocess.Popen(
+        [sys.executable, os.path.join(_HERE, "_soak_worker.py"),
+         json.dumps(clean_cfg)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    ready_deadline = time.time() + 300
+    while (not os.path.exists(os.path.join(scratch, "ready_0"))
+           and time.time() < ready_deadline):
+        time.sleep(0.1)
+    with open(os.path.join(scratch, "go"), "w") as f:
+        f.write(str(time.time()))
+    t_go = time.time()
+
+    # Hold the poison until the ring holds a rollback target: the first
+    # periodic save must exist, or the trip would degrade to halt (the
+    # drill would still be "safe", but the acceptance bar is RECOVERY).
+    ckpt_deadline = time.time() + duration_s * 0.6
+    while server._ckpt_saves < 1 and time.time() < ckpt_deadline:
+        time.sleep(0.25)
+    assert server._ckpt_saves >= 1, "no checkpoint before poison window"
+
+    poison_plan = {"seed": 11, "rules": [
+        {"site": "agent.send", "op": "nan_poison", "prob": 1.0}]}
+    plan_path = os.path.join(scratch, "poison_plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(poison_plan, f)
+    poison_result = os.path.join(scratch, "worker_1.json")
+    poison_cfg = {
+        "worker_id": 1, "agents_per_proc": 1,
+        # the poison stream outlives its quarantine: rejected sends keep
+        # hammering the shed path for the rest of the window
+        "duration_s": max(10.0, duration_s - (time.time() - t_go)),
+        "episode_len": 16, "obs_dim": 4, "scratch": scratch,
+        "handshake_timeout_s": 180.0, "start_barrier": False,
+        "receipt_grace_s": 2.0, "result_path": poison_result,
+        "fault_plan": plan_path, "chaos_telemetry": True,
+        **worker_addrs,
+    }
+    poison_proc = subprocess.Popen(
+        [sys.executable, os.path.join(_HERE, "_soak_worker.py"),
+         json.dumps(poison_cfg)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+    # Observe the drill fire: quarantine + rollback, version at recovery.
+    trip_info = {"rollback_seen_s": None, "quarantine_seen_s": None,
+                 "version_at_recovery": None}
+    watch_deadline = t_go + duration_s + 60
+    while time.time() < watch_deadline:
+        acct = server.guardrails_accounting()
+        q = (acct.get("quarantine") or {})
+        if (trip_info["quarantine_seen_s"] is None
+                and q.get("quarantines_total", 0) >= 1):
+            trip_info["quarantine_seen_s"] = round(time.time() - t_go, 1)
+        if (trip_info["rollback_seen_s"] is None
+                and acct.get("rollbacks_total", 0) >= 1):
+            trip_info["rollback_seen_s"] = round(time.time() - t_go, 1)
+            trip_info["version_at_recovery"] = int(
+                server.latest_model_version)
+        if (trip_info["rollback_seen_s"] is not None
+                and trip_info["quarantine_seen_s"] is not None):
+            break
+        if acct.get("halted"):
+            break
+        time.sleep(0.25)
+
+    # Convergence on the restored line: the learner must reach the
+    # reward target INSIDE the window, poison notwithstanding.
+    from relayrl_tpu import telemetry
+
+    def _ep_ret() -> float | None:
+        for m in telemetry.get_registry().snapshot()["metrics"]:
+            if (m["name"] == "relayrl_epoch_stat"
+                    and m.get("labels", {}).get("stat") == "AverageEpRet"):
+                return m["value"]
+        return None
+
+    target_reached_s = None
+    best_ep_ret = None
+    conv_deadline = t_go + duration_s + 60
+    while reward_target is not None and time.time() < conv_deadline:
+        ret = _ep_ret()
+        if ret is not None:
+            best_ep_ret = ret if best_ep_ret is None else max(best_ep_ret,
+                                                              ret)
+        if ret is not None and ret >= reward_target:
+            target_reached_s = round(time.time() - t_go, 1)
+            break
+        # the workers exiting does NOT end the run: the learner keeps
+        # training the ingest backlog (real data sent in-window)
+        time.sleep(0.5)
+
+    clean_out, _ = clean_proc.communicate(timeout=duration_s + 720)
+    poison_out, _ = poison_proc.communicate(timeout=duration_s + 720)
+    server.drain(timeout=120)
+    ret = _ep_ret()
+    if ret is not None:
+        best_ep_ret = ret if best_ep_ret is None else max(best_ep_ret, ret)
+    final_acct = server.guardrails_accounting()
+    stats = dict(server.stats)
+    snapshot = telemetry.get_registry().snapshot()
+
+    import jax
+    import numpy as np
+
+    params_finite = all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(server.algorithm.state.params))
+        if np.asarray(leaf).dtype.kind == "f")
+    final_version = int(server.latest_model_version)
+    server.disable_server()
+
+    for name, rc, out, path in (("clean", clean_proc.returncode,
+                                 clean_out, clean_result),
+                                ("poison", poison_proc.returncode,
+                                 poison_out, poison_result)):
+        if rc != 0 or not os.path.exists(path):
+            raise RuntimeError(
+                f"guard-drill {name} worker failed (rc={rc}):\n{out[-3000:]}")
+    with open(clean_result) as f:
+        clean_agents = json.load(f)["agents"]
+    with open(poison_result) as f:
+        poison_data = json.load(f)
+    poison_agents = poison_data["agents"]
+
+    def _counter(name: str) -> float:
+        return sum(m["value"] for m in snapshot["metrics"]
+                   if m["name"] == name)
+
+    result = {
+        "bench": f"guardrail_drill_{transport}",
+        "config": {"clean_lanes": n_lanes, "poison_agents": 1,
+                   "duration_s": duration_s, "algorithm": "REINFORCE",
+                   "jax_env": "CartPole-v1",
+                   "unroll_length": unroll_length,
+                   "reward_target": reward_target,
+                   "fault_plan": poison_plan, "guardrails": guard_cfg,
+                   "checkpoint_every_epochs": 2,
+                   "host_cores": os.cpu_count()},
+        "warmup_excluded": warmed,
+        "timeline_s": trip_info,
+        "quarantine": final_acct.get("quarantine"),
+        "watchdog": final_acct.get("watchdog"),
+        "admission": final_acct.get("admission"),
+        "rollbacks_total": final_acct.get("rollbacks_total"),
+        "halted": final_acct.get("halted"),
+        "validation_rejections": _counter("relayrl_guard_rejected_total"),
+        "strikes": _counter("relayrl_guard_strikes_total"),
+        "quarantine_rejected_sends": _counter(
+            "relayrl_guard_quarantine_rejects_total"),
+        "publishes_blocked_nonfinite": _counter(
+            "relayrl_guard_publish_blocked_total"),
+        "wire_keyframes": _counter("relayrl_wire_keyframes_total"),
+        "best_average_ep_ret": best_ep_ret,
+        "target_reached_s": target_reached_s,
+        "final_params_finite": params_finite,
+        "final_version": final_version,
+        "clean_agents_final_version": max(
+            (a.get("final_version") or 0) for a in clean_agents),
+        "clean_env_steps_total": sum(a["steps"] for a in clean_agents),
+        "poison_episodes_sent": sum(a["episodes"] for a in poison_agents),
+        "server_stats": stats,
+        "telemetry": snapshot,
+        "poison_worker_counters": _sum_counters(
+            [poison_data.get("telemetry") or {}],
+            ("relayrl_faults_", "relayrl_spool_")),
+    }
+    return result
+
+
+def _finish_guardrail_drill(result: dict, outfile: str | None) -> None:
+    print(json.dumps(result))
+    q = result["quarantine"] or {}
+    assert q.get("quarantines_total", 0) >= 1, \
+        "the poison agent was never quarantined"
+    assert (result["rollbacks_total"] or 0) >= 1, \
+        "the watchdog never rolled the learner back"
+    assert not result["halted"], "guardrails degraded to halt"
+    assert result["final_params_finite"], "non-finite params survived"
+    assert result["strikes"] >= 3, "strike accounting missed the stream"
+    # zero non-finite params ever published: every blocked snapshot was
+    # stopped AT the gate, and the restored line kept publishing past
+    # the recovery version.
+    recovery_v = result["timeline_s"]["version_at_recovery"] or 0
+    assert result["final_version"] > recovery_v, \
+        "the learner never resumed publishing after the rollback"
+    # Actor resync evidence needs the clean window to still be OPEN when
+    # the rollback lands (a --quick run's window can close first; the
+    # committed full-length row always covers it).
+    rb_s = result["timeline_s"]["rollback_seen_s"]
+    if rb_s is not None and rb_s < result["config"]["duration_s"] * 0.8:
+        assert result["clean_agents_final_version"] >= recovery_v, \
+            "actors never resynced onto the restored line"
+    if result["config"]["reward_target"] is not None:
+        assert result["target_reached_s"] is not None, (
+            f"run never reached AverageEpRet "
+            f">= {result['config']['reward_target']} "
+            f"(best {result['best_average_ep_ret']})")
+    if outfile is not None and "--write" in sys.argv:
+        _write_results(outfile, [result])
 
 
 def _finish_chaos(result: dict, outfile: str | None) -> None:
@@ -1085,6 +1354,9 @@ def _finish_chaos(result: dict, outfile: str | None) -> None:
         v for k, v in result["worker_fault_counters"].items()
         if k.startswith("relayrl_faults_injected_total"))
     assert faults_fired > 0, "the chaos row injected no faults"
+    guard = result.get("guardrails") or {}
+    assert not guard.get("halted"), \
+        "guardrails halted under the standard (packet-level) plan"
     if outfile is not None and "--write" in sys.argv:
         _write_results(outfile, [result])
 
@@ -1125,6 +1397,16 @@ def main():
             print("native .so unavailable; build with make -C native",
                   file=sys.stderr)
             return
+    if "--poison" in sys.argv:
+        # Guardrail chaos drill (ISSUE 8 acceptance row): NaN-poison
+        # stream on a live transport → quarantine + auto-rollback +
+        # convergence to the reward target anyway.
+        result = run_guardrail_drill(
+            transport=transport,
+            duration_s=25.0 if quick else 150.0,
+            reward_target=None if quick else 125.0)
+        _finish_guardrail_drill(result, f"guardrail_drill_{transport}.json")
+        return
     if "--chaos" in sys.argv:
         # Crash-recovery soak: faults injected per the standard plan +
         # learner SIGKILL/resume mid-window; commits MTTR and the

@@ -75,6 +75,18 @@ class AlgorithmBase(abc.ABC):
     # first increment materializes the instance counter.
     dropped_nonfinite = 0
 
+    # The per-algorithm finite guard's enable flag. The guardrail plane
+    # (relayrl_tpu/guardrails) sets it False ONLY in the observe-only
+    # "warn" validation mode — the plane then owns the boundary and this
+    # belt must stand down or warn-mode silently re-enforces. Everywhere
+    # else it stays True (belt-and-suspenders under "enforce").
+    ingest_finite_guard = True
+
+    # Divergence-watchdog probe source (guardrails/watchdog.GuardProbes),
+    # installed by Guardrails.attach_algorithm; None = no probes, the
+    # dispatch paths pay one identity check.
+    _guard_probes = None
+
     # Bounded async-dispatch window (runtime/pipeline.InflightWindow);
     # class defaults so pre-existing subclasses/tests that never touch
     # the pipeline keep working. max_inflight_updates=0 restores the
@@ -181,6 +193,88 @@ class AlgorithmBase(abc.ABC):
 
             self._inflight = InflightWindow(self.max_inflight_updates)
         return self._inflight
+
+    # -- divergence-watchdog probes (guardrails plane) --
+    def _guard_probe_tree(self):
+        """The param tree the health probes observe. The on-policy and
+        value families keep trainable params at ``state.params``; the
+        actor-critic families (SAC/DDPG/TD3) split them across
+        ``*_params`` fields — collect those, excluding ``target_*``
+        (polyak copies of what is already probed). Anything else falls
+        back to the whole state tree: the finiteness probe stays
+        meaningful on any pytree of arrays."""
+        state = self.state
+        params = getattr(state, "params", None)
+        if params is not None:
+            return params
+        fields = getattr(type(state), "__dataclass_fields__", None)
+        if fields:
+            tree = {name: getattr(state, name) for name in fields
+                    if name.endswith("_params")
+                    and not name.startswith("target_")}
+            if tree:
+                return tree
+        return state
+
+    def _guard_pre_update(self):
+        """Async D2D copy of the probe target, taken BEFORE the donating
+        update so the old buffers are still live (the update-norm
+        probe's base). None when probes are off — one identity check.
+        A probe failure DISABLES probes (logged once) instead of
+        propagating: the guardrail plane must never break the learner
+        it protects."""
+        probes = self._guard_probes
+        if probes is None:
+            return None
+        try:
+            return probes.pre_update(self._guard_probe_tree())
+        except Exception as e:
+            self._guard_probes = None
+            print(f"[guardrails] health probes DISABLED "
+                  f"(pre-update probe failed: {e!r})", flush=True)
+            return None
+
+    def _guard_merge_probes(self, metrics, old_copy) -> Mapping[str, Any]:
+        """Merge the post-update probe scalars (unresolved device
+        arrays) into ``metrics``; pass-through when probes are off. The
+        merged dict rides the in-flight window and LazyMetrics exactly
+        like the update's own metrics — resolved at the fence, never on
+        the dispatch path."""
+        probes = self._guard_probes
+        if probes is None:
+            return metrics
+        merged = dict(metrics)
+        try:
+            merged.update(probes.post_update(old_copy,
+                                             self._guard_probe_tree()))
+        except Exception as e:
+            self._guard_probes = None
+            print(f"[guardrails] health probes DISABLED "
+                  f"(post-update probe failed: {e!r})", flush=True)
+            return metrics
+        return merged
+
+    def force_version(self, version: int) -> None:
+        """Fast-forward the model version PAST a rolled-back line of
+        history (guardrail rollback): the restored params keep training
+        under a version higher than anything the poisoned line
+        published, so actor swap gates, artifact gates, and checkpoint
+        step numbering all stay monotonic. Step numbers are labels — the
+        true state is the restored tree (checkpoint/manager.py)."""
+        import jax.numpy as jnp
+
+        step = self.state.step
+        self.state = self.state.replace(
+            step=jnp.asarray(int(version), dtype=step.dtype))
+        self._dispatched_updates = None
+
+    def reset_ingest_buffers(self) -> None:
+        """Drop partially-accumulated host-side ingest state after a
+        rollback (a poisoned stream may have part-filled it). Base:
+        nothing to drop; on-policy clears its epoch buffer. The
+        off-policy replay ring is restored by the checkpoint's aux
+        snapshot instead (or deliberately kept when the step carried
+        none — stale-but-finite experience is valid off-policy data)."""
 
     def _sync_version_mirror(self) -> None:
         """Initialize the host-side step mirror BEFORE the first async

@@ -227,15 +227,17 @@ class OffPolicyAlgorithm(AlgorithmBase):
                       else np.stack([np.asarray(b[key]) for b in chunk]))
                 for key in chunk[0]}
             self._sync_version_mirror()
+            probe_base = self._guard_pre_update()
             self.state, ms = self._fused_update()(
                 self.state, self._to_device(stacked))
             self._dispatched_updates += k
             # Per-row device slices dispatch lazily — no host readback on
             # the dispatch path; resolution happens where the values are
-            # read (log_epoch / a test's _last_metrics access).
-            self._last_metrics = LazyMetrics(
-                {key: v[-1] for key, v in ms.items()})
-            self.inflight.push(ms)
+            # read (log_epoch / a test's _last_metrics access). Probes
+            # cover the whole fused dispatch (the k-th update's params).
+            self._last_metrics = LazyMetrics(self._guard_merge_probes(
+                {key: v[-1] for key, v in ms.items()}, probe_base))
+            self.inflight.push((ms, self._last_metrics.device))
             i += k
         for b in host_batches[i:]:
             self.train_on_batch(b)
@@ -251,9 +253,11 @@ class OffPolicyAlgorithm(AlgorithmBase):
         from relayrl_tpu.runtime.pipeline import LazyMetrics
 
         self._sync_version_mirror()
+        probe_base = self._guard_pre_update()
         self.state, metrics = self._update(self.state,
                                            self._to_device(host_batch))
         self._dispatched_updates += 1
+        metrics = self._guard_merge_probes(metrics, probe_base)
         self._last_metrics = LazyMetrics(metrics)
         self.inflight.push(metrics)
         # No logger.store here (the old per-update rows were never
@@ -261,6 +265,23 @@ class OffPolicyAlgorithm(AlgorithmBase):
         # the stored lists only grew for the life of the process — and as
         # device scalars they would also pin XLA buffers).
         return self._last_metrics
+
+    def reset_ingest_buffers(self) -> None:
+        """Guardrail rollback: stale-but-finite replay experience is
+        valid off-policy data, so the ring is normally kept (or replaced
+        wholesale by the restored checkpoint's aux snapshot). But when
+        the ingest finite belt is standing down (guardrails' "warn"
+        posture sets ``ingest_finite_guard = False``), admitted poison
+        may sit in the ring — including inside a restored aux snapshot,
+        whose healthy-at-save tag covers the params, not unsampled
+        experience — and every post-restore update would re-diverge
+        until the rollback budget burns down to halt. Scrub it."""
+        if not self.ingest_finite_guard:
+            dropped = self.buffer.scrub_nonfinite()
+            if dropped:
+                print(f"[guardrails] replay ring scrubbed after rollback: "
+                      f"{dropped} non-finite transition(s) dropped",
+                      flush=True)
 
     # -- multi-host contract (server broadcast loop; SURVEY §7.4 item 5) --
     def accumulate(self, item):
@@ -282,7 +303,7 @@ class OffPolicyAlgorithm(AlgorithmBase):
             return None
         else:
             rew_total = float(sum(a.rew for a in item))
-        if not trajectory_is_finite(item):
+        if self.ingest_finite_guard and not trajectory_is_finite(item):
             # Replay poisoning is worse than the on-policy case — a
             # non-finite transition keeps resampling forever.
             self._drop_nonfinite()

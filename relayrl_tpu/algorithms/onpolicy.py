@@ -143,7 +143,7 @@ class OnPolicyAlgorithm(AlgorithmBase):
             # Marker-only trajectories (stranded by a capacity flush)
             # carry no steps; padding would raise on the empty fold.
             return None
-        if not trajectory_is_finite(item):
+        if self.ingest_finite_guard and not trajectory_is_finite(item):
             self._drop_nonfinite()
             return None
         if self.buffer.add_episode(item):
@@ -161,9 +161,13 @@ class OnPolicyAlgorithm(AlgorithmBase):
         from relayrl_tpu.runtime.pipeline import LazyMetrics
 
         self._sync_version_mirror()
+        # Health-probe base copy BEFORE the donating update (guardrails
+        # plane; None without probes) — see base._guard_pre_update.
+        probe_base = self._guard_pre_update()
         self.state, metrics = self._update(self.state,
                                            self._to_device(host_batch))
         self._dispatched_updates += 1
+        metrics = self._guard_merge_probes(metrics, probe_base)
         self._last_metrics = LazyMetrics(metrics)
         self.inflight.push(metrics)
         return self._last_metrics
@@ -232,6 +236,11 @@ class OnPolicyAlgorithm(AlgorithmBase):
 
         self._gather_params = jax.jit(lambda p: p,
                                       out_shardings=replicated(mesh))
+
+    def reset_ingest_buffers(self) -> None:
+        """Guardrail rollback: a poisoned stream may have part-filled the
+        epoch buffer; those episodes belong to the rolled-back line."""
+        self.buffer.reset()
 
     def capture_epoch_stats(self, updated: bool):
         """One update == one epoch for this family: a log is due exactly

@@ -4,6 +4,8 @@ from relayrl_tpu.checkpoint.manager import (
     CheckpointManager,
     checkpoint_algorithm,
     restore_algorithm,
+    restore_latest_healthy,
 )
 
-__all__ = ["CheckpointManager", "checkpoint_algorithm", "restore_algorithm"]
+__all__ = ["CheckpointManager", "checkpoint_algorithm",
+           "restore_algorithm", "restore_latest_healthy"]

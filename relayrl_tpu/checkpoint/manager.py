@@ -119,6 +119,32 @@ class CheckpointManager:
                 return restored.get("aux")
         return None
 
+    def read_extra(self, step: int) -> dict:
+        """The JSON extras of one retained step WITHOUT touching the
+        state arrays — how the rollback path reads health tags cheaply
+        (a step predating the tag returns {} → treated unhealthy by
+        :meth:`healthy_steps`, conservatively)."""
+        import orbax.checkpoint as ocp
+
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
+        return dict(restored.get("extra") or {})
+
+    def healthy_steps(self) -> list[int]:
+        """Retained steps whose save-time extras carry ``healthy: true``
+        (ascending). The guardrail rollback ring: the server tags each
+        periodic save with the watchdog's verdict AFTER quiescing the
+        in-flight window, so a healthy tag means every update baked into
+        that step had its probes resolved clean."""
+        out = []
+        for step in self._mgr.all_steps():
+            try:
+                if self.read_extra(step).get("healthy"):
+                    out.append(step)
+            except Exception:
+                continue  # unreadable step: never a rollback target
+        return sorted(out)
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 
@@ -130,7 +156,8 @@ def checkpoint_algorithm(algo, directory: str | None = None,
                          wait: bool = False,
                          include_aux: bool = True,
                          overwrite: bool = False,
-                         max_to_keep: int | None = None) -> CheckpointManager:
+                         max_to_keep: int | None = None,
+                         extra_meta: dict | None = None) -> CheckpointManager:
     """Save an algorithm's full state (convenience used by the server).
 
     ``include_aux=False`` skips the replay-buffer snapshot: for a large
@@ -159,6 +186,10 @@ def checkpoint_algorithm(algo, directory: str | None = None,
         "version": int(algo.version),
         "arch": algo.arch,
     }
+    if extra_meta:
+        # Caller metadata rides the JSON extras (the guardrail plane's
+        # healthy-at-save tag); the reserved keys above win on collision.
+        extra = {**dict(extra_meta), **extra}
     # aux (replay buffer) is single-host only: on a multi-process mesh the
     # orbax save is collective and every rank must contribute an identical
     # structure, but the buffer lives on the coordinator alone — multi-host
@@ -171,11 +202,40 @@ def checkpoint_algorithm(algo, directory: str | None = None,
     return mgr
 
 
+def restore_latest_healthy(algo, directory: str | None = None) -> int:
+    """Last-known-good restore: roll ``algo`` back to the NEWEST retained
+    checkpoint tagged ``healthy: true`` at save time. Returns the
+    restored step. Raises FileNotFoundError when no healthy step is
+    retained — the rollback path then degrades to halt-and-alarm rather
+    than restoring a step the watchdog never cleared.
+
+    Uses the algorithm's cached manager when it matches the directory
+    (the live server's, with its retention settings); callers must
+    :meth:`CheckpointManager.wait` out any in-flight async save first so
+    the step listing is settled."""
+    directory = directory or osp.join(".", "checkpoints")
+    mgr = getattr(algo, "_ckpt_mgr", None)
+    own = mgr is None or mgr.directory != osp.abspath(directory)
+    if own:
+        mgr = CheckpointManager(directory)
+    try:
+        healthy = mgr.healthy_steps()
+        if not healthy:
+            raise FileNotFoundError(
+                f"no healthy-tagged checkpoint retained in {directory}")
+        restore_algorithm(algo, directory, step=healthy[-1], manager=mgr)
+        return healthy[-1]
+    finally:
+        if own:
+            mgr.close()
+
+
 def restore_algorithm(algo, directory: str | None = None,
-                      step: int | None = None) -> None:
+                      step: int | None = None,
+                      manager: CheckpointManager | None = None) -> None:
     """Restore a previously checkpointed algorithm in place."""
     directory = directory or osp.join(".", "checkpoints")
-    mgr = CheckpointManager(directory)
+    mgr = manager if manager is not None else CheckpointManager(directory)
     # Symmetric with the save-side gate: the replay buffer is a
     # coordinator-only host structure, so a multi-process resume of a
     # single-host checkpoint skips it (the ring refills) instead of
@@ -193,4 +253,5 @@ def restore_algorithm(algo, directory: str | None = None,
     algo._dispatched_updates = None
     if aux is not None:
         algo.restore_aux(aux)
-    mgr.close()
+    if manager is None:
+        mgr.close()

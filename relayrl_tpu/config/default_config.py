@@ -244,6 +244,77 @@ DEFAULT_CONFIG: dict = {
             "breaker_reset_s": 2.0,
         },
     },
+    # -- training-health guardrails (relayrl_tpu/guardrails/,
+    #    docs/operations.md "Training-health guardrails") --
+    "guardrails": {
+        # false = no guardrail object is built at all: ingest validation,
+        # quarantine, watchdog, rollback, and backpressure all disappear
+        # and every hook site costs one identity check (the telemetry/
+        # faults process-model precedent).
+        "enabled": True,
+        # Ingest validation posture: "enforce" rejects invalid
+        # trajectories before they touch the staging slabs; "warn"
+        # counts + strikes but ADMITS them (observe-only — the
+        # defense-in-depth drill posture; also stands the per-algorithm
+        # finite guard down); "off" skips validation entirely.
+        "ingest_validation": "enforce",
+        # Per-trajectory length bound for the validator; null derives
+        # from max_traj_length.
+        "max_steps": None,
+        # -- poison-agent quarantine --
+        # Strikes (validation rejections) within strike_window_s before
+        # an agent is quarantined; quarantined sends are rejected (typed
+        # nack on ack-capable transports) until the cooldown paroles it.
+        "strike_threshold": 3,
+        "strike_window_s": 60.0,
+        "quarantine_cooldown_s": 300.0,
+        # -- divergence watchdog --
+        "watchdog": True,
+        # Device-side probes merged into each update's metrics (resolved
+        # lazily at the in-flight fence; observers — bit-identical
+        # params on vs off). update_norm_probe adds a pre-update D2D
+        # params copy to compute ||new - old|| (the grad-norm proxy).
+        "probes": True,
+        "update_norm_probe": True,
+        # Trip thresholds; 0/null disables that detector. param-norm
+        # and update-norm are global L2 over float leaves.
+        "max_param_norm": 1000000.0,
+        "max_update_norm": 0,
+        # Loss spike: |loss| beyond factor x rolling-median(loss_window)
+        # trips; loss_key "auto" picks LossPi/LossQ/Loss. 0 = off
+        # (non-finite loss always trips while the watchdog is on).
+        "loss_spike_factor": 0,
+        "loss_window": 16,
+        "loss_key": "auto",
+        # Reward collapse: rolling mean (reward_window trajectories)
+        # dropping more than this many reward units below its best trips
+        # the watchdog. Workload-specific — 0 = off by default.
+        "reward_collapse_drop": 0,
+        "reward_window": 32,
+        # -- last-known-good auto-rollback --
+        "rollback": True,
+        # Retained checkpoints (the ring the rollback searches for the
+        # newest healthy-tagged step); raises the effective orbax
+        # max_to_keep to at least this.
+        "checkpoint_ring": 5,
+        # Rollbacks allowed within rollback_window_s before guardrails
+        # degrade to halt-and-alarm (training stops, process survives).
+        "max_rollbacks": 3,
+        "rollback_window_s": 600.0,
+        # -- ingest backpressure --
+        # Soft admission bound on the raw ingest queue (the 100k hard
+        # cap is the OOM guard, not a policy). 0 disables backpressure.
+        "ingest_soft_limit": 8192,
+        # "drop_oldest" evicts the globally oldest queued trajectory
+        # (freshest-wins; the victim's seq is retracted so spool replay
+        # can redeliver) | "nack" refuses the arrival with a typed
+        # retry-after where the transport can answer.
+        "shed_policy": "drop_oldest",
+        # One agent may hold at most this fraction of the soft limit;
+        # beyond it the agent sheds its OWN arrivals (flood fairness).
+        "agent_share": 0.5,
+        "nack_retry_after_s": 1.0,
+    },
     # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
     "telemetry": {
         # false = the process-global registry stays a NullRegistry: every

@@ -33,6 +33,20 @@ from relayrl_tpu.config.default_config import (
 
 DEFAULT_CONFIG_FILENAME = "relayrl_config.json"
 
+#: (config_path, dotted_key) pairs already warned about — unknown-key
+#: warnings fire once per process per file, not once per ConfigLoader
+#: (a server + N agents in one process would otherwise repeat them).
+_warned_unknown_keys: set[tuple[str, str]] = set()
+
+
+def _closest(key: str, candidates) -> str | None:
+    """Nearest known key for the typo hint, or None when nothing close."""
+    import difflib
+
+    matches = difflib.get_close_matches(key, [str(c) for c in candidates],
+                                        n=1, cutoff=0.6)
+    return matches[0] if matches else None
+
 
 class Endpoint:
     """One server address `{prefix, host, port}`
@@ -99,6 +113,7 @@ class ConfigLoader:
                     self._raw = default_config()
         else:
             self._raw = default_config()
+        self._warn_unknown_keys()
         if algorithm_name is not None and algorithm_name.upper() not in SUPPORTED_ALGORITHMS:
             # The reference whitelists but ultimately tolerates unknown algos
             # (they resolve to empty params); keep that permissiveness for
@@ -109,6 +124,50 @@ class ConfigLoader:
                 f"algorithm {algorithm_name!r} is not in the built-in registry "
                 f"{SUPPORTED_ALGORITHMS}; treating as a plugin"
             )
+
+    def _warn_unknown_keys(self) -> None:
+        """Warn ONCE per (config file, key) about keys the framework will
+        never read: unknown top-level sections (the classic typo'd
+        ``guardrials:`` block — silently ignored until this check) and
+        unknown keys inside the known non-algorithm sections. Unknown
+        ALGORITHM hyperparams are deliberately exempt (plugin algorithms
+        take arbitrary overrides); ``_comment*`` keys are the config
+        file's documented escape hatch."""
+        import warnings
+
+        def warn(key: str, hint: str) -> None:
+            marker = (str(self.config_path), key)
+            if marker in _warned_unknown_keys:
+                return
+            _warned_unknown_keys.add(marker)
+            warnings.warn(f"config key {key!r} is not recognized and will "
+                          f"be ignored{hint}", stacklevel=4)
+
+        known_top = set(DEFAULT_CONFIG) | {"grpc_idle_timeout_s",
+                                           "grpc_idle_timeout",
+                                           "max_traj_length"}
+        for key in self._raw:
+            if str(key).startswith("_comment"):
+                continue
+            if key not in known_top:
+                close = _closest(str(key), known_top)
+                warn(str(key), f" (did you mean {close!r}?)" if close else "")
+        # Sections whose key set IS the contract (algorithms excluded:
+        # hyperparam overrides are open-ended by design).
+        for section in ("actor", "transport", "learner", "telemetry",
+                        "guardrails", "model_paths", "server",
+                        "training_tensorboard"):
+            defaults = DEFAULT_CONFIG.get(section)
+            loaded = self._section(section)
+            if not isinstance(defaults, Mapping) or not loaded:
+                continue
+            for key in loaded:
+                if str(key).startswith("_comment") or key in defaults:
+                    continue
+                close = _closest(str(key), set(defaults))
+                warn(f"{section}.{key}",
+                     f" (did you mean {section}.{close!r}?)" if close
+                     else "")
 
     # -- getters (ref: config_loader.rs:344-555) --
     def _section(self, key: str) -> Mapping:
@@ -263,6 +322,63 @@ class ConfigLoader:
         if isinstance(retry, Mapping):
             defaults.update(retry)
         params["retry"] = defaults
+        return params
+
+    def get_guardrails_params(self) -> dict[str, Any]:
+        """Training-health knobs (``guardrails.*`` — see
+        docs/operations.md "Training-health guardrails"), defaults
+        merged under user overrides; malformed values degrade to the
+        built-ins (the guardrail plane must never crash the process it
+        protects)."""
+        params = dict(DEFAULT_CONFIG["guardrails"])
+        params.update(self._section("guardrails"))
+        params["enabled"] = bool(params.get("enabled", True))
+        if params.get("ingest_validation") not in ("enforce", "warn", "off"):
+            params["ingest_validation"] = "enforce"
+        for key, default, lo in (
+                ("strike_threshold", 3, 1),
+                ("loss_window", 16, 4),
+                ("reward_window", 32, 4),
+                ("checkpoint_ring", 5, 1),
+                ("max_rollbacks", 3, 0),
+                ("ingest_soft_limit", 8192, 0)):
+            try:
+                params[key] = max(lo, int(params.get(key, default)))
+            except (TypeError, ValueError):
+                params[key] = default
+        for key, default in (
+                ("strike_window_s", 60.0), ("quarantine_cooldown_s", 300.0),
+                ("rollback_window_s", 600.0), ("agent_share", 0.5),
+                ("nack_retry_after_s", 1.0)):
+            try:
+                value = params.get(key, default)
+                params[key] = max(0.0, float(default if value is None
+                                             else value))
+            except (TypeError, ValueError):
+                params[key] = default
+        for key, default in (
+                ("max_param_norm", 1e6), ("max_update_norm", 0.0),
+                ("loss_spike_factor", 0.0), ("reward_collapse_drop", 0.0)):
+            # Trip thresholds honor the documented "0/null disables"
+            # contract: an explicit null means the detector is OFF, not
+            # back to a default that keeps it armed.
+            try:
+                value = params.get(key, default)
+                params[key] = max(0.0, float(0.0 if value is None
+                                             else value))
+            except (TypeError, ValueError):
+                params[key] = default
+        try:
+            max_steps = params.get("max_steps")
+            params["max_steps"] = (None if max_steps is None
+                                   else max(0, int(max_steps)))
+        except (TypeError, ValueError):
+            params["max_steps"] = None
+        for key in ("watchdog", "probes", "update_norm_probe", "rollback"):
+            params[key] = bool(params.get(key, True))
+        if params.get("shed_policy") not in ("drop_oldest", "nack"):
+            params["shed_policy"] = "drop_oldest"
+        params["loss_key"] = str(params.get("loss_key") or "auto")
         return params
 
     def get_telemetry_params(self) -> dict[str, Any]:

@@ -134,3 +134,11 @@ class EpochBuffer:
         rets, lens = self.episode_returns, self.episode_lengths
         self.episode_returns, self.episode_lengths = [], []
         return rets, lens
+
+    def reset(self) -> None:
+        """Drop the part-filled epoch (and its stats) — the guardrail
+        rollback path: episodes buffered on a rolled-back line of
+        history must not leak into the restored line's first epoch."""
+        self._pending.clear()
+        self.episode_returns.clear()
+        self.episode_lengths.clear()

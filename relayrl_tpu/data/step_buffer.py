@@ -210,6 +210,42 @@ class StepReplayBuffer:
             stored += 1
         return stored
 
+    def scrub_nonfinite(self) -> int:
+        """Drop every stored transition carrying a non-finite value in
+        any float field, compacting the survivors to the front of the
+        ring in chronological order. Returns how many were dropped.
+
+        Normally the ring is finite by construction (the off-policy
+        ingest belt rejects non-finite trajectories before ``add_*``);
+        under guardrails' ``ingest_validation: "warn"`` posture that
+        belt stands down, and a post-rollback ring may hold admitted
+        poison that would re-diverge every update after the restore —
+        this is the rollback path's decontamination pass."""
+        s = self.size
+        if s == 0:
+            return 0
+        if s == self.capacity and self.ptr:
+            order = np.r_[self.ptr:s, 0:self.ptr]
+        else:
+            order = np.arange(s)
+        keep = np.isfinite(self.rew[order]) & np.isfinite(self.done[order])
+        keep &= np.isfinite(self.mask2[order]).all(axis=1)
+        if self.obs_dtype != np.uint8:  # uint8 cannot hold NaN/Inf
+            keep &= np.isfinite(self.obs[order]).all(axis=1)
+            keep &= np.isfinite(self.obs2[order]).all(axis=1)
+        if not self.discrete:
+            keep &= np.isfinite(self.act[order]).all(axis=1)
+        dropped = int(s - keep.sum())
+        if dropped == 0:
+            return 0
+        kept = order[keep]
+        for name in ("obs", "obs2", "act", "mask2", "rew", "done"):
+            arr = getattr(self, name)
+            arr[: len(kept)] = arr[kept]
+        self.size = len(kept)
+        self.ptr = self.size % self.capacity
+        return dropped
+
     def state_arrays(self) -> dict[str, np.ndarray]:
         """Stored transitions in CHRONOLOGICAL order plus counters — the
         checkpoint payload (SURVEY §5.4: the reference loses its buffer on

@@ -19,6 +19,15 @@ Fault ops:
                         one (swap-with-next; network reordering shape).
 * ``corrupt``         — ``corrupt_bytes`` flips bytes mid-frame (exercises
                         CRC rejection / decode-error narrowing).
+* ``nan_poison``      — decodes the trajectory payload, patches finite
+                        floats (rewards + tensor elements) to NaN/Inf,
+                        and re-encodes — a VALID frame carrying poison
+                        data, the guardrail ingest-validation drill
+                        (corrupt breaks the envelope; this breaks the
+                        *semantics*). Non-trajectory payloads pass
+                        through untouched.
+* ``flood``           — burst-amplifies the send ``flood_factor``× (the
+                        ingest-backpressure / per-agent-fairness drill).
 * ``kill_connection`` — the transport abruptly closes its live socket
                         (heal/redial paths take over).
 * ``kill_process``    — the hosting process SIGKILLs itself (the actor
@@ -41,7 +50,7 @@ import threading
 from dataclasses import dataclass, field
 
 FAULT_OPS = ("drop", "delay", "duplicate", "reorder", "corrupt",
-             "kill_connection", "kill_process")
+             "nan_poison", "flood", "kill_connection", "kill_process")
 
 #: Hook sites the runtime/transports expose (free-form sites are legal —
 #: a rule naming a site nobody hooks simply never fires).
@@ -75,6 +84,59 @@ def corrupt_bytes(payload: bytes, seed: int, site: str,
     return bytes(out)
 
 
+def nan_poison_bytes(payload: bytes, seed: int, site: str,
+                     op_index: int) -> bytes:
+    """Deterministically patch a trajectory payload's finite floats to
+    NaN/Inf and re-encode: a frame that stays wire-VALID (envelope, CRC,
+    msgpack all intact) but carries semantically poisoned data — the
+    guardrail ingest-validation drill. Handles both shapes the hook
+    sites see: the ``agent.send`` envelope (``{"id", "traj"}``) and the
+    bare ``server.ingest`` trajectory frame. Rewards become NaN and the
+    first element of each float obs tensor becomes +/-Inf (alternating
+    off the plan hash, so drills exercise both non-finite kinds).
+    Anything that fails to decode as a Python-codec trajectory (native
+    columnar frames, model bundles, junk) passes through untouched —
+    injection must never raise into the host path."""
+    try:
+        import msgpack
+        import numpy as np
+
+        from relayrl_tpu.types.trajectory import (
+            deserialize_actions,
+            serialize_actions,
+        )
+
+        agent_id = None
+        body = payload
+        try:
+            env = msgpack.unpackb(bytes(payload), raw=False)
+            if isinstance(env, dict) and "traj" in env:
+                agent_id = str(env.get("id", "?"))
+                body = env["traj"]
+        except Exception:
+            pass  # not an envelope: try the bare trajectory frame
+        records = deserialize_actions(body)
+        if not records:
+            return payload
+        bad = (np.inf if _u01(seed, site, op_index, 20_000, 0) < 0.5
+               else -np.inf)
+        for rec in records:
+            rec.rew = float("nan")
+            obs = rec.obs
+            if (isinstance(obs, np.ndarray) and obs.dtype.kind == "f"
+                    and obs.size):
+                obs = obs.copy()
+                obs.flat[0] = bad
+                rec.obs = obs
+        body = serialize_actions(records)
+        if agent_id is not None:
+            return msgpack.packb({"id": agent_id, "traj": body},
+                                 use_bin_type=True)
+        return body
+    except Exception:
+        return payload
+
+
 @dataclass
 class FaultRule:
     site: str
@@ -85,6 +147,7 @@ class FaultRule:
     until: int | None = None   # active window: op index < until
     count: int | None = None   # cap on total firings (None = unbounded)
     delay_s: float = 0.0       # for op == "delay"
+    flood_factor: int = 8      # for op == "flood": total copies delivered
     salt: int = 0              # decorrelates rules sharing (site, prob)
 
     def __post_init__(self):
@@ -108,6 +171,8 @@ class FaultRule:
             d["count"] = self.count
         if self.delay_s:
             d["delay_s"] = self.delay_s
+        if self.op == "flood" and self.flood_factor != 8:
+            d["flood_factor"] = self.flood_factor
         if self.salt:
             d["salt"] = self.salt
         return d
@@ -123,6 +188,7 @@ class FaultRule:
                    count=(None if d.get("count") is None
                           else int(d["count"])),
                    delay_s=float(d.get("delay_s", 0.0)),
+                   flood_factor=int(d.get("flood_factor", 8)),
                    salt=int(d.get("salt", 0)))
 
     def fires(self, seed: int, op_index: int, fired_so_far: int) -> bool:
@@ -166,7 +232,8 @@ class _Decision:
 #: corrupt the injection ledger (counted faults that never happened).
 _OP_CLASS = {"drop": "payload", "delay": "payload",
              "duplicate": "payload", "reorder": "payload",
-             "corrupt": "payload", "kill_connection": "kill_connection",
+             "corrupt": "payload", "nan_poison": "payload",
+             "flood": "payload", "kill_connection": "kill_connection",
              "kill_process": "kill_process"}
 
 
@@ -240,19 +307,29 @@ class SiteInjector:
         k = self._op_index["payload"] - 1
         delay = 0.0
         out_payload = payload
-        dropped = duplicated = reordered = False
+        copies = 1
+        dropped = reordered = False
         for rule in fired:
             if rule.op == "drop":
                 dropped = True
             elif rule.op == "delay":
                 delay += rule.delay_s  # several delay rules stack
             elif rule.op == "duplicate":
-                duplicated = True
+                copies += 1
             elif rule.op == "reorder":
                 reordered = True
             elif rule.op == "corrupt":
                 out_payload = corrupt_bytes(out_payload, self._plan.seed,
                                             self.site, k)
+            elif rule.op == "nan_poison":
+                out_payload = nan_poison_bytes(out_payload,
+                                               self._plan.seed,
+                                               self.site, k)
+            elif rule.op == "flood":
+                # Burst-amplify: this op delivers flood_factor copies in
+                # one call (stacks multiplicatively with duplicate — a
+                # retry storm atop a flood is a legal drill).
+                copies *= max(1, int(rule.flood_factor))
         with self._lock:
             held, self._held = self._held, []
         out: list[tuple[float, bytes]] = [(0.0, h) for h in held]
@@ -262,9 +339,7 @@ class SiteInjector:
             with self._lock:
                 self._held.append(out_payload)
             return out
-        out.append((delay, out_payload))
-        if duplicated:
-            out.append((delay, out_payload))
+        out.extend((delay, out_payload) for _ in range(copies))
         return out
 
     def _take_kill(self, op: str) -> bool:
@@ -369,4 +444,4 @@ class FaultPlan:
 
 
 __all__ = ["FAULT_OPS", "KNOWN_SITES", "FaultRule", "FaultPlan",
-           "SiteInjector", "corrupt_bytes"]
+           "SiteInjector", "corrupt_bytes", "nan_poison_bytes"]

@@ -196,7 +196,16 @@ class Agent:
         if self.spool is not None:
             self.spool.send(payload, self.transport.identity)
         else:  # actor.spool_entries == 0: the pre-recovery direct path
-            self.transport.send_trajectory(payload)
+            from relayrl_tpu.transport.base import IngestNack
+
+            try:
+                self.transport.send_trajectory(payload)
+            except IngestNack:
+                # The server answered with a guardrail verdict
+                # (quarantine/overload). Spool-less there is nothing to
+                # retain or replay — drop, never crash the env loop
+                # (the spooled path routes this through spool._attempt).
+                pass
 
     def _bind_spool(self) -> None:
         name = self._addr_overrides.get("identity") or "agent"
@@ -421,8 +430,13 @@ class VectorAgent:
         if self.spool is not None:
             self.spool.send(payload, self.agent_ids[lane])
         else:
-            self.transport.send_trajectory(payload,
-                                           agent_id=self.agent_ids[lane])
+            from relayrl_tpu.transport.base import IngestNack
+
+            try:
+                self.transport.send_trajectory(payload,
+                                               agent_id=self.agent_ids[lane])
+            except IngestNack:
+                pass  # guardrail verdict, spool-less: drop (see Agent)
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
         # ONE receipt serves all lanes: a single wire-aware swap

@@ -142,6 +142,21 @@ class TrainingServer:
         self._fault_ingest = faults.site("server.ingest")
         self._fault_publish = faults.site("server.publish")
 
+        # Training-health guardrails (relayrl_tpu/guardrails/): ingest
+        # validation + quarantine, divergence watchdog, last-known-good
+        # rollback, and ingest backpressure. None when guardrails.enabled
+        # is false — every hook site below then costs one identity check.
+        from relayrl_tpu.guardrails import build_guardrails
+
+        self.guardrails = build_guardrails(self.config)
+        # Rollback bookkeeping (learner thread only): timestamps of
+        # executed rollbacks inside the budget window, and the degraded
+        # halt-and-alarm latch (halted = ingest sheds, training stops,
+        # the process survives for operator forensics).
+        self._rollback_times: list[float] = []
+        self._rollbacks_total = 0
+        self._halted = False
+
         # Multi-host bring-up must precede any other JAX use (no-op for the
         # default single-host config; RELAYRL_COORDINATOR etc. override).
         from relayrl_tpu.parallel.distributed import initialize_distributed
@@ -176,6 +191,11 @@ class TrainingServer:
             buf_size=buf_size,
             **hp,
         )
+        if self.guardrails is not None:
+            # Installs the device-side health probes (observers — params
+            # stay bit-identical to guardrails-off) and aligns the
+            # per-algorithm finite guard with the validation mode.
+            self.guardrails.attach_algorithm(self.algorithm)
 
         learner_cfg = self.config.get_learner_params()
         # One resolution for save AND resume — a falsy configured value
@@ -203,6 +223,12 @@ class TrainingServer:
             1, int(learner_cfg.get("checkpoint_aux_every", 1)))
         self._ckpt_keep = max(CheckpointManager.DEFAULT_MAX_TO_KEEP,
                               self._aux_every)
+        if self.guardrails is not None and self.guardrails.params["rollback"]:
+            # The last-known-good ring: retain at least checkpoint_ring
+            # steps so the rollback search has healthy-tagged candidates
+            # even when the newest saves straddled the divergence.
+            self._ckpt_keep = max(self._ckpt_keep,
+                                  self.guardrails.params["checkpoint_ring"])
         self._ckpt_saves = 0
 
         # Idempotent ingest (runtime/spool.SequenceLedger): sequence-
@@ -331,6 +357,11 @@ class TrainingServer:
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
             self.transport.on_unregister = self._on_unregister
+            if self.guardrails is not None:
+                # Ack-capable transports (gRPC) answer a refused send
+                # with a typed nack (quarantine / overload) instead of a
+                # silent server-side shed — see _check_ingest.
+                self.transport.check_ingest = self._check_ingest
             if getattr(self.transport, "serves_full_bundles_only", False):
                 # This plane (native C++ gRPC long-polls) ships the
                 # stored full bundle to every subscriber regardless —
@@ -463,7 +494,8 @@ class TrainingServer:
                         # instead of being skipped (never deletes).
                         checkpoint_algorithm(self.algorithm,
                                              self._checkpoint_dir, wait=True,
-                                             overwrite=True)
+                                             overwrite=True,
+                                             extra_meta=self._health_tag())
                         self._save_ledger_sidecar(self.algorithm.version)
                     except Exception as e:
                         self._m_ckpt_failures.inc()
@@ -557,18 +589,105 @@ class TrainingServer:
             return
         self._ingest_one(agent_id, payload)
 
+    def _check_ingest(self, tagged_id: str):
+        """Guardrail admission verdict for ack-capable transports (the
+        pure-grpcio servicer calls this BEFORE on_trajectory): ``None``
+        admits; ``(nack_code, reason, retry_after_s)`` is returned to
+        the sender as a typed nack the actor's spool understands
+        (quarantine → discard the entry; overload → keep it, replay
+        later). Broadcast planes — and the native C++ gRPC server,
+        which acks in C++ before Python sees the send — never call
+        this; the same verdicts are enforced server-side in _ingest_one.
+        Runs on transport threads."""
+        g = self.guardrails
+        if g is None:
+            return None
+        from relayrl_tpu.transport.base import (
+            NACK_OVERLOADED,
+            NACK_QUARANTINED,
+            split_agent_seq,
+        )
+
+        agent_id, _ = split_agent_seq(tagged_id)
+        if self._halted:
+            # NOT counted as a halted drop: an overload nack is retained
+            # by the sender's spool and replayed — counting each replay
+            # would read as unbounded data loss that never happened (the
+            # genuine-shed sites in _ingest_one/_on_trajectory_decoded
+            # own that counter).
+            return (NACK_OVERLOADED, "guardrails halted", 30.0)
+        if g.quarantine.is_quarantined(agent_id):
+            g.quarantine.count_rejected_send()
+            return (NACK_QUARANTINED, "agent quarantined",
+                    g.quarantine.retry_after(agent_id))
+        adm = g.admission
+        if adm is not None and adm.policy == "nack":
+            # Under the nack shed policy the back-channel IS the shed:
+            # decide here so the sender's spool keeps the entry and
+            # retries after the hint. (admit() only mutates shed
+            # counters, so an "admit" verdict here followed by the
+            # _ingest_one re-check is harmless.)
+            verdict = adm.admit(agent_id)
+            if verdict in ("nack", "shed_agent"):
+                reason = ("agent over fair share"
+                          if verdict == "shed_agent" else "ingest overloaded")
+                return (NACK_OVERLOADED, reason, adm.retry_after_s)
+        return None
+
     def _ingest_one(self, agent_id: str, payload: bytes) -> None:
         agent_id, seq, admit = self._admit_seq(agent_id)
         if not admit:
             return
-        try:
-            self._ingest.put_nowait((agent_id, payload))
-        except queue.Full:
+
+        def retract():
+            # un-see the seq: the actor's replay must be able to land
+            # this trajectory later — a shed is backpressure, not dedup.
             if seq is not None and self._ingest_ledger is not None:
-                # un-see the seq: the actor's replay must be able to land
-                # this trajectory later — a Full drop is loss, not dedup.
                 self._ingest_ledger.retract(agent_id, seq)
+
+        g = self.guardrails
+        if g is not None:
+            if self._halted:
+                g._m_halted_drops.inc()
+                retract()
+                return
+            if g.quarantine.is_quarantined(agent_id):
+                # Broadcast planes (zmq PUSH, native) have no per-send
+                # back-channel: the quarantine sheds here, silently to
+                # the sender, loudly to telemetry.
+                g.quarantine.count_rejected_send()
+                retract()
+                return
+            if g.admission is not None:
+                verdict = g.admission.admit(agent_id)
+                if verdict in ("shed_agent", "nack"):
+                    retract()
+                    return
+                if verdict == "evict":
+                    self._evict_oldest_raw()
+        try:
+            self._ingest.put_nowait((agent_id, seq, payload))
+            if g is not None and g.admission is not None:
+                g.admission.note_enqueued(agent_id)
+        except queue.Full:
+            retract()
             self._count_dropped()
+
+    def _evict_oldest_raw(self) -> None:
+        """drop_oldest shed: evict the globally oldest queued raw payload
+        to admit a fresh one (freshest-data-wins). The victim's seq is
+        retracted from the dedup ledger so the owning actor's spool can
+        redeliver it when pressure clears."""
+        try:
+            victim_id, victim_seq, _ = self._ingest.get_nowait()
+        except queue.Empty:
+            return
+        self._ingest.task_done()
+        if victim_seq is not None and self._ingest_ledger is not None:
+            self._ingest_ledger.retract(victim_id, victim_seq)
+        adm = self.guardrails.admission if self.guardrails else None
+        if adm is not None:
+            adm.note_dequeued(victim_id)
 
     def _on_trajectory_decoded(self, batch) -> None:
         """Pre-decoded columnar trajectory batch from the native drain —
@@ -576,6 +695,7 @@ class TrainingServer:
         Sequence tags ride the decoded items' agent ids through the C++
         core; they are split + deduped here, and the clean id is written
         back so per-agent attribution stays tag-free downstream."""
+        g = self.guardrails
         admitted = []
         for item in batch:
             clean_id, seq, admit = self._admit_seq(item.agent_id)
@@ -583,6 +703,22 @@ class TrainingServer:
                 continue
             if clean_id != item.agent_id:
                 item.agent_id = clean_id
+            if g is not None:
+                # Same guardrail funnel as the staged path: halted shed,
+                # quarantine shed, then validation + strike accounting.
+                # (Admission backpressure governs the raw ingest queue;
+                # this plane delivers pre-decoded batches whose depth the
+                # native core already bounds.)
+                if self._halted:
+                    g._m_halted_drops.inc()
+                    continue
+                if g.quarantine.is_quarantined(clean_id):
+                    g.quarantine.count_rejected_send()
+                    if seq is not None and self._ingest_ledger is not None:
+                        self._ingest_ledger.retract(clean_id, seq)
+                    continue
+                if g.validate(clean_id, item) is None:
+                    continue
             admitted.append((item, seq))
         if not admitted:
             return
@@ -684,11 +820,14 @@ class TrainingServer:
             decoder = NativeDecoder()
         except Exception:
             pass  # native codec unavailable: pure-Python decode
+        guard = self.guardrails
         while not self._stop.is_set():
             try:
-                agent_id, payload = self._ingest.get(timeout=0.1)
+                agent_id, seq, payload = self._ingest.get(timeout=0.1)
             except queue.Empty:
                 continue
+            if guard is not None and guard.admission is not None:
+                guard.admission.note_dequeued(agent_id)
             item = None
             t0 = time.monotonic()
             try:
@@ -710,6 +849,12 @@ class TrainingServer:
                     item = deserialize_actions(payload)
             except Exception:
                 self._count_dropped()
+            if item is not None and guard is not None:
+                # Ingest validation + per-agent strike accounting: the
+                # semantic trust boundary, BEFORE the decoded item can
+                # reach the staging slabs. None = rejected (counted,
+                # struck; the poison never reaches the learner plane).
+                item = guard.validate(agent_id, item)
             dt = time.monotonic() - t0
             self._m_decode.observe(dt)  # per-thread shard: no lock needed
             with self._timings_lock:  # N decode workers share the ledger
@@ -892,8 +1037,20 @@ class TrainingServer:
                 # flushing their deferred epoch logs) costs no overlap —
                 # and it is what lets drain() observe pending -> 0.
                 self._pipeline_quiesce()
+                # Everything dispatched is now fenced: resolve every
+                # pending health probe (free post-fence) and act on trips.
+                self._guard_poll()
                 continue
             self.timings["learner_idle_s"] += time.monotonic() - t_wait
+            if self._halted:
+                # Degraded halt-and-alarm: training is stopped (rollback
+                # budget spent / no healthy checkpoint); drain and drop
+                # so the queues don't balloon while the operator digs.
+                if self.guardrails is not None:
+                    self.guardrails._m_halted_drops.inc(
+                        len(item) if isinstance(item, list) else 1)
+                self._decoded.task_done()
+                continue
             t0 = time.monotonic()
             try:
                 # A native drain batch is a list of DecodedTrajectory; a
@@ -910,8 +1067,12 @@ class TrainingServer:
                 self.timings["learn_s"] += time.monotonic() - t0
                 self._decoded.task_done()
         # Shutdown: fence what was dispatched and flush its logs so
-        # disable_server leaves state/progress.txt consistent.
+        # disable_server leaves state/progress.txt consistent — then
+        # resolve the fenced probes, so the signal-path final save's
+        # healthy-at-save tag covers every update baked into it (a
+        # poisoned last update must trip here, not get tagged healthy).
         self._pipeline_quiesce()
+        self._guard_poll()
 
     def _sync_drop_stats(self) -> None:
         """Mirror the algorithm's finite-guard counter into stats — the
@@ -957,6 +1118,13 @@ class TrainingServer:
             return
         finally:
             self._sync_drop_stats()
+        if (updated and self.guardrails is not None
+                and self.guardrails.watchdog is not None):
+            # Queue the dispatched update's (lazy) metrics — probe
+            # scalars included — for the watchdog; they resolve at the
+            # in-flight fence, never here (the LazyMetrics deferral).
+            self.guardrails.watchdog.observe_dispatch(
+                algo.inflight.dispatch_count, algo._last_metrics)
         # Epoch log: captured now (episode counters must not leak across
         # epochs), dumped once the update it describes is fenced.
         payload = algo.capture_epoch_stats(updated)
@@ -986,6 +1154,7 @@ class TrainingServer:
             except Exception as e:  # transient socket/fs errors must not
                 print(f"[TrainingServer] publish error: {e!r}", flush=True)
         self._flush_ready_logs()
+        self._guard_poll()
 
     def _process_one_legacy(self, item) -> None:
         """Pre-pipeline path for plugin algorithms: train + log inside
@@ -1047,6 +1216,150 @@ class TrainingServer:
             win.drain()
         if self._pending_logs:
             self._flush_ready_logs(force=True)
+
+    # -- divergence watchdog + last-known-good rollback (learner thread) --
+    def _guard_poll(self) -> bool:
+        """Resolve fenced health probes and evaluate the watchdog's
+        detectors; a Trip executes the rollback path (or the degraded
+        halt). True when a trip fired — callers gating a checkpoint on
+        health skip the save then. Learner thread only."""
+        g = self.guardrails
+        if g is None or g.watchdog is None or self._halted:
+            return False
+        win = getattr(self.algorithm, "_inflight", None)
+        fenced = win.fenced_count if win is not None else 0
+        trip = g.watchdog.poll(fenced)
+        if trip is None:
+            return False
+        self._execute_rollback(trip)
+        return True
+
+    def _execute_rollback(self, trip) -> None:
+        """The watchdog tripped: halt dispatch, restore the newest
+        healthy-tagged checkpoint AND its dedup-ledger sidecar, fast-
+        forward the version past the poisoned line, force a model-wire
+        keyframe so actors resync off the poisoned delta chain, publish
+        the restored params, and resume. Bounded: more than
+        ``max_rollbacks`` inside ``rollback_window_s`` (or no healthy
+        checkpoint to restore) degrades to halt-and-alarm. Learner
+        thread only — nothing else dispatches while this runs."""
+        from relayrl_tpu import telemetry
+
+        g = self.guardrails
+        # 1. Halt dispatch: fence everything in flight, drop the deferred
+        # logs (they describe the rolled-back line of history), and let
+        # the publisher finish so no poisoned-line publish races the
+        # restored one.
+        win = getattr(self.algorithm, "_inflight", None)
+        if win is not None and win.pending:
+            win.drain()
+        self._pending_logs.clear()
+        if self._publisher is not None:
+            self._publisher.drain(timeout=30.0)
+        if not g.params["rollback"] or not self._checkpoint_dir:
+            self._enter_halt(trip, "rollback disabled")
+            return
+        now = time.monotonic()
+        window = g.params["rollback_window_s"]
+        self._rollback_times = [t for t in self._rollback_times
+                                if now - t < window]
+        if len(self._rollback_times) >= g.params["max_rollbacks"]:
+            self._enter_halt(trip, "rollback budget spent")
+            return
+        self._rollback_times.append(now)
+        # 2. Restore the newest healthy step (settle any in-flight async
+        # save first so the step listing is complete).
+        mgr = getattr(self.algorithm, "_ckpt_mgr", None)
+        if mgr is not None:
+            try:
+                mgr.wait()
+            except Exception:
+                pass
+        try:
+            from relayrl_tpu.checkpoint import restore_latest_healthy
+
+            step = restore_latest_healthy(self.algorithm,
+                                          self._checkpoint_dir)
+        except FileNotFoundError:
+            self._enter_halt(trip, "no healthy checkpoint retained")
+            return
+        except Exception as e:
+            self._enter_halt(trip, f"restore failed: {e!r}")
+            return
+        # 3. The dedup ledger must match the restored params' line of
+        # history (PR 6's consistency contract): a newer ledger would
+        # dedup (lose) trajectories whose updates just rolled back.
+        self._load_ledger_sidecar(step)
+        # 4. Fast-forward the version PAST anything the poisoned line
+        # published, so actor swap gates and checkpoint step numbering
+        # stay monotonic (step numbers are labels; the state is the
+        # restored tree).
+        new_version = max(self.latest_model_version,
+                          int(self.algorithm.version)) + 1
+        self.algorithm.force_version(new_version)
+        # 5. Host-side ingest state part-filled by the poisoned stream
+        # belongs to the rolled-back line.
+        self.algorithm.reset_ingest_buffers()
+        # 6. Re-arm BEFORE the publish below: its checkpoint due-check
+        # re-enters _guard_poll, and a watchdog still holding poisoned-
+        # line probes would recurse straight back into rollback. The
+        # detector windows describe the dead line anyway, and the
+        # re-anchored distance gates put the restored line on its own
+        # checkpoint cadence.
+        g.watchdog.reset_after_rollback()
+        self._ckpt_version = new_version
+        self._artifact_version = new_version
+        # 7. Forced keyframe + immediate publish: every actor resyncs to
+        # the restored params regardless of what deltas it held.
+        if self._wire_encoder is not None:
+            self._wire_encoder.force_keyframe()
+        try:
+            self._publish()
+        except Exception as e:
+            print(f"[TrainingServer] rollback publish error: {e!r}",
+                  flush=True)
+        self._rollbacks_total += 1
+        g._m_rollbacks.inc()
+        telemetry.emit("rollback", signal=trip.signal, value=trip.value,
+                       threshold=trip.threshold, restored_step=int(step),
+                       new_version=int(new_version),
+                       attempt=len(self._rollback_times))
+        print(f"[TrainingServer] ROLLBACK #{self._rollbacks_total}: "
+              f"{trip.signal} tripped → restored healthy step {step}, "
+              f"resuming as version {new_version}", flush=True)
+
+    def _enter_halt(self, trip, reason: str) -> None:
+        """Degrade to halt-and-alarm: training stops, ingest sheds, the
+        process survives for operator forensics (docs/operations.md
+        runbook). One-way until an operator restarts the server."""
+        from relayrl_tpu import telemetry
+
+        self._halted = True
+        g = self.guardrails
+        g._m_halted.set(1)
+        telemetry.emit("guardrails_halt", signal=trip.signal,
+                       value=trip.value, reason=reason,
+                       rollbacks=self._rollbacks_total)
+        print(f"[TrainingServer] GUARDRAILS HALT ({reason}): "
+              f"{trip.signal} tripped and recovery is exhausted — "
+              f"training stopped, ingest shedding, process alive for "
+              f"inspection", flush=True)
+
+    @property
+    def guardrails_halted(self) -> bool:
+        return self._halted
+
+    def guardrails_accounting(self) -> dict:
+        """Guardrail evidence block for drills/benches/status loops:
+        validation + quarantine + watchdog + admission accounting plus
+        the server-side rollback/halt ledger. Empty when disabled."""
+        g = self.guardrails
+        if g is None:
+            return {}
+        out = g.accounting()
+        out["rollbacks_total"] = self._rollbacks_total
+        out["halted"] = self._halted
+        return out
 
     def _learner_pending(self) -> int:
         """Dispatched-but-unfenced updates + deferred logs + queued or
@@ -1185,6 +1498,23 @@ class TrainingServer:
         Wire v1: the legacy full-bundle bytes ship on every publish."""
         from relayrl_tpu import telemetry
 
+        from relayrl_tpu.guardrails.validate import params_tree_finite
+
+        g = self.guardrails
+        if g is not None and not params_tree_finite(host_params):
+            # The publish gate: non-finite params NEVER reach the wire,
+            # the handshake cache, or the artifact file — the fleet keeps
+            # serving the last good model while the watchdog's rollback
+            # replaces the poisoned line (trip_external surfaces on the
+            # learner thread's next poll).
+            g._m_publish_blocked.inc()
+            if g.watchdog is not None:
+                g.watchdog.trip_external("publish_nonfinite",
+                                         float("nan"), 0.0)
+            telemetry.emit("publish_blocked", version=int(version))
+            print(f"[TrainingServer] publish BLOCKED: version {version} "
+                  f"params are non-finite", flush=True)
+            return
         enc = self._wire_encoder
         with self._bundle_lock:
             self._bundle_host = (int(version), dict(arch), host_params)
@@ -1259,6 +1589,12 @@ class TrainingServer:
                 or version - self._ckpt_version < self._checkpoint_every):
             return
         self._pipeline_quiesce()
+        # Post-quiesce the in-flight window is empty, so every pending
+        # health probe resolves for free here — a trip rolls back (the
+        # save is skipped: the state it would capture is the poisoned
+        # line) and a clean poll makes the healthy-at-save tag honest.
+        if self._guard_poll():
+            return
         self._periodic_checkpoint()
         # Advance even on a (caught) failed save — retrying every epoch
         # would hammer a broken checkpoint dir, and multi-host ranks must
@@ -1275,6 +1611,20 @@ class TrainingServer:
         self._publish_params(snapshot.version, snapshot.arch,
                              snapshot.host_params())
 
+    def _health_tag(self) -> dict:
+        """The healthy-at-save tag every checkpoint carries (JSON
+        extras): True iff the watchdog's most recently resolved probes
+        were clean and guardrails are not halted. The periodic path
+        quiesces + polls BEFORE saving, so a True tag means every update
+        baked into the step had its probes resolved clean — the
+        last-known-good ring's membership test (restore_latest_healthy).
+        Guardrails/watchdog off ⇒ True: the ring stays usable as a
+        plain resume source."""
+        g = self.guardrails
+        healthy = not self._halted and (
+            g is None or g.watchdog is None or g.watchdog.healthy())
+        return {"healthy": healthy}
+
     def _periodic_checkpoint(self) -> None:
         """One periodic save, with the replay-buffer (aux) snapshot
         throttled to every ``checkpoint_aux_every``-th save — the ring
@@ -1286,7 +1636,8 @@ class TrainingServer:
             include_aux = self._ckpt_saves % self._aux_every == 0
             checkpoint_algorithm(self.algorithm, self._checkpoint_dir,
                                  include_aux=include_aux,
-                                 max_to_keep=self._ckpt_keep)
+                                 max_to_keep=self._ckpt_keep,
+                                 extra_meta=self._health_tag())
             from relayrl_tpu import telemetry
 
             telemetry.emit("checkpoint", version=self.algorithm.version,
@@ -1457,6 +1808,8 @@ class TrainingServer:
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
             self.transport.on_unregister = self._on_unregister
+            if self.guardrails is not None:
+                self.transport.check_ingest = self._check_ingest
         self.enable_server()
 
     def __enter__(self):

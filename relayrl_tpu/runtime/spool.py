@@ -41,6 +41,7 @@ import io
 import os
 import struct
 import threading
+import time
 
 _MAGIC = b"SPL1"
 _REC_HDR = struct.Struct(">IIH")  # total_len, seq, id_len
@@ -75,6 +76,12 @@ class TrajectorySpool:
             f"spool:{name}", failure_threshold=3, reset_timeout_s=2.0)
         self._lock = threading.Lock()
         self._entries: list[tuple[str, int, bytes]] = []  # (agent_id, seq, payload)
+        # Overload-nack backoff: entries nacked NACK_OVERLOADED stay
+        # retained, and the next fresh send at/after this monotonic
+        # deadline triggers a replay (honoring the server's
+        # retry_after_s). Without it a never-breaking connection would
+        # only redeliver them at end-of-run flush().
+        self._replay_due: float | None = None
         self._bytes = 0
         self._next_seq: dict[str, int] = {}
         self._dir = directory
@@ -96,6 +103,10 @@ class TrajectorySpool:
         self._m_send_failures = reg.counter(
             "relayrl_spool_send_failures_total",
             "wire send attempts that failed into the spool")
+        self._m_nacked = reg.counter(
+            "relayrl_spool_nacked_total",
+            "sends the server answered with a typed ingest nack "
+            "(quarantine discards the entry; overload retains it)")
         self._m_depth = reg.gauge(
             "relayrl_spool_depth", "entries currently retained")
         if self._path is not None:
@@ -183,18 +194,31 @@ class TrajectorySpool:
         """One policy-bounded wire attempt; updates the breaker. A
         success that CLOSES the breaker triggers a full replay (the
         reconnect may have been silent — e.g. a zmq PUSH that never
-        errors)."""
+        errors).
+
+        Typed ingest nacks (transport/base.IngestNack — the guardrail
+        plane's verdicts on ack-capable transports) are NOT wire
+        failures: the server answered. A *quarantine* nack discards the
+        entry (retrying is pointless until parole and would replay
+        poison forever); an *overload* nack keeps it retained for a
+        later replay. Neither touches the breaker."""
         if self.send_fn is None:
             return True
         if not self.breaker.allow():
             return False
-        from relayrl_tpu.transport.base import tag_agent_seq
+        from relayrl_tpu.transport.base import IngestNack, tag_agent_seq
 
         tagged = tag_agent_seq(agent_id, seq)
+
+        def attempt_once():
+            try:
+                self.send_fn(payload, tagged)
+            except IngestNack as nack:
+                return nack  # a verdict, not a failure — escape the retry
+            return True
+
         try:
-            self.retry.call(
-                lambda: (self.send_fn(payload, tagged), True)[1],
-                op="spool.send")
+            result = self.retry.call(attempt_once, op="spool.send")
         except Exception as e:
             self._m_send_failures.inc()
             if self.breaker.record_failure():
@@ -202,14 +226,51 @@ class TrajectorySpool:
                       f"buffering until the server answers a probe",
                       flush=True)
             return False
+        if isinstance(result, IngestNack):
+            self._m_nacked.inc()
+            healed = self.breaker.record_success()  # the server IS alive
+            if result.quarantined:
+                self.discard(agent_id, seq)
+                if healed and not replay:
+                    # The outage may have eaten OTHER agents'/lanes'
+                    # entries; the quarantined ones replayed here just
+                    # nack-and-discard again (bounded by the window).
+                    self.replay()
+                return True  # delivered-and-refused: nothing to replay
+            # Overloaded: stays retained; schedule the redelivery the
+            # server asked for instead of replaying into the overload
+            # (a heal-triggered replay would do exactly that).
+            self._replay_due = time.monotonic() + max(
+                0.25, result.retry_after_s)
+            return False
         if replay:
             self._m_replayed.inc()
-        if self.breaker.record_success() and not replay:
+            self.breaker.record_success()  # may be flush()'s half-open probe
+            return True
+        if self.breaker.record_success():
             # Broken → healed on a live send: replay everything the
             # outage may have eaten (runs on the caller thread; bounded
             # by the spool window).
             self.replay()
+        elif (self._replay_due is not None
+              and time.monotonic() >= self._replay_due):
+            # Overload-nacked entries come due: one replay pass
+            # redelivers them (the server ledger dedups the rest).
+            self._replay_due = None
+            self.replay()
         return True
+
+    def discard(self, agent_id: str, seq: int) -> None:
+        """Drop one retained entry (quarantine nack: the server will
+        never accept it — retaining it would replay poison on every
+        reconnect)."""
+        with self._lock:
+            for i, (aid, s, payload) in enumerate(self._entries):
+                if aid == agent_id and s == seq:
+                    del self._entries[i]
+                    self._bytes -= len(payload)
+                    break
+        self._m_depth.set(len(self._entries))
 
     # -- retention --
     def _retain_locked(self, agent_id: str, seq: int, payload: bytes) -> None:

@@ -105,6 +105,40 @@ def unpack_model_frame(buf: bytes) -> tuple[int, bytes]:
     return version, model
 
 
+# -- typed ingest nacks (guardrail plane) --
+#
+# Ack-capable transports (gRPC request/response; any future proto with a
+# reply) carry the server's admission verdict back to the sender as a
+# typed nack instead of a silent drop: code 2 = the sending agent is
+# QUARANTINED (stop sending — the spool discards the entry; retrying is
+# pointless until parole), code 3 = ingest OVERLOADED (keep the entry
+# spooled and retry after ``retry_after_s``). Broadcast planes (zmq PUSH,
+# native) have no per-send back-channel; there the same verdicts are
+# enforced server-side and surface through telemetry/events only.
+NACK_OK = 1
+NACK_MALFORMED = 0
+NACK_QUARANTINED = 2
+NACK_OVERLOADED = 3
+
+
+class IngestNack(RuntimeError):
+    """A send the server REFUSED with a typed verdict (not a transport
+    failure: the server is alive and answered — callers must not count
+    it against circuit breakers or retry budgets)."""
+
+    def __init__(self, code: int, reason: str = "",
+                 retry_after_s: float = 0.0):
+        super().__init__(f"ingest nack code={code}"
+                         f"{f' ({reason})' if reason else ''}")
+        self.code = int(code)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+    @property
+    def quarantined(self) -> bool:
+        return self.code == NACK_QUARANTINED
+
+
 # -- receive-loop decode-error narrowing (ISSUE 6 satellite) --
 #
 # The receive loops used to eat EVERY exception from a frame decode
@@ -290,6 +324,12 @@ class ServerTransport(abc.ABC):
         self.on_trajectory: Callable[[str, bytes], None] = lambda *_: None
         self.get_model: Callable[[], tuple[int, bytes]] = lambda: (0, b"")
         self.get_model_update = None
+        # Guardrail admission pre-check for ack-capable backends:
+        # ``check_ingest(agent_id) -> None | (nack_code, reason,
+        # retry_after_s)``. A non-None verdict is returned to the sender
+        # as a typed nack INSTEAD of invoking on_trajectory. None (the
+        # default) admits everything; broadcast backends never call it.
+        self.check_ingest = None
         # Cheap current-version probe (no bundle serialize): long-poll
         # wakeup checks want the version alone — under wire v2 the full
         # v1 bytes serialize lazily, and probing through get_model()

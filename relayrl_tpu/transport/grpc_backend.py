@@ -34,7 +34,10 @@ import grpc
 import msgpack
 
 from relayrl_tpu.transport.base import (
+    NACK_OVERLOADED,
+    NACK_QUARANTINED,
     AgentTransport,
+    IngestNack,
     ReceiptLedger,
     ServerTransport,
     agent_wire_metrics,
@@ -66,6 +69,17 @@ class _Servicer:
             # RPC error instead of a silent code-0 ack).
             swallow_decode_error("grpc", "trajectory_ingest", e)
             return msgpack.packb({"code": 0, "error": "malformed envelope"})
+        verdict = None
+        if self._owner.check_ingest is not None:
+            # Guardrail admission (quarantine / overload-nack): this
+            # plane HAS a back-channel, so a refused send is a typed
+            # nack the sender's spool can act on instead of a silent
+            # server-side shed (transport/base.py NACK_* codes).
+            verdict = self._owner.check_ingest(agent_id)
+        if verdict is not None:
+            code, reason, retry_after = verdict
+            return msgpack.packb({"code": int(code), "error": str(reason),
+                                  "retry_after_s": float(retry_after)})
         self._owner.on_trajectory(agent_id, payload)
         return msgpack.packb({"code": 1})
 
@@ -358,7 +372,14 @@ class GrpcAgentTransport(AgentTransport):
             resp = msgpack.unpackb(self._send(part, timeout=30.0), raw=False)
             self._m["send_total"].inc()
             self._m["send_bytes"].inc(len(part))
-            if resp.get("code") != 1:
+            code = resp.get("code")
+            if code in (NACK_QUARANTINED, NACK_OVERLOADED):
+                # Typed guardrail nack: the server is alive and REFUSED
+                # the send — not a wire failure (the spool must not
+                # count it against the breaker; see spool._attempt).
+                raise IngestNack(code, str(resp.get("error") or ""),
+                                 float(resp.get("retry_after_s") or 0.0))
+            if code != 1:
                 raise RuntimeError(
                     f"trajectory rejected: {resp.get('error')}")
         self._m["send_seconds"].observe(time.monotonic() - t0)

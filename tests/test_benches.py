@@ -131,6 +131,37 @@ def test_bench_soak_chaos_quick_smoke(tmp_path):
         assert ledger["max_seq"] == n and ledger["contiguous"], ledger
 
 
+@pytest.mark.guardrails
+def test_bench_soak_guardrail_drill_quick_smoke(tmp_path):
+    """Fast --poison guardrail drill smoke (ISSUE 8): a NaN-poison
+    stream against a live fleet must quarantine the offending agent,
+    trip the watchdog, auto-roll the learner back to a healthy
+    checkpoint (never halt), and end with finite params — with the full
+    guardrail evidence block in the emitted row. The committed full-
+    length row additionally proves reward-target convergence; the smoke
+    runs target-free to stay fast."""
+    lines = _run_bench("bench_soak.py", tmp_path, "--poison", timeout=600)
+    row = next(r for r in lines if r["bench"].startswith("guardrail_drill"))
+    # asserted in-script too (_finish_guardrail_drill); re-asserted here
+    # so a schema drift can't silently weaken the smoke
+    assert row["quarantine"]["quarantines_total"] >= 1
+    assert row["rollbacks_total"] >= 1
+    assert row["halted"] is False
+    assert row["final_params_finite"] is True
+    assert row["strikes"] >= row["config"]["guardrails"]["strike_threshold"]
+    assert row["poison_episodes_sent"] >= 1
+    injected = sum(v for k, v in row["poison_worker_counters"].items()
+                   if k.startswith("relayrl_faults_injected_total"))
+    assert injected >= 1, "the poison plan never fired"
+    # the restored line kept publishing (forced-keyframe resync path;
+    # per-actor resync version is asserted in-script when the rollback
+    # lands inside the clean window)
+    assert row["final_version"] > (
+        row["timeline_s"]["version_at_recovery"] or 0)
+    snap = row["telemetry"]
+    assert snap["schema"] == "relayrl-telemetry-v1" and snap["enabled"]
+
+
 @pytest.mark.anakin
 def test_bench_soak_anakin_quick_smoke(tmp_path):
     """Fast bench_soak --anakin smoke (ISSUE 7): a tiny fused-rollout

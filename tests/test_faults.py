@@ -149,6 +149,67 @@ class TestInjectorOps:
         dropped = sum(1 for _ in range(10) if not inj.inject(b"x"))
         assert dropped == 2
 
+    def test_nan_poison_keeps_frame_valid_but_poisons_floats(self):
+        import msgpack
+        import numpy as np
+
+        from relayrl_tpu.faults.plan import nan_poison_bytes
+        from relayrl_tpu.types.action import ActionRecord
+        from relayrl_tpu.types.trajectory import (
+            deserialize_actions,
+            serialize_actions,
+        )
+
+        recs = [ActionRecord(obs=np.full((4,), 0.5, np.float32),
+                             act=np.int32(1), rew=1.0, done=(i == 2))
+                for i in range(3)]
+        body = serialize_actions(recs)
+        poisoned = nan_poison_bytes(body, seed=42, site="server.ingest",
+                                    op_index=0)
+        assert poisoned != body
+        out = deserialize_actions(poisoned)  # still wire-VALID
+        assert all(np.isnan(r.rew) for r in out)
+        assert all(np.isinf(r.obs.flat[0]) for r in out)
+        # deterministic: same (seed, site, op_index) → same bytes
+        assert poisoned == nan_poison_bytes(body, 42, "server.ingest", 0)
+        # the agent.send envelope shape poisons the inner traj and
+        # keeps the envelope id intact
+        env = msgpack.packb({"id": "actor-1", "traj": body},
+                            use_bin_type=True)
+        poisoned_env = nan_poison_bytes(env, 42, "agent.send", 0)
+        unpacked = msgpack.unpackb(poisoned_env, raw=False)
+        assert unpacked["id"] == "actor-1"
+        assert np.isnan(deserialize_actions(unpacked["traj"])[0].rew)
+
+    def test_nan_poison_passes_through_non_trajectory_payloads(self):
+        from relayrl_tpu.faults.plan import nan_poison_bytes
+
+        for junk in (b"", b"not-msgpack", bytes(range(256))):
+            assert nan_poison_bytes(junk, 1, "s", 0) == junk
+
+    def test_flood_amplifies_send(self):
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule(site="agent.send", op="flood", prob=1.0,
+                      flood_factor=4)])
+        out = plan.site("agent.send").inject(b"x")
+        assert out == [(0.0, b"x")] * 4
+
+    def test_flood_stacks_with_duplicate(self):
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule(site="agent.send", op="duplicate", prob=1.0),
+            FaultRule(site="agent.send", op="flood", prob=1.0,
+                      flood_factor=3)])
+        out = plan.site("agent.send").inject(b"x")
+        assert len(out) == 6  # (1 + 1 duplicate) x 3 flood
+
+    def test_flood_factor_round_trips_plan_json(self):
+        plan = FaultPlan(seed=3, rules=[
+            FaultRule(site="agent.send", op="flood", prob=0.5,
+                      flood_factor=16)])
+        again = FaultPlan.from_json(plan.to_json())
+        assert again.rules[0].flood_factor == 16
+        assert again.rules[0].op == "flood"
+
     def test_injections_counted_in_telemetry(self):
         telemetry.set_registry(telemetry.Registry(run_id="t"))
         plan = faults.install_plan(FaultPlan(seed=0, rules=[

@@ -1,0 +1,1233 @@
+"""Training-health guardrails (relayrl_tpu/guardrails/ + the server
+wiring): ingest validation + poison-agent quarantine, the divergence
+watchdog, last-known-good auto-rollback, and ingest backpressure.
+
+The acceptance contract under test (ISSUE 8):
+
+* the validator is the semantic trust boundary — non-finite /
+  malformed-but-decodable trajectories never reach the learner plane,
+  and a hostile payload cannot crash the validator itself;
+* a poison-*emitting* agent is quarantined (typed nack where the
+  transport has a back-channel), then auto-paroled;
+* the watchdog's device probes are OBSERVERS: guardrails-on params are
+  BIT-identical to guardrails-off for REINFORCE and PPO;
+* a watchdog trip rolls the learner back to the newest healthy-tagged
+  checkpoint with a consistent dedup ledger and a forced keyframe, and
+  the rollback budget degrades to halt-and-alarm;
+* non-finite params NEVER publish.
+"""
+
+import json
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from relayrl_tpu.algorithms import build_algorithm
+from relayrl_tpu.guardrails import (
+    AdmissionController,
+    DivergenceWatchdog,
+    GuardProbes,
+    QuarantineBook,
+    params_tree_finite,
+    trajectory_reward,
+    validate_trajectory,
+)
+from relayrl_tpu.guardrails.watchdog import (
+    PROBE_NONFINITE,
+    PROBE_PARAM_NORM,
+    PROBE_UPDATE_NORM,
+)
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.trajectory import serialize_actions
+
+pytestmark = pytest.mark.guardrails
+
+OBS_DIM, ACT_DIM = 4, 2
+
+
+def _episode(n=4, seed=0, rew=None, obs_fill=None, with_v=True):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        data = {"logp_a": np.float32(-0.69)}
+        if with_v:
+            data["v"] = np.float32(rng.standard_normal())
+        obs = (np.full((OBS_DIM,), obs_fill, np.float32)
+               if obs_fill is not None
+               else rng.standard_normal(OBS_DIM).astype(np.float32))
+        recs.append(ActionRecord(
+            obs=obs,
+            act=np.int64(rng.integers(ACT_DIM)),
+            rew=float(rew) if (rew is not None and i == n - 1)
+            else float(rng.random()),
+            data=data,
+            done=(i == n - 1),
+        ))
+    return recs
+
+
+def _decoded(rew=1.0, n=2, agent="a"):
+    from relayrl_tpu.types.columnar import DecodedTrajectory
+
+    return DecodedTrajectory(
+        agent_id=agent, n_steps=n, n_records=n, marker_truncated=False,
+        columns={"o": np.zeros((n, OBS_DIM), np.float32),
+                 "a": np.zeros((n,), np.int32),
+                 "r": np.array([0.0] * (n - 1) + [rew], np.float32),
+                 "t": np.array([False] * (n - 1) + [True])},
+        aux={"v": np.zeros((n,), np.float32),
+             "logp_a": np.zeros((n,), np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# validate.py — the semantic trust boundary
+# ---------------------------------------------------------------------------
+class TestValidator:
+    def test_clean_records_pass(self):
+        assert validate_trajectory(_episode()) is None
+
+    def test_clean_decoded_passes(self):
+        assert validate_trajectory(_decoded()) is None
+
+    @pytest.mark.parametrize("poison,reason", [
+        (dict(rew=float("nan")), "nonfinite"),
+        (dict(rew=float("inf")), "nonfinite"),
+        (dict(obs_fill=float("nan")), "nonfinite"),
+    ])
+    def test_nonfinite_records_rejected(self, poison, reason):
+        assert validate_trajectory(_episode(**poison)) == reason
+
+    def test_nonfinite_decoded_rejected(self):
+        assert validate_trajectory(_decoded(rew=float("nan"))) == "nonfinite"
+
+    def test_schema_non_record_items(self):
+        assert validate_trajectory(["not-a-record"]) == "schema"
+        assert validate_trajectory(object()) == "schema"
+
+    def test_schema_bad_reward_type(self):
+        recs = _episode()
+        recs[0] = ActionRecord(obs=recs[0].obs, act=recs[0].act,
+                               rew="1.0", data=recs[0].data,
+                               done=recs[0].done)
+        assert validate_trajectory(recs) == "schema"
+
+    def test_dtype_object_obs_rejected(self):
+        recs = _episode()
+        evil = np.array([object()], dtype=object)
+        recs[0] = ActionRecord(obs=evil, act=recs[0].act, rew=0.0,
+                               data=recs[0].data, done=recs[0].done)
+        assert validate_trajectory(recs) == "dtype"
+
+    def test_dtype_string_aux_is_inert(self):
+        # Stable contract with the finite guard: string/bytes/bool aux
+        # values never reach the training path, so they must not reject.
+        recs = _episode()
+        recs[0] = ActionRecord(obs=recs[0].obs, act=recs[0].act, rew=0.0,
+                               data={"tag": "ep-1", "v": np.float32(0.1),
+                                     "logp_a": np.float32(-0.1)},
+                               done=recs[0].done)
+        assert validate_trajectory(recs) is None
+
+    def test_length_bound(self):
+        assert validate_trajectory(_episode(n=8), max_steps=4) == "length"
+        assert validate_trajectory(_episode(n=4), max_steps=4) is None
+        assert validate_trajectory(_episode(n=8), max_steps=0) is None
+
+    def test_decoded_shape_mismatch(self):
+        item = _decoded(n=3)
+        item.columns["r"] = np.zeros((2,), np.float32)  # wrong leading dim
+        assert validate_trajectory(item) == "shape"
+
+    def test_decoded_object_column(self):
+        item = _decoded()
+        item.aux["v"] = np.array([object(), object()], dtype=object)
+        assert validate_trajectory(item) == "dtype"
+
+    def test_decoded_non_array_column(self):
+        item = _decoded()
+        item.columns["o"] = [[0.0] * OBS_DIM, [0.0] * OBS_DIM]
+        assert validate_trajectory(item) == "schema"
+
+    def test_validator_never_raises(self):
+        class Hostile:
+            def __len__(self):
+                return 2
+
+            def __iter__(self):
+                raise RuntimeError("weaponized payload")
+
+        assert validate_trajectory(Hostile()) == "validator_error"
+
+    def test_bfloat16_nan_rejected(self):
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        recs = _episode()
+        bad = np.array([0.1, float("nan"), 0.2, 0.3], ml_dtypes.bfloat16)
+        recs[1] = ActionRecord(obs=bad, act=recs[1].act, rew=recs[1].rew,
+                               data=recs[1].data, done=recs[1].done)
+        assert validate_trajectory(recs) == "nonfinite"
+
+    def test_trajectory_reward_both_shapes(self):
+        recs = _episode(rew=2.0, n=3)
+        want = sum(r.rew for r in recs)
+        assert trajectory_reward(recs) == pytest.approx(want)
+        assert trajectory_reward(_decoded(rew=3.0)) == pytest.approx(3.0)
+        assert trajectory_reward(object()) is None
+
+    def test_params_tree_finite(self):
+        good = {"w": np.ones((3,), np.float32),
+                "step": np.int32(7)}  # int leaves carry no signal
+        assert params_tree_finite(good)
+        bad = {"w": np.array([1.0, float("nan")], np.float32)}
+        assert not params_tree_finite(bad)
+        inf = {"w": np.array([np.inf], np.float32)}
+        assert not params_tree_finite(inf)
+
+
+class TestRejectionCounting:
+    def test_every_rejection_reason_is_counted(self):
+        """The Guardrails facade counts EVERY rejection under its stable
+        reason label (the fuzz suite's counting contract, runnable
+        without hypothesis)."""
+        from relayrl_tpu import telemetry
+        from relayrl_tpu.config.loader import ConfigLoader
+        from relayrl_tpu.guardrails import Guardrails
+        from relayrl_tpu.guardrails.validate import REASONS
+
+        telemetry.reset_for_tests()
+        telemetry.set_registry(telemetry.Registry(run_id="guard-test"))
+        try:
+            params = ConfigLoader("REINFORCE").get_guardrails_params()
+            params["max_steps"] = 4
+            g = Guardrails(params)
+            rejects = [
+                _episode(rew=float("nan")),       # nonfinite
+                _episode(n=9),                    # length
+                ["junk"],                         # schema
+                object(),                         # schema
+            ]
+            for item in rejects:
+                assert g.validate("fuzzer", item) is None
+            snap = telemetry.get_registry().snapshot()
+            rows = [m for m in snap["metrics"]
+                    if m["name"] == "relayrl_guard_rejected_total"]
+            assert sum(m["value"] for m in rows) == len(rejects)
+            assert {m["labels"]["reason"] for m in rows} <= set(REASONS)
+        finally:
+            telemetry.reset_for_tests()
+
+    def test_validation_off_still_feeds_reward_detector(self):
+        """``ingest_validation: "off"`` stands down the validator and
+        strikes — NOT a detector the operator armed: the reward-collapse
+        feed must see every admitted trajectory in every mode."""
+        from relayrl_tpu.config.loader import ConfigLoader
+        from relayrl_tpu.guardrails import Guardrails
+
+        params = ConfigLoader("REINFORCE").get_guardrails_params()
+        params["reward_collapse_drop"] = 5.0
+        for mode in ("off", "warn", "enforce"):
+            params["ingest_validation"] = mode
+            g = Guardrails(params)
+            assert g.validate("a", _episode()) is not None
+            assert len(g.watchdog._rewards) == 1, mode
+
+
+# ---------------------------------------------------------------------------
+# quarantine.py — strike accounting + parole lifecycle
+# ---------------------------------------------------------------------------
+class TestQuarantine:
+    def test_below_threshold_stays_clean(self):
+        book = QuarantineBook(strike_threshold=3, strike_window_s=60)
+        assert book.strike("a", "nonfinite") is False
+        assert book.strike("a", "nonfinite") is False
+        assert not book.is_quarantined("a")
+
+    def test_threshold_quarantines(self):
+        book = QuarantineBook(strike_threshold=2, strike_window_s=60,
+                              cooldown_s=300)
+        assert book.strike("a", "nonfinite") is False
+        assert book.strike("a", "nonfinite") is True
+        assert book.is_quarantined("a")
+        assert not book.is_quarantined("b")  # per-agent isolation
+        assert book.quarantines_total == 1
+        assert 0 < book.retry_after("a") <= 300
+        assert book.retry_after("b") == 0.0
+
+    def test_strikes_age_out_of_window(self):
+        book = QuarantineBook(strike_threshold=2, strike_window_s=0.05)
+        book.strike("a", "nonfinite")
+        time.sleep(0.08)
+        # the first strike aged out: this one is strike #1 again
+        assert book.strike("a", "nonfinite") is False
+        assert not book.is_quarantined("a")
+
+    def test_lazy_parole_after_cooldown(self):
+        book = QuarantineBook(strike_threshold=1, cooldown_s=0.05)
+        assert book.strike("a", "nonfinite") is True
+        assert book.is_quarantined("a")
+        time.sleep(0.08)
+        assert not book.is_quarantined("a")  # parole evaluated lazily
+        assert book.paroles_total == 1
+        # re-offending re-quarantines from a clean slate
+        assert book.strike("a", "nonfinite") is True
+
+    def test_accounting(self):
+        book = QuarantineBook(strike_threshold=2)
+        book.strike("a", "nonfinite")
+        book.strike("b", "schema")
+        book.strike("b", "schema")
+        acct = book.accounting()
+        assert acct["quarantined"] == ["b"]
+        assert acct["strikes_pending"] == {"a": 1}
+        assert acct["quarantines_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# admission.py — bounded ingest + shed policies
+# ---------------------------------------------------------------------------
+class TestAdmission:
+    def test_admits_under_limit(self):
+        adm = AdmissionController(soft_limit=4)
+        assert adm.admit("a") == "admit"
+        adm.note_enqueued("a")
+        assert adm.accounting()["depth"] == 1
+
+    def test_agent_fair_share_sheds_first(self):
+        adm = AdmissionController(soft_limit=10, agent_share=0.2)
+        assert adm.agent_cap == 2
+        for _ in range(2):
+            assert adm.admit("hog") == "admit"
+            adm.note_enqueued("hog")
+        assert adm.admit("hog") == "shed_agent"  # over its share
+        assert adm.admit("polite") == "admit"    # fleet unaffected
+        assert adm.accounting()["sheds"]["agent_share"] == 1
+
+    def test_drop_oldest_evicts_at_limit(self):
+        adm = AdmissionController(soft_limit=2, policy="drop_oldest",
+                                  agent_share=1.0)
+        for agent in ("a", "b"):
+            adm.admit(agent)
+            adm.note_enqueued(agent)
+        assert adm.admit("c") == "evict"
+        # the caller evicts the oldest, then enqueues the new arrival
+        adm.note_dequeued("a")
+        adm.note_enqueued("c")
+        assert adm.accounting()["depth"] == 2
+        assert adm.accounting()["sheds"]["drop_oldest"] == 1
+
+    def test_nack_policy_refuses_at_limit(self):
+        adm = AdmissionController(soft_limit=1, policy="nack",
+                                  agent_share=1.0, retry_after_s=2.5)
+        adm.admit("a")
+        adm.note_enqueued("a")
+        assert adm.admit("b") == "nack"
+        assert adm.retry_after_s == 2.5
+        assert adm.accounting()["sheds"]["nack"] == 1
+
+    def test_dequeue_releases_pressure(self):
+        adm = AdmissionController(soft_limit=1, policy="nack",
+                                  agent_share=1.0)
+        adm.admit("a")
+        adm.note_enqueued("a")
+        adm.note_dequeued("a")
+        assert adm.admit("b") == "admit"
+
+
+# ---------------------------------------------------------------------------
+# watchdog.py — detectors + probes
+# ---------------------------------------------------------------------------
+class TestWatchdog:
+    def _dog(self, **kw):
+        return DivergenceWatchdog(**kw)
+
+    def test_nonfinite_probe_trips(self):
+        dog = self._dog()
+        dog.observe_dispatch(1, {PROBE_NONFINITE: 3.0,
+                                 PROBE_PARAM_NORM: 1.0})
+        trip = dog.poll(fenced_count=1)
+        assert trip is not None and trip.signal == "nonfinite_params"
+        assert dog.trips_total == 1 and not dog.healthy()
+
+    def test_param_norm_threshold(self):
+        dog = self._dog(max_param_norm=10.0)
+        dog.observe_dispatch(1, {PROBE_NONFINITE: 0.0,
+                                 PROBE_PARAM_NORM: 5.0})
+        assert dog.poll(1) is None and dog.healthy()
+        dog.observe_dispatch(2, {PROBE_NONFINITE: 0.0,
+                                 PROBE_PARAM_NORM: 50.0})
+        trip = dog.poll(2)
+        assert trip.signal == "param_norm" and trip.value == 50.0
+
+    def test_param_norm_inf_trips_even_unset_threshold(self):
+        # sumsq overflow → inf norm is divergence regardless of knob
+        dog = self._dog(max_param_norm=0.0)
+        dog.observe_dispatch(1, {PROBE_PARAM_NORM: float("inf")})
+        assert dog.poll(1).signal == "param_norm"
+
+    def test_update_norm_threshold(self):
+        dog = self._dog(max_update_norm=1.0)
+        dog.observe_dispatch(1, {PROBE_UPDATE_NORM: 4.2})
+        assert dog.poll(1).signal == "update_norm"
+
+    def test_loss_nonfinite_always_trips(self):
+        dog = self._dog()
+        dog.observe_dispatch(1, {"LossPi": float("nan")})
+        assert dog.poll(1).signal == "loss_nonfinite"
+
+    def test_loss_spike_over_rolling_median(self):
+        dog = self._dog(loss_spike_factor=3.0, loss_window=4)
+        for i, loss in enumerate([1.0, 1.1, 0.9], start=1):
+            dog.observe_dispatch(i, {"LossPi": loss})
+            assert dog.poll(i) is None
+        dog.observe_dispatch(4, {"LossPi": 10.0})
+        trip = dog.poll(4)
+        assert trip is not None and trip.signal == "loss_spike"
+
+    def test_reward_collapse(self):
+        dog = self._dog(reward_collapse_drop=5.0, reward_window=4)
+        for _ in range(4):
+            dog.observe_reward(10.0)
+        assert dog.poll(0) is None  # establishes the best mean
+        for _ in range(4):
+            dog.observe_reward(0.0)
+        trip = dog.poll(0)
+        assert trip is not None and trip.signal == "reward_collapse"
+
+    def test_fence_gating(self):
+        # probes for an unfenced dispatch must not resolve yet
+        dog = self._dog()
+        dog.observe_dispatch(5, {PROBE_NONFINITE: 1.0})
+        assert dog.poll(fenced_count=4) is None
+        assert dog.poll(fenced_count=5).signal == "nonfinite_params"
+
+    def test_external_trip_surfaces_once(self):
+        dog = self._dog()
+        dog.trip_external("publish_nonfinite")
+        assert not dog.healthy()
+        trip = dog.poll(0)
+        assert trip.signal == "publish_nonfinite"
+        assert dog.poll(0) is None  # consumed
+
+    def test_pending_probe_reads_unhealthy(self):
+        """An unresolved probe may be the one carrying the NaN — the
+        healthy-at-save tag must not vouch for it. The signal-path
+        final checkpoint races the fence: quiesce resolves the device
+        scalars but only a poll evaluates them, so a dispatch whose
+        probe is still queued reads unhealthy until polled clean."""
+        dog = self._dog()
+        assert dog.healthy()  # nothing dispatched yet
+        dog.observe_dispatch(1, {PROBE_NONFINITE: 1.0})
+        assert not dog.healthy()            # queued, unevaluated
+        assert dog.poll(fenced_count=0) is None  # still unfenced
+        assert not dog.healthy()
+        assert dog.poll(fenced_count=1).signal == "nonfinite_params"
+        assert not dog.healthy()
+
+    def test_reset_after_rollback_rearms(self):
+        dog = self._dog(loss_spike_factor=3.0, loss_window=4,
+                        reward_collapse_drop=1.0, reward_window=4)
+        dog.observe_dispatch(1, {PROBE_NONFINITE: 1.0})
+        assert dog.poll(1) is not None
+        assert not dog.healthy()
+        dog.reset_after_rollback()
+        assert dog.healthy()
+        assert dog.accounting()["pending_probes"] == 0
+        # detector windows rebuilt from scratch on the restored line
+        dog.observe_dispatch(2, {"LossPi": 1.0})
+        assert dog.poll(2) is None
+
+
+class TestGuardProbes:
+    def test_probe_values(self):
+        import jax
+
+        probes = GuardProbes(update_norm=True)
+        old = {"w": np.array([3.0, 4.0], np.float32)}
+        copy = probes.pre_update(old)
+        new = {"w": np.array([4.0, 5.0], np.float32)}
+        out = probes.post_update(copy, new)
+        resolved = {k: float(v) for k, v in out.items()}
+        assert resolved[PROBE_NONFINITE] == 0
+        assert resolved[PROBE_PARAM_NORM] == pytest.approx(
+            float(np.sqrt(16 + 25)), rel=1e-6)
+        assert resolved[PROBE_UPDATE_NORM] == pytest.approx(
+            float(np.sqrt(2)), rel=1e-6)
+        del jax
+
+    def test_nonfinite_count(self):
+        probes = GuardProbes(update_norm=False)
+        assert probes.pre_update({"w": np.zeros(2, np.float32)}) is None
+        out = probes.post_update(None, {
+            "w": np.array([1.0, float("nan"), float("inf")], np.float32)})
+        assert float(out[PROBE_NONFINITE]) == 2
+
+    def test_integer_leaves_ignored(self):
+        probes = GuardProbes(update_norm=False)
+        out = probes.post_update(None, {"step": np.int32(7)})
+        assert float(out[PROBE_NONFINITE]) == 0
+        assert float(out[PROBE_PARAM_NORM]) == 0
+
+    def test_probes_do_not_mutate_params(self):
+        probes = GuardProbes(update_norm=True)
+        tree = {"w": np.array([1.0, 2.0], np.float32)}
+        before = tree["w"].copy()
+        copy = probes.pre_update(tree)
+        probes.post_update(copy, tree)
+        np.testing.assert_array_equal(tree["w"], before)
+
+    def test_actor_critic_states_are_probeable(self, tmp_cwd):
+        """SAC/DDPG/TD3 keep trainable params across ``*_params`` fields
+        instead of ``state.params`` — the probe tree must collect them
+        (targets excluded) and the probed update must still train (the
+        tier-1 regression: a probe AttributeError used to kill every
+        actor-critic update when guardrails were on by default)."""
+        algo = build_algorithm(
+            "SAC", obs_dim=OBS_DIM, act_dim=2, env_dir=str(tmp_cwd),
+            hidden_sizes=[8], batch_size=8, update_after=8,
+            update_every=8,
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        tree = algo._guard_probe_tree()
+        assert set(tree) >= {"actor_params", "critic_params"}
+        assert not any(k.startswith("target_") for k in tree)
+        algo._guard_probes = GuardProbes(update_norm=True)
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            ep = [ActionRecord(
+                obs=rng.standard_normal(OBS_DIM).astype(np.float32),
+                act=rng.uniform(-1, 1, 2).astype(np.float32),
+                rew=float(rng.random()), done=(i == 3))
+                for i in range(4)]
+            algo.receive_trajectory(ep)
+        assert algo.version > 0, "SAC never updated with probes attached"
+        assert algo._guard_probes is not None, \
+            "probes self-disabled — the probe tree failed"
+        assert float(algo._last_metrics[PROBE_NONFINITE]) == 0
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+class TestConfig:
+    def test_defaults(self, tmp_cwd):
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        params = ConfigLoader("REINFORCE").get_guardrails_params()
+        assert params["enabled"] is True
+        assert params["ingest_validation"] == "enforce"
+        assert params["strike_threshold"] == 3
+        assert params["shed_policy"] == "drop_oldest"
+
+    def test_malformed_values_degrade_to_defaults(self, tmp_path,
+                                                  monkeypatch):
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        monkeypatch.chdir(tmp_path)
+        cfg = {"guardrails": {"strike_threshold": "bogus",
+                              "loss_window": -3,
+                              "shed_policy": "weird",
+                              "ingest_validation": "nope",
+                              "agent_share": 99,
+                              "max_steps": "x"}}
+        path = tmp_path / "cfg.json"
+        path.write_text(json.dumps(cfg))
+        params = ConfigLoader("REINFORCE",
+                              str(path)).get_guardrails_params()
+        assert params["strike_threshold"] == 3
+        assert params["loss_window"] == 4      # clamped floor
+        assert params["shed_policy"] == "drop_oldest"
+        assert params["ingest_validation"] == "enforce"
+        assert params["agent_share"] == 99.0 or params["agent_share"] >= 0
+        assert params["max_steps"] is None
+
+    def test_explicit_zero_max_steps_disables_length_bound(
+            self, tmp_path, monkeypatch):
+        """``max_steps: 0`` is the documented length-bound opt-out —
+        build_guardrails must not conflate it with null (which derives
+        the bound from max_traj_length)."""
+        from relayrl_tpu.config.loader import ConfigLoader
+        from relayrl_tpu.guardrails import build_guardrails
+
+        monkeypatch.chdir(tmp_path)
+        path = tmp_path / "cfg0.json"
+        path.write_text(json.dumps({"guardrails": {"max_steps": 0}}))
+        g = build_guardrails(ConfigLoader("REINFORCE", str(path)))
+        assert g.params["max_steps"] == 0
+        # and null still derives from max_traj_length
+        path2 = tmp_path / "cfg_null.json"
+        path2.write_text(json.dumps({"guardrails": {"max_steps": None}}))
+        loader = ConfigLoader("REINFORCE", str(path2))
+        g2 = build_guardrails(loader)
+        assert g2.params["max_steps"] == loader.get_max_traj_length() > 0
+
+    def test_null_trip_threshold_disables_detector(self, tmp_path,
+                                                   monkeypatch):
+        """default_config documents "0/null disables that detector" for
+        the trip thresholds — an explicit null must map to 0 (off), not
+        back to a default that keeps the detector armed."""
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        monkeypatch.chdir(tmp_path)
+        path = tmp_path / "null_thr.json"
+        path.write_text(json.dumps(
+            {"guardrails": {"max_param_norm": None,
+                            "strike_window_s": None}}))
+        params = ConfigLoader("REINFORCE", str(path)).get_guardrails_params()
+        assert params["max_param_norm"] == 0.0   # null = detector OFF
+        assert params["strike_window_s"] == 60.0  # non-threshold: default
+
+    def test_unknown_top_level_section_warns_with_hint(self, tmp_path,
+                                                       monkeypatch):
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        monkeypatch.chdir(tmp_path)
+        path = tmp_path / "typo.json"
+        path.write_text(json.dumps({"guardrials": {"enabled": False}}))
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ConfigLoader("REINFORCE", str(path))
+        msgs = [str(w.message) for w in caught
+                if "not recognized" in str(w.message)]
+        assert any("guardrials" in m and "guardrails" in m for m in msgs), \
+            msgs
+        # once per process per file: a second loader stays silent
+        with warnings.catch_warnings(record=True) as again:
+            warnings.simplefilter("always")
+            ConfigLoader("REINFORCE", str(path))
+        assert not [w for w in again
+                    if "not recognized" in str(w.message)]
+
+    def test_unknown_key_inside_known_section_warns(self, tmp_path,
+                                                    monkeypatch):
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        monkeypatch.chdir(tmp_path)
+        path = tmp_path / "typo2.json"
+        path.write_text(json.dumps(
+            {"guardrails": {"strike_treshold": 5},
+             "transport": {"keyframe_intervall": 3}}))
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ConfigLoader("REINFORCE", str(path))
+        msgs = [str(w.message) for w in caught
+                if "not recognized" in str(w.message)]
+        assert any("guardrails.strike_treshold" in m
+                   and "strike_threshold" in m for m in msgs), msgs
+        assert any("transport.keyframe_intervall" in m for m in msgs)
+
+    def test_algorithm_hyperparams_exempt_and_comments_exempt(
+            self, tmp_path, monkeypatch):
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        monkeypatch.chdir(tmp_path)
+        path = tmp_path / "ok.json"
+        path.write_text(json.dumps(
+            {"algorithms": {"REINFORCE": {"my_custom_hyperparam": 1}},
+             "_comment": "free-form notes",
+             "guardrails": {"_comment_strikes": "why 3", "enabled": True}}))
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ConfigLoader("REINFORCE", str(path))
+        assert not [w for w in caught
+                    if "not recognized" in str(w.message)]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint ring: healthy-at-save tags + last-known-good restore
+# ---------------------------------------------------------------------------
+class TestCheckpointRing:
+    def _algo(self, tmp_cwd):
+        return build_algorithm(
+            "REINFORCE", obs_dim=OBS_DIM, act_dim=ACT_DIM,
+            env_dir=str(tmp_cwd), traj_per_epoch=1, hidden_sizes=[8],
+            with_vf_baseline=False,
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+
+    def test_healthy_ring_and_restore(self, tmp_cwd):
+        from relayrl_tpu.checkpoint import (
+            checkpoint_algorithm,
+            restore_latest_healthy,
+        )
+
+        algo = self._algo(tmp_cwd)
+        ckdir = str(tmp_cwd / "ck")
+        algo.force_version(1)
+        checkpoint_algorithm(algo, ckdir, wait=True,
+                             extra_meta={"healthy": True})
+        algo.force_version(2)
+        checkpoint_algorithm(algo, ckdir, wait=True,
+                             extra_meta={"healthy": True})
+        algo.force_version(3)
+        checkpoint_algorithm(algo, ckdir, wait=True,
+                             extra_meta={"healthy": False})
+        mgr = algo._ckpt_mgr
+        assert mgr.healthy_steps() == [1, 2]
+        assert mgr.read_extra(3)["healthy"] is False
+        algo.force_version(9)
+        step = restore_latest_healthy(algo, ckdir)
+        assert step == 2
+        assert algo.version == 2
+
+    def test_no_healthy_step_raises(self, tmp_cwd):
+        from relayrl_tpu.checkpoint import (
+            checkpoint_algorithm,
+            restore_latest_healthy,
+        )
+
+        algo = self._algo(tmp_cwd)
+        ckdir = str(tmp_cwd / "ck")
+        algo.force_version(1)
+        checkpoint_algorithm(algo, ckdir, wait=True,
+                             extra_meta={"healthy": False})
+        with pytest.raises(FileNotFoundError):
+            restore_latest_healthy(algo, ckdir)
+
+    def test_untagged_step_never_a_rollback_target(self, tmp_cwd):
+        # Pre-guardrails checkpoints carry no tag: conservatively
+        # unhealthy (the operator can still restore them explicitly).
+        from relayrl_tpu.checkpoint import checkpoint_algorithm
+
+        algo = self._algo(tmp_cwd)
+        ckdir = str(tmp_cwd / "ck")
+        algo.force_version(1)
+        checkpoint_algorithm(algo, ckdir, wait=True)  # no extra_meta
+        assert algo._ckpt_mgr.healthy_steps() == []
+
+
+# ---------------------------------------------------------------------------
+# typed ingest nacks through the spool
+# ---------------------------------------------------------------------------
+class TestSpoolNacks:
+    def test_quarantine_nack_discards_entry(self):
+        from relayrl_tpu.runtime.spool import TrajectorySpool
+        from relayrl_tpu.transport.base import (
+            NACK_QUARANTINED,
+            IngestNack,
+        )
+
+        calls = []
+
+        def send_fn(payload, tagged):
+            calls.append(tagged)
+            raise IngestNack(NACK_QUARANTINED, "agent quarantined", 120.0)
+
+        spool = TrajectorySpool(send_fn=send_fn)
+        spool.send(b"poison", "evil")
+        # delivered-and-refused: nothing retained, breaker untouched
+        assert spool.depth == 0
+        assert len(calls) == 1  # the nack escaped the retry loop
+        assert spool.breaker.allow()
+
+    def test_overload_nack_retains_for_replay(self):
+        from relayrl_tpu.runtime.spool import TrajectorySpool
+        from relayrl_tpu.transport.base import (
+            NACK_OVERLOADED,
+            IngestNack,
+        )
+
+        verdicts = [IngestNack(NACK_OVERLOADED, "overloaded", 0.5)]
+
+        def send_fn(payload, tagged):
+            if verdicts:
+                raise verdicts.pop()
+
+        spool = TrajectorySpool(send_fn=send_fn)
+        spool.send(b"traj", "a")
+        assert spool.depth == 1      # kept: the server asked for later
+        assert spool.breaker.allow()   # an answer, not a failure
+        assert spool.replay() == 1     # pressure cleared: replay lands
+        assert spool.depth == 1      # at-least-once: retained until ack'd window moves
+
+    def test_overload_nack_replays_on_live_connection(self):
+        """Overload-nacked entries must come back WITHOUT a reconnect
+        or breaker transition: the connection never broke, so the only
+        triggers left are fresh sends — once the server's retry_after
+        lapses, the next send fires a replay pass (pre-fix they sat
+        spooled until end-of-run flush())."""
+        from relayrl_tpu.runtime.spool import TrajectorySpool
+        from relayrl_tpu.transport.base import (
+            NACK_OVERLOADED,
+            IngestNack,
+        )
+
+        delivered = []
+        verdicts = [IngestNack(NACK_OVERLOADED, "overloaded", 0.0)]
+
+        def send_fn(payload, tagged):
+            if verdicts:
+                raise verdicts.pop()
+            delivered.append(tagged)
+
+        spool = TrajectorySpool(send_fn=send_fn)
+        spool.send(b"first", "a")   # nacked: retained, redelivery due
+        assert spool.depth == 1 and not delivered
+        assert spool._replay_due is not None
+        time.sleep(0.3)             # past the clamped retry_after floor
+        spool.send(b"second", "a")  # fresh send on the live connection
+        # the fresh send landed AND the due replay re-shipped the window
+        assert any(t.endswith("#s1") for t in delivered), delivered
+        assert spool._replay_due is None
+
+    def test_wire_failure_still_counts_against_breaker(self):
+        from relayrl_tpu.runtime.spool import TrajectorySpool
+        from relayrl_tpu.transport.retry import CircuitBreaker, RetryPolicy
+
+        def send_fn(payload, tagged):
+            raise ConnectionError("down")
+
+        spool = TrajectorySpool(
+            send_fn=send_fn,
+            retry=RetryPolicy(base_delay_s=0.01, max_delay_s=0.01,
+                              deadline_s=0.05, max_attempts=1),
+            breaker=CircuitBreaker("t", failure_threshold=1,
+                                   reset_timeout_s=60.0))
+        spool.send(b"traj", "a")
+        assert spool.depth == 1
+        assert not spool.breaker.allow()  # real failures open the breaker
+
+
+class TestReplayScrub:
+    """Warn-posture decontamination: with the off-policy finite belt
+    standing down, admitted poison in the replay ring must not survive
+    a rollback (it would re-diverge every post-restore update until the
+    budget burns down to halt)."""
+
+    def _fill(self, buf, n, rng, poison_at=()):
+        for i in range(n):
+            rew = float("nan") if i in poison_at else float(rng.random())
+            buf._put(rng.standard_normal(3).astype(np.float32),
+                     rng.uniform(-1, 1, 2).astype(np.float32), rew,
+                     rng.standard_normal(3).astype(np.float32), 0.0,
+                     np.ones(2, np.float32))
+
+    def test_scrub_drops_only_poison(self):
+        from relayrl_tpu.data.step_buffer import StepReplayBuffer
+
+        buf = StepReplayBuffer(obs_dim=3, act_dim=2, capacity=16,
+                               discrete=False)
+        rng = np.random.default_rng(0)
+        self._fill(buf, 6, rng, poison_at=(1, 4))
+        buf.obs[2, 0] = np.inf  # poison a second field class too
+        assert buf.scrub_nonfinite() == 3
+        assert buf.size == 3
+        for name in ("obs", "obs2", "act", "mask2", "rew", "done"):
+            assert np.isfinite(getattr(buf, name)[: buf.size]).all()
+        assert buf.scrub_nonfinite() == 0  # idempotent on a clean ring
+
+    def test_scrub_wrapped_ring_keeps_chronological_order(self):
+        from relayrl_tpu.data.step_buffer import StepReplayBuffer
+
+        buf = StepReplayBuffer(obs_dim=3, act_dim=2, capacity=4,
+                               discrete=False)
+        rng = np.random.default_rng(1)
+        self._fill(buf, 6, rng)          # wraps: ptr=2, size=4
+        marker = buf.rew[(buf.ptr + 1) % buf.capacity]  # 2nd-oldest kept
+        buf.rew[buf.ptr] = np.nan        # poison the oldest survivor
+        assert buf.scrub_nonfinite() == 1
+        assert buf.size == 3 and buf.rew[0] == marker
+
+    def test_warn_mode_rollback_scrubs_the_ring(self, tmp_cwd):
+        algo = build_algorithm(
+            "SAC", obs_dim=3, act_dim=2, env_dir=str(tmp_cwd),
+            hidden_sizes=[8], batch_size=8, update_after=10_000,
+            logger_kwargs={"output_dir": str(tmp_cwd / "logs")})
+        algo.ingest_finite_guard = False  # the warn posture stands it down
+        rng = np.random.default_rng(2)
+
+        def ep(poison):
+            return [ActionRecord(
+                obs=rng.standard_normal(3).astype(np.float32),
+                act=rng.uniform(-1, 1, 2).astype(np.float32),
+                rew=float("nan") if (poison and i == 1)
+                else float(rng.random()),
+                done=(i == 3)) for i in range(4)]
+
+        algo.accumulate(ep(poison=False))
+        algo.accumulate(ep(poison=True))   # admitted: the belt is down
+        assert not np.isfinite(algo.buffer.rew[: algo.buffer.size]).all()
+        before = algo.buffer.size
+        algo.reset_ingest_buffers()        # the rollback path's call
+        assert algo.buffer.size == before - 1
+        assert np.isfinite(algo.buffer.rew[: algo.buffer.size]).all()
+        # enforce posture: the ring is finite by construction — kept
+        algo.ingest_finite_guard = True
+        algo.reset_ingest_buffers()
+        assert algo.buffer.size == before - 1
+
+
+class TestGrpcNackLive:
+    def test_quarantine_nack_rides_the_wire(self, tmp_cwd):
+        """The full back-channel loop on a live gRPC pair: a poison
+        stream quarantines the agent server-side, the next send comes
+        back as a typed nack, and the agent's spool DISCARDS the entry
+        (counted in relayrl_spool_nacked_total) instead of retaining
+        poison for replay."""
+        pytest.importorskip("grpc")
+        import sys
+
+        sys.path.insert(0, str(__import__("pathlib").Path(
+            __file__).parent))
+        from _util import free_port
+
+        from relayrl_tpu import telemetry
+        from relayrl_tpu.runtime.agent import Agent
+        from relayrl_tpu.runtime.server import TrainingServer
+
+        telemetry.reset_for_tests()
+        telemetry.set_registry(telemetry.Registry(run_id="grpc-nack"))
+        cfg = {"guardrails": {"strike_threshold": 1,
+                              "quarantine_cooldown_s": 300.0}}
+        path = tmp_cwd / "grpc_guard.json"
+        path.write_text(json.dumps(cfg))
+        addr = f"127.0.0.1:{free_port()}"
+        # native_grpc=False pins the pure-grpcio servicer — the plane
+        # that carries the typed nack back-channel (the native C++ gRPC
+        # server acks in C++ before Python sees the send, so quarantine
+        # there sheds server-side like the broadcast planes).
+        server = TrainingServer(
+            "REINFORCE", obs_dim=OBS_DIM, act_dim=ACT_DIM,
+            env_dir=str(tmp_cwd), config_path=str(path),
+            server_type="grpc", bind_addr=addr, native_grpc=False,
+            hyperparams={"traj_per_epoch": 100, "hidden_sizes": [8],
+                         "with_vf_baseline": False})
+        try:
+            agent = Agent(server_type="grpc", server_addr=addr,
+                          handshake_timeout_s=30, seed=0, probe=False)
+            try:
+                def play(n, rew):
+                    for _ in range(n):
+                        agent.request_for_action(
+                            np.zeros(OBS_DIM, np.float32))
+                    agent.flag_last_action(rew, terminated=True)
+
+                play(2, float("nan"))  # strike 1 of 1 → quarantine
+                deadline = time.monotonic() + 30
+                while (server.guardrails.quarantine.quarantines_total < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert server.guardrails.quarantine.quarantines_total == 1
+                # quarantined: the NEXT (clean) send nacks on the wire
+                # and the spool discards it — poison-agent sends never
+                # pile up for replay (the PRE-quarantine entry stays
+                # retained: successful sends hold their at-least-once
+                # replay window as always)
+                depth_before = agent.spool.depth
+                play(2, 1.0)
+                snap = telemetry.get_registry().snapshot()
+                nacked = sum(m["value"] for m in snap["metrics"]
+                             if m["name"] == "relayrl_spool_nacked_total")
+                assert nacked >= 1, "the typed nack never reached the spool"
+                assert agent.spool.depth == depth_before, \
+                    "a quarantine-nacked entry was retained"
+                # breaker untouched: a nack is an answer, not a failure
+                assert agent.spool.breaker.allow()
+            finally:
+                agent.disable_agent()
+        finally:
+            server.disable_server()
+            telemetry.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# server integration: the assembled plane
+# ---------------------------------------------------------------------------
+class StubTransport:
+    def __init__(self):
+        self.published = []
+        self.on_trajectory = None
+        self.on_trajectory_decoded = None
+        self.get_model = None
+        self.on_register = None
+        self.on_unregister = None
+        self.check_ingest = None
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def publish_model(self, version, raw):
+        self.published.append((int(version), len(raw)))
+
+
+@pytest.fixture
+def guard_server_factory(tmp_cwd, monkeypatch):
+    """TrainingServer over a stub transport with a guardrails config
+    written to disk; returns (server, stub)."""
+    import relayrl_tpu.runtime.server as srv_mod
+
+    def make(guardrails=None, learner=None, hp=None, start=True,
+             algorithm="REINFORCE"):
+        stub = StubTransport()
+        monkeypatch.setattr(srv_mod, "make_server_transport",
+                            lambda *a, **k: stub)
+        cfg = {}
+        if guardrails is not None:
+            cfg["guardrails"] = guardrails
+        if learner is not None:
+            cfg["learner"] = learner
+        path = tmp_cwd / "guard_config.json"
+        path.write_text(json.dumps(cfg))
+        hyper = {"traj_per_epoch": 2, "hidden_sizes": [8],
+                 "with_vf_baseline": False, "seed_salt": 0, **(hp or {})}
+        server = srv_mod.TrainingServer(
+            algorithm, obs_dim=OBS_DIM, act_dim=ACT_DIM,
+            env_dir=str(tmp_cwd), config_path=str(path),
+            hyperparams=hyper, start=start)
+        return server, stub
+
+    return make
+
+
+class TestServerIngestGuard:
+    def test_poison_stream_rejected_struck_quarantined(
+            self, guard_server_factory):
+        srv, _ = guard_server_factory(
+            guardrails={"strike_threshold": 2, "quarantine_cooldown_s": 300})
+        try:
+            srv.wait_warmup(120)
+            poison = serialize_actions(_episode(rew=float("nan")))
+            clean = serialize_actions(_episode(seed=7))
+            srv._on_trajectory("evil", poison)
+            srv._on_trajectory("evil", poison)   # strike 2 → quarantine
+            srv._on_trajectory("good", clean)
+            deadline = time.monotonic() + 30
+            while (srv.guardrails.quarantine.quarantines_total < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            acct = srv.guardrails_accounting()
+            assert acct["quarantine"]["quarantined"] == ["evil"]
+            assert acct["quarantine"]["quarantines_total"] == 1
+            # quarantined agent's sends shed server-side now
+            srv._on_trajectory("evil", clean)
+            deadline = time.monotonic() + 30
+            while (srv.stats["trajectories"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            # only the good agent's episode reached the learner plane
+            assert srv.stats["trajectories"] == 1
+            params = __import__("jax").device_get(
+                srv.algorithm.state.params)
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(params):
+                assert np.isfinite(np.asarray(leaf)).all()
+        finally:
+            srv.disable_server()
+
+    def test_check_ingest_verdicts(self, guard_server_factory):
+        from relayrl_tpu.transport.base import (
+            NACK_OVERLOADED,
+            NACK_QUARANTINED,
+        )
+
+        srv, _ = guard_server_factory(
+            guardrails={"strike_threshold": 1, "shed_policy": "nack",
+                        "ingest_soft_limit": 1,
+                        "quarantine_cooldown_s": 300,
+                        "nack_retry_after_s": 2.0},
+            start=False)
+        try:
+            assert srv._check_ingest("anyone") is None
+            srv.guardrails.quarantine.strike("evil", "nonfinite")
+            code, reason, retry = srv._check_ingest("evil")
+            assert code == NACK_QUARANTINED and retry > 0
+            # seq-tagged envelope ids resolve to the logical agent
+            code, _, _ = srv._check_ingest("evil#s7")
+            assert code == NACK_QUARANTINED
+            # overload under the nack shed policy
+            srv.guardrails.admission.note_enqueued("x")
+            code, reason, retry = srv._check_ingest("other")
+            assert code == NACK_OVERLOADED and retry == 2.0
+        finally:
+            srv.disable_server()
+
+    def test_warn_mode_admits_but_strikes(self, guard_server_factory):
+        srv, _ = guard_server_factory(
+            guardrails={"ingest_validation": "warn",
+                        "strike_threshold": 100})
+        try:
+            srv.wait_warmup(120)
+            # warn mode stands the per-algorithm belt down too
+            assert srv.algorithm.ingest_finite_guard is False
+            poison = serialize_actions(_episode(rew=float("nan")))
+            srv._on_trajectory("sloppy", poison)
+            deadline = time.monotonic() + 30
+            while (srv.stats["trajectories"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            # admitted (observe-only) AND struck
+            assert srv.stats["trajectories"] == 1
+            acct = srv.guardrails_accounting()
+            assert acct["quarantine"]["strikes_pending"].get("sloppy") == 1
+        finally:
+            srv.disable_server()
+
+    def test_disabled_guardrails_build_nothing(self, guard_server_factory):
+        srv, _ = guard_server_factory(guardrails={"enabled": False},
+                                      start=False)
+        try:
+            assert srv.guardrails is None
+            assert srv.guardrails_accounting() == {}
+        finally:
+            srv.disable_server()
+
+
+class TestPublishGate:
+    def test_nonfinite_params_never_publish(self, guard_server_factory):
+        srv, stub = guard_server_factory(start=False)
+        try:
+            bad = {"w": np.array([1.0, float("nan")], np.float32)}
+            srv._publish_params(99, {"obs_dim": OBS_DIM}, bad)
+            assert stub.published == []
+            assert srv.guardrails.watchdog is not None
+            assert not srv.guardrails.watchdog.healthy()  # external trip
+            trip = srv.guardrails.watchdog.poll(0)
+            assert trip is not None
+            assert trip.signal == "publish_nonfinite"
+        finally:
+            srv.disable_server()
+
+    def test_finite_params_publish_normally(self, guard_server_factory):
+        srv, stub = guard_server_factory(start=False)
+        try:
+            import jax
+
+            host = jax.device_get(srv.algorithm.state.params)
+            srv._publish_params(1, dict(srv.algorithm.arch), host)
+            assert stub.published and stub.published[-1][0] == 1
+        finally:
+            srv.disable_server()
+
+
+class TestRollback:
+    def test_trip_rolls_back_to_healthy_and_resumes(
+            self, guard_server_factory):
+        import jax
+
+        srv, stub = guard_server_factory(
+            learner={"checkpoint_every_epochs": 1},
+            guardrails={"checkpoint_ring": 5})
+        try:
+            srv.wait_warmup(120)
+            for ep in [_episode(seed=i, n=6) for i in range(4)]:
+                srv._decoded.put(ep)
+            assert srv.drain(timeout=120)
+            assert srv.algorithm.version == 2  # traj_per_epoch=2
+            saved_params = jax.device_get(srv.algorithm.state.params)
+            mgr = srv.algorithm._ckpt_mgr
+            mgr.wait()
+            assert mgr.healthy_steps(), "no healthy checkpoint retained"
+            pre_version = srv.latest_model_version
+            # poison the line: external trip surfaces on the next poll
+            srv.guardrails.watchdog.trip_external("publish_nonfinite")
+            for ep in [_episode(seed=10 + i, n=6) for i in range(2)]:
+                srv._decoded.put(ep)
+            deadline = time.monotonic() + 60
+            while (srv.guardrails_accounting().get("rollbacks_total", 0) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            acct = srv.guardrails_accounting()
+            assert acct["rollbacks_total"] == 1
+            assert acct["halted"] is False
+            # params returned to the newest healthy line…
+            restored = jax.device_get(srv.algorithm.state.params)
+            for a, b in zip(jax.tree_util.tree_leaves(saved_params),
+                            jax.tree_util.tree_leaves(restored)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            # …under a version strictly beyond the poisoned line
+            assert srv.algorithm.version > pre_version
+            # the restored params were re-published (forced keyframe path)
+            assert stub.published[-1][0] == srv.algorithm.version
+            srv.drain(timeout=60)
+        finally:
+            srv.disable_server()
+
+    def test_rollback_budget_degrades_to_halt(self, guard_server_factory):
+        from relayrl_tpu.guardrails.watchdog import Trip
+
+        srv, _ = guard_server_factory(
+            guardrails={"max_rollbacks": 0}, start=False)
+        try:
+            assert not srv.guardrails_halted
+            srv._execute_rollback(Trip("nonfinite_params", 1.0, 0.0))
+            assert srv.guardrails_halted
+            acct = srv.guardrails_accounting()
+            assert acct["halted"] is True
+            assert acct["rollbacks_total"] == 0
+            # halted ingest sheds instead of queueing
+            before = srv._ingest.qsize()
+            srv._ingest_one("a", b"payload")
+            assert srv._ingest.qsize() == before
+        finally:
+            srv.disable_server()
+
+    def test_no_healthy_checkpoint_halts(self, guard_server_factory):
+        from relayrl_tpu.guardrails.watchdog import Trip
+
+        srv, _ = guard_server_factory(start=False)
+        try:
+            # no checkpoint was ever saved → restore raises → halt
+            srv._execute_rollback(Trip("param_norm", 1e9, 1e6))
+            assert srv.guardrails_halted
+        finally:
+            srv.disable_server()
+
+    def test_checkpoints_carry_health_tag(self, guard_server_factory):
+        srv, _ = guard_server_factory(
+            learner={"checkpoint_every_epochs": 1})
+        try:
+            srv.wait_warmup(120)
+            for ep in [_episode(seed=i, n=6) for i in range(2)]:
+                srv._decoded.put(ep)
+            assert srv.drain(timeout=120)
+            mgr = srv.algorithm._ckpt_mgr
+            mgr.wait()
+            steps = mgr.healthy_steps()
+            assert steps, "clean training must save healthy-tagged steps"
+            assert mgr.read_extra(steps[-1])["healthy"] is True
+        finally:
+            srv.disable_server()
+
+
+# ---------------------------------------------------------------------------
+# probes are observers: bit-identical params on vs off
+# ---------------------------------------------------------------------------
+class TestBitIdentity:
+    @pytest.mark.parametrize("algo_name,hp", [
+        ("REINFORCE", {"with_vf_baseline": True, "train_vf_iters": 2}),
+        ("PPO", {"train_iters": 2, "minibatch_count": 2}),
+    ])
+    def test_guardrails_probes_do_not_perturb_training(
+            self, tmp_cwd, algo_name, hp):
+        import jax
+
+        def run(with_probes: bool):
+            algo = build_algorithm(
+                algo_name, obs_dim=OBS_DIM, act_dim=ACT_DIM,
+                env_dir=str(tmp_cwd), traj_per_epoch=2, hidden_sizes=[16],
+                seed_salt=0,
+                logger_kwargs={"output_dir":
+                               str(tmp_cwd / f"logs_{with_probes}")},
+                **hp)
+            if with_probes:
+                algo._guard_probes = GuardProbes(update_norm=True)
+            stream = [_episode(seed=100 + i, n=8) for i in range(6)]
+            for ep in stream:
+                algo.receive_trajectory(ep)
+            assert algo.version > 0, "never trained"
+            if with_probes:
+                # the probe scalars really rode the metrics
+                assert PROBE_PARAM_NORM in algo._last_metrics
+                assert PROBE_UPDATE_NORM in algo._last_metrics
+                assert algo._last_metrics[PROBE_NONFINITE] == 0
+            return jax.device_get(algo.state.params)
+
+        off = run(False)
+        on = run(True)
+        flat_off = jax.tree_util.tree_leaves(off)
+        flat_on = jax.tree_util.tree_leaves(on)
+        assert len(flat_off) == len(flat_on)
+        for a, b in zip(flat_off, flat_on):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,164 @@
+"""Property fuzz of the guardrail ingest validator (ISSUE 8 satellite).
+
+The validation boundary's contract, driven with arbitrary and adversarial
+inputs instead of curated cases:
+
+1. ``validate_trajectory`` NEVER raises — a hostile payload must not be
+   able to weaponize the validator (any internal exception is itself a
+   rejection, reason ``validator_error``);
+2. non-finite float data is NEVER accepted — whatever shape smuggles the
+   NaN/Inf (reward, obs tensor, aux value, columnar column), the verdict
+   is a rejection;
+3. every verdict is a member of the stable reason vocabulary
+   (``validate.REASONS``) so the per-reason rejection counter can always
+   attribute it.
+
+Follows the PR 6 fuzz-suite convention: hard dependency on hypothesis is
+soft — the whole module skips when it isn't installed.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property fuzz needs hypothesis (pip install relayrl-tpu[test])")
+
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+from relayrl_tpu.guardrails.validate import (  # noqa: E402
+    REASONS,
+    validate_trajectory,
+)
+from relayrl_tpu.types.action import ActionRecord  # noqa: E402
+
+pytestmark = pytest.mark.guardrails
+
+_FUZZ = settings(max_examples=120, deadline=None)
+
+# -- building blocks ---------------------------------------------------------
+_scalars = st.one_of(
+    st.none(), st.booleans(), st.integers(-2**40, 2**40),
+    st.floats(allow_nan=True, allow_infinity=True, width=32),
+    st.text(max_size=8), st.binary(max_size=8))
+
+_small_arrays = st.one_of(
+    st.lists(st.floats(allow_nan=True, allow_infinity=True, width=32),
+             max_size=6).map(lambda v: np.asarray(v, np.float32)),
+    st.lists(st.integers(-100, 100), max_size=6)
+    .map(lambda v: np.asarray(v, np.int32)),
+    st.lists(st.text(max_size=4), min_size=1, max_size=3)
+    .map(lambda v: np.asarray(v, dtype=object)),
+)
+
+_garbage = st.recursive(
+    _scalars,
+    lambda inner: st.one_of(
+        st.lists(inner, max_size=4),
+        st.dictionaries(st.text(max_size=6), inner, max_size=4)),
+    max_leaves=12)
+
+
+def _record(obs, act, rew, data):
+    return ActionRecord(obs=obs, act=act, rew=rew, data=data, done=False)
+
+
+_records = st.builds(
+    _record,
+    obs=st.one_of(_small_arrays, _scalars),
+    act=st.one_of(st.integers(-10, 10).map(np.int64), _scalars),
+    rew=st.one_of(
+        st.floats(allow_nan=True, allow_infinity=True), _scalars),
+    data=st.dictionaries(st.text(max_size=6),
+                         st.one_of(_scalars, _small_arrays), max_size=3))
+
+_payloads = st.one_of(
+    _garbage,
+    st.lists(_records, max_size=5),
+    st.lists(st.one_of(_records, _garbage), min_size=1, max_size=5),
+)
+
+
+# -- the contract ------------------------------------------------------------
+class TestValidatorFuzz:
+    @_FUZZ
+    @given(item=_payloads, max_steps=st.integers(0, 8))
+    def test_never_raises_and_reasons_are_stable(self, item, max_steps):
+        verdict = validate_trajectory(item, max_steps)
+        assert verdict is None or verdict in REASONS
+
+    @_FUZZ
+    @given(
+        pre=st.lists(st.floats(-10, 10, allow_nan=False,
+                               allow_infinity=False), max_size=3),
+        bad=st.sampled_from([float("nan"), float("inf"), float("-inf")]),
+        where=st.sampled_from(["rew", "obs", "aux"]),
+    )
+    def test_nonfinite_never_accepted(self, pre, bad, where):
+        recs = [
+            ActionRecord(obs=np.asarray(pre + [0.0], np.float32),
+                         act=np.int64(0), rew=1.0,
+                         data={"v": np.float32(0.1)}, done=False)
+            for _ in range(2)
+        ]
+        if where == "rew":
+            recs[1] = ActionRecord(obs=recs[1].obs, act=recs[1].act,
+                                   rew=bad, data=recs[1].data, done=True)
+        elif where == "obs":
+            poisoned = recs[1].obs.copy()
+            poisoned[-1] = bad
+            recs[1] = ActionRecord(obs=poisoned, act=recs[1].act, rew=0.0,
+                                   data=recs[1].data, done=True)
+        else:
+            recs[1] = ActionRecord(obs=recs[1].obs, act=recs[1].act,
+                                   rew=0.0, data={"v": np.float32(bad)},
+                                   done=True)
+        assert validate_trajectory(recs) is not None
+
+    @_FUZZ
+    @given(cols=st.dictionaries(
+        st.sampled_from(["o", "a", "r", "t", "extra"]),
+        st.one_of(_small_arrays, _scalars), max_size=5),
+        n_steps=st.one_of(st.integers(-3, 8), _scalars))
+    def test_decoded_shape_never_raises(self, cols, n_steps):
+        from relayrl_tpu.types.columnar import DecodedTrajectory
+
+        try:
+            item = DecodedTrajectory(
+                agent_id="fuzz", n_steps=n_steps, n_records=0,
+                marker_truncated=False, columns=cols, aux={})
+        except Exception:
+            return  # construction itself refused: boundary never saw it
+        verdict = validate_trajectory(item)
+        assert verdict is None or verdict in REASONS
+
+    def test_every_rejection_is_counted(self):
+        """The server funnel counts EVERY rejection by reason — drive
+        the Guardrails facade directly with one payload per reason."""
+        from relayrl_tpu import telemetry
+        from relayrl_tpu.guardrails import Guardrails
+
+        telemetry.reset_for_tests()
+        telemetry.set_registry(telemetry.Registry(run_id="guard-fuzz"))
+        from relayrl_tpu.config.loader import ConfigLoader
+
+        params = ConfigLoader("REINFORCE").get_guardrails_params()
+        params["max_steps"] = 4
+        g = Guardrails(params)
+        nan_ep = [ActionRecord(obs=np.array([float("nan")], np.float32),
+                               act=np.int64(0), rew=0.0, done=True)]
+        long_ep = [ActionRecord(obs=np.zeros(2, np.float32),
+                                act=np.int64(0), rew=0.0, done=False)
+                   for _ in range(9)]
+        rejects = [nan_ep, long_ep, ["junk"], object()]
+        for item in rejects:
+            assert g.validate("fuzzer", item) is None
+        snap = telemetry.get_registry().snapshot()
+        counted = sum(m["value"] for m in snap["metrics"]
+                      if m["name"] == "relayrl_guard_rejected_total")
+        assert counted == len(rejects)
+        reasons = {m["labels"]["reason"] for m in snap["metrics"]
+                   if m["name"] == "relayrl_guard_rejected_total"}
+        assert reasons <= set(REASONS)
+        telemetry.reset_for_tests()
