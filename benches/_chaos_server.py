@@ -58,6 +58,14 @@ def main() -> None:
                     "checkpoint_dir": os.path.join(scratch, "checkpoints"),
                     "checkpoint_every_epochs": int(
                         cfg.get("checkpoint_every", 2)),
+                    # Dedup-window sizing rides the drill config: anakin
+                    # columnar fleets deliver one SEQ PER EPISODE SEGMENT
+                    # (thousands per lane per drill), so a retracted/
+                    # corrupted seq must stay re-acceptable for the whole
+                    # run or late replays read as duplicates (the window
+                    # analog of the PR 6 spool sizing rule).
+                    "ingest_dedup_window": int(
+                        cfg.get("dedup_window", 4096)),
                 },
                 "telemetry": {"enabled": True, "port": 0},
             }, f)

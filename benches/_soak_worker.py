@@ -395,6 +395,9 @@ def anakin_host_loop(cfg: dict) -> list[dict]:
         host_mode="anakin",
         jax_env=cfg.get("jax_env", "CartPole-v1"),
         unroll_length=cfg.get("unroll_length", 32),
+        # None → config "auto" → columnar frames (the anakin default);
+        # bench_soak --per-record forces False for A/B rows.
+        columnar_wire=cfg.get("columnar_wire"),
         **addr_overrides,
     )
     receipts: list[tuple[int, int]] = []
@@ -428,6 +431,9 @@ def anakin_host_loop(cfg: dict) -> list[dict]:
     # connection, like the spool accounting in chaos mode).
     rows[0]["anakin"] = {
         "windows": windows, "unroll_length": agent.unroll_length,
+        # which trajectory wire form this run shipped (ISSUE 9): with
+        # "columnar", unstack_s_total IS the frame-encode time.
+        "wire": "columnar" if agent.columnar_wire else "records",
         "dispatch_s_total": round(dispatch_s, 4),
         "unstack_s_total": round(unstack_s, 4),
     }
@@ -445,15 +451,19 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     chaos_setup(cfg)
 
-    if cfg.get("anakin"):
-        rows = anakin_host_loop(cfg)
+    if cfg.get("anakin") or cfg.get("vector"):
+        rows = (anakin_host_loop(cfg) if cfg.get("anakin")
+                else vector_host_loop(cfg))
+        result = {"worker_id": cfg["worker_id"], "agents": rows}
+        if cfg.get("chaos_telemetry"):
+            from relayrl_tpu import telemetry
+
+            # same worker-side chaos evidence as process mode below:
+            # without this snapshot the coordinator's fault/retry/spool
+            # accounting reads zero for batched-host chaos rows.
+            result["telemetry"] = telemetry.get_registry().snapshot()
         with open(cfg["result_path"], "w") as f:
-            json.dump({"worker_id": cfg["worker_id"], "agents": rows}, f)
-        return
-    if cfg.get("vector"):
-        rows = vector_host_loop(cfg)
-        with open(cfg["result_path"], "w") as f:
-            json.dump({"worker_id": cfg["worker_id"], "agents": rows}, f)
+            json.dump(result, f)
         return
 
     out: dict = {}

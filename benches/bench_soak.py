@@ -98,7 +98,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              traj_per_epoch: int = 64, algorithm: str = "REINFORCE",
              transport: str = "zmq", vector: bool = False,
              anakin: bool = False, unroll_length: int = 32,
-             jax_env: str = "CartPole-v1") -> dict:
+             jax_env: str = "CartPole-v1",
+             columnar_wire: bool | None = None) -> dict:
     """``vector=True`` runs the fleet as vector actor hosts: each worker
     process is ONE VectorAgent stepping ``agents_per_proc`` logical
     agents through a single batched jitted policy dispatch (the
@@ -217,7 +218,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             "receipt_grace_s": max(8.0, n_actors / 10.0),
             "result_path": result_path, "vector": vector,
             "anakin": anakin, "unroll_length": unroll_length,
-            "jax_env": jax_env, **worker_addrs,
+            "jax_env": jax_env, "columnar_wire": columnar_wire,
+            **worker_addrs,
         }
         procs.append(subprocess.Popen(
             [sys.executable,
@@ -324,6 +326,10 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         "env_steps_per_sec": round(total_steps / mean_window_s, 1),
         **({"anakin_engine": {
             "windows": sum(r["windows"] for r in anakin_rows),
+            # "columnar" = whole segments shipped as contiguous frames
+            # (ISSUE 9, the anakin default) — unstack_s_total is then
+            # the frame-ENCODE time, not per-record unstack.
+            "wire": anakin_rows[0].get("wire", "records"),
             "dispatch_s_total": round(sum(r["dispatch_s_total"]
                                           for r in anakin_rows), 3),
             "unstack_s_total": round(sum(r["unstack_s_total"]
@@ -788,7 +794,9 @@ def _sum_counters(snapshots: list[dict], prefixes: tuple[str, ...]) -> dict:
 def run_chaos(transport: str = "zmq", n_actors: int = 8,
               agents_per_proc: int = 4, duration_s: float = 45.0,
               episode_len: int = 10, obs_dim: int = 8, act_dim: int = 4,
-              traj_per_epoch: int = 8) -> dict:
+              traj_per_epoch: int = 8, anakin: bool = False,
+              unroll_length: int = 16,
+              columnar_wire: bool | None = None) -> dict:
     """Chaos soak (ISSUE 6): the fleet trains under a deterministic
     fault plan (drops/dups/delays/corruption on both agent planes) while
     the coordinator SIGKILLs the learner a third of the way in and
@@ -797,7 +805,14 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
     sequence accounting: after the workers' final spool flush, every
     sequence each actor assigned must be accepted exactly once by the
     surviving server line of history, replay surplus landing in the
-    duplicate counter."""
+    duplicate counter.
+
+    ``anakin=True`` (ISSUE 9) runs the fleet as fused on-device rollout
+    hosts on real CartPole, shipping COLUMNAR trajectory frames by
+    default — the drill then proves frames ride the whole crash-recovery
+    plane (spool seq tags, replay, idempotent ingest, CRC) unchanged."""
+    if anakin:
+        obs_dim, act_dim = 4, 2  # the on-device CartPole the lanes run
     scratch = tempfile.mkdtemp(prefix="relayrl_chaos_")
     server_addrs, worker_addrs = _transport_addrs(
         transport, server_type_in_server=False)
@@ -811,9 +826,18 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
     # kill time the committed line can lag several versions — for the
     # drill, size the window to hold the whole run (the runbook's sizing
     # rule: peak traj rate x (checkpoint interval + commit lag + MTTR)).
+    # Columnar anakin fleets assign one seq PER EPISODE SEGMENT (~25-step
+    # CartPole frames → thousands of seqs per lane per drill, vs hundreds
+    # of per-record trajectories), so both delivery-correctness windows
+    # scale with the wire's granularity: the spool must retain every
+    # frame a mid-run fault could have eaten until the final flush, and
+    # the server dedup window must keep those seqs re-acceptable.
+    spool_entries = 262144 if anakin else 16384
+    dedup_window = 32768 if anakin else 4096
     worker_config = os.path.join(scratch, "worker_config.json")
     with open(worker_config, "w") as f:
-        json.dump({"actor": {"spool_entries": 16384}}, f)
+        json.dump({"actor": {"spool_entries": spool_entries,
+                             "spool_bytes": 512 << 20}}, f)
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -828,6 +852,7 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
                             "hidden_sizes": [32, 32]},
             "server_type": transport, "scratch": scratch,
             "checkpoint_every": 2, "resume": resume,
+            "dedup_window": dedup_window,
             "status_path": status_path, **server_addrs,
         }
         return subprocess.Popen(
@@ -861,6 +886,9 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
             "fault_plan": plan_path, "chaos_telemetry": True,
             "final_replay": True, "config_path": worker_config,
             "result_path": result_path,
+            **({"anakin": True, "unroll_length": unroll_length,
+                "jax_env": "CartPole-v1", "columnar_wire": columnar_wire}
+               if anakin else {}),
             **worker_addrs,
         }
         if transport == "native":
@@ -1010,12 +1038,18 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
 
     rows = (status or {}).get("accounting", {}).get("agents", {})
     zero_loss = accounted(status)
+    anakin_rows = [a["anakin"] for a in agents if a.get("anakin")]
     result = {
-        "bench": f"chaos_soak_{transport}",
+        "bench": f"chaos_soak_{transport}" + ("_anakin" if anakin else ""),
         "config": {"actors": n_actors, "agents_per_proc": agents_per_proc,
                    "duration_s": duration_s, "episode_len": episode_len,
                    "traj_per_epoch": traj_per_epoch,
                    "outage_s": round(restart_wall - kill_wall, 1),
+                   **({"mode": "anakin",
+                       "unroll_length": unroll_length,
+                       "wire": (anakin_rows[0].get("wire", "records")
+                                if anakin_rows else None)}
+                      if anakin else {}),
                    "fault_plan": plan, "host_cores": os.cpu_count()},
         "agents_completed": len(agents),
         "agents_crashed": sum(1 for a in agents if a.get("crashed")),
@@ -1387,6 +1421,10 @@ def main():
     quick = "--quick" in sys.argv
     vector = "--vector" in sys.argv
     anakin = "--anakin" in sys.argv
+    # --anakin ships columnar trajectory frames by DEFAULT (ISSUE 9,
+    # actor.columnar_wire "auto"); --per-record forces the ActionRecord
+    # wire for A/B rows against the same fused engine.
+    columnar_wire = False if "--per-record" in sys.argv else None
     bench_cwd()
     transport = ("native" if "--native" in sys.argv
                  else "grpc" if "--grpc" in sys.argv else "zmq")
@@ -1411,12 +1449,17 @@ def main():
         # Crash-recovery soak: faults injected per the standard plan +
         # learner SIGKILL/resume mid-window; commits MTTR and the
         # zero-loss/zero-dup accounting (ISSUE 6 acceptance row).
+        # --chaos --anakin: the same drill with fused-rollout actors
+        # shipping columnar frames (ISSUE 9's recovery acceptance row).
         result = run_chaos(
             transport=transport,
             n_actors=4 if quick else 8,
             agents_per_proc=4,
-            duration_s=20.0 if quick else 45.0)
-        _finish_chaos(result, f"chaos_soak_{transport}.json")
+            duration_s=20.0 if quick else 45.0,
+            anakin=anakin, columnar_wire=columnar_wire)
+        _finish_chaos(result,
+                      f"chaos_soak_{transport}"
+                      + ("_anakin" if anakin else "") + ".json")
         return
     if "--churn" in sys.argv:
         if transport != "native":
@@ -1452,7 +1495,8 @@ def main():
             r = run_soak(n_actors=n,
                          agents_per_proc=min(16, n) if batched else min(8, n),
                          duration_s=10.0 if quick else 20.0,
-                         transport=transport, vector=vector, anakin=anakin)
+                         transport=transport, vector=vector, anakin=anakin,
+                         columnar_wire=columnar_wire)
             print(json.dumps(r))
             assert r["server_stats"]["dropped"] == 0
             assert r["agents_crashed"] == 0
@@ -1480,7 +1524,8 @@ def main():
         result = run_soak(n_actors=8 if quick else 64,
                           agents_per_proc=4 if quick else 16,
                           duration_s=8.0 if quick else 30.0,
-                          transport=transport, anakin=True)
+                          transport=transport, anakin=True,
+                          columnar_wire=columnar_wire)
         _finish(result, f"soak64_{transport}_anakin.json")
         return
     if vector:

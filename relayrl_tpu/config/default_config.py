@@ -184,6 +184,15 @@ DEFAULT_CONFIG: dict = {
         # On-device env id for the anakin tier, resolved through the JAX
         # env registry (envs/jax/__init__.py; see envs.list_envs()).
         "jax_env": "CartPole-v1",
+        # Trajectory wire form. "auto" (the default) picks per tier:
+        # anakin hosts ship whole rollout segments as contiguous columnar
+        # frames (types/columnar.py — decoded server-side straight into
+        # the staging slabs, no per-step objects or per-record msgpack
+        # on either end); process/vector hosts keep the per-record
+        # ActionRecord wire (their steps are host-bound anyway). true /
+        # false force the form on anakin hosts (false = rolling compat
+        # with pre-columnar servers).
+        "columnar_wire": "auto",
         # -- trajectory spool (runtime/spool.py, crash-recovery plane) --
         # Outbound trajectories are retained in a bounded window and
         # replayed on reconnect; the server's sequence-number dedup makes

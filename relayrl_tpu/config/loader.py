@@ -269,6 +269,12 @@ class ConfigLoader:
         jax_env = params.get("jax_env")
         params["jax_env"] = (str(jax_env) if jax_env
                              else DEFAULT_CONFIG["actor"]["jax_env"])
+        # columnar_wire: "auto" resolves per tier (anakin -> columnar
+        # frames, host-bound tiers -> per-record); booleans force it.
+        cw = params.get("columnar_wire", "auto")
+        if not isinstance(cw, bool):
+            cw = "auto"
+        params["columnar_wire"] = cw
         try:
             # 0 legitimately disables the spool; negatives clamp to 0.
             params["spool_entries"] = max(0, int(

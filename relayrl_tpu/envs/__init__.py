@@ -15,6 +15,7 @@ from relayrl_tpu.envs.atari import (
     make_atari,
 )
 from relayrl_tpu.envs.classic import CartPoleEnv, PendulumEnv
+from relayrl_tpu.envs.gridworld import GridWorldEnv
 from relayrl_tpu.envs.memory import RecallEnv
 from relayrl_tpu.envs.spaces import Box, Discrete
 from relayrl_tpu.envs.vector import SyncVectorEnv, make_vector
@@ -24,6 +25,9 @@ _BUILTIN = {
     "Pendulum-v1": PendulumEnv,
     # Memory task (no Gymnasium counterpart): built-in only.
     "Recall-v0": RecallEnv,
+    # Integer-observation navigation (no Gymnasium counterpart):
+    # exercises the columnar wire's int32 obs column end to end.
+    "GridWorld-v0": GridWorldEnv,
 }
 
 
@@ -91,5 +95,5 @@ def make_jax(env_id: str, **kwargs):
 
 __all__ = ["make", "make_jax", "list_envs", "make_atari",
            "AtariPreprocessing", "SyntheticPixelEnv",
-           "CartPoleEnv", "PendulumEnv", "RecallEnv", "Box", "Discrete",
-           "SyncVectorEnv", "make_vector"]
+           "CartPoleEnv", "PendulumEnv", "RecallEnv", "GridWorldEnv",
+           "Box", "Discrete", "SyncVectorEnv", "make_vector"]

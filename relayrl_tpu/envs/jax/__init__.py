@@ -11,6 +11,7 @@ without renaming the task.
 
 from relayrl_tpu.envs.jax.base import JaxEnv, step_autoreset, tree_where
 from relayrl_tpu.envs.jax.cartpole import CartPoleState, JaxCartPole
+from relayrl_tpu.envs.jax.gridworld import GridWorldState, JaxGridWorld
 from relayrl_tpu.envs.jax.pendulum import JaxPendulum, PendulumState
 from relayrl_tpu.envs.jax.recall import JaxRecall, RecallState
 
@@ -18,6 +19,7 @@ JAX_ENVS = {
     "CartPole-v1": JaxCartPole,
     "Pendulum-v1": JaxPendulum,
     "Recall-v0": JaxRecall,
+    "GridWorld-v0": JaxGridWorld,
 }
 
 
@@ -34,4 +36,4 @@ def make_jax(env_id: str, **kwargs) -> JaxEnv:
 
 __all__ = ["JaxEnv", "JAX_ENVS", "make_jax", "step_autoreset", "tree_where",
            "JaxCartPole", "CartPoleState", "JaxPendulum", "PendulumState",
-           "JaxRecall", "RecallState"]
+           "JaxRecall", "RecallState", "JaxGridWorld", "GridWorldState"]

@@ -59,6 +59,14 @@ def _value_dtype_ok(value) -> bool:
     return arr.dtype.kind in _OK_KINDS
 
 
+#: Columns every decoded trajectory must carry: both producers (the
+#: native msgpack decoder and the columnar wire encoder) always emit
+#: them, and the padding fast path indexes them unguarded — a
+#: hand-rolled hostile frame that omits one must shed here, not as a
+#: KeyError inside the learner loop.
+_REQUIRED_COLS = ("r", "t", "u", "x")
+
+
 def _validate_decoded(item, max_steps: int) -> str | None:
     from relayrl_tpu.types.columnar import trajectory_is_finite
 
@@ -67,6 +75,9 @@ def _validate_decoded(item, max_steps: int) -> str | None:
         return "schema"
     if max_steps and n > max_steps:
         return "length"
+    for name in _REQUIRED_COLS:
+        if name not in item.columns:
+            return "schema"
     for name, col in item.columns.items():
         reason = _col_ok(col, n)
         if reason is not None:

@@ -315,6 +315,7 @@ class VectorAgent:
         host_mode: str | None = None,
         jax_env: str | None = None,
         unroll_length: int | None = None,
+        columnar_wire: bool | None = None,
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
@@ -337,6 +338,14 @@ class VectorAgent:
                            else actor_params["jax_env"])
         self.unroll_length = int(unroll_length if unroll_length is not None
                                  else actor_params["unroll_length"])
+        # actor.columnar_wire: "auto" -> columnar frames on the anakin
+        # tier (whole-segment frames decoded server-side straight into
+        # the staging slabs), per-record wire on the host-bound tiers.
+        if columnar_wire is None:
+            columnar_wire = actor_params.get("columnar_wire", "auto")
+        self.columnar_wire = (self.host_mode == "anakin"
+                              if not isinstance(columnar_wire, bool)
+                              else bool(columnar_wire))
         self.server_type = server_type
         self._addr_overrides = addr_overrides
         self._identity = identity
@@ -390,6 +399,7 @@ class VectorAgent:
                     max_traj_length=self.config.get_max_traj_length(),
                     on_send=self._send_lane,
                     seed=self._seed,
+                    columnar_wire=self.columnar_wire,
                 )
             else:
                 self.host = VectorActorHost(

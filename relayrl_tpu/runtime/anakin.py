@@ -51,6 +51,10 @@ from relayrl_tpu.runtime.policy_actor import (
     apply_wire_swap,
 )
 from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.columnar import (
+    DecodedTrajectory,
+    encode_columnar_frame,
+)
 from relayrl_tpu.types.model_bundle import ModelBundle, exploration_kwargs
 from relayrl_tpu.types.trajectory import Trajectory
 
@@ -121,6 +125,7 @@ class AnakinActorHost:
         seed: int = 0,
         validate: bool = True,
         rng_keys=None,
+        columnar_wire: bool = True,
         **env_kwargs,
     ):
         if num_envs < 1:
@@ -174,6 +179,16 @@ class AnakinActorHost:
         states, obs = jax.jit(jax.vmap(self.env.reset))(init_keys)
         self._carry = (pol_keys, carry_keys, states, obs)
 
+        # Wire form: ``columnar_wire=True`` (the anakin-tier default,
+        # config ``actor.columnar_wire``) ships each completed per-lane
+        # segment as ONE contiguous columnar frame (types/columnar.py)
+        # sliced straight out of the host-resident window — zero per-step
+        # Python objects, zero per-record msgpack. False keeps the
+        # per-record ActionRecord streams (rolling compat / pre-columnar
+        # servers), now unstacked with O(episodes) boundary slicing.
+        self.columnar_wire = bool(columnar_wire)
+        self.max_traj_length = int(max_traj_length)
+        self._on_send = on_send
         self.trajectories = [
             Trajectory(
                 max_length=max_traj_length,
@@ -182,6 +197,11 @@ class AnakinActorHost:
                                on_send(_lane, payload))))
             for lane in range(self.num_envs)
         ]
+        # Per-lane columnar accumulators: column chunks (window slices)
+        # pending until an episode boundary / max_traj_length flush.
+        self._pending = [
+            {"len": 0, "cols": {"o": [], "a": [], "r": []}, "aux": {}}
+            for _ in range(self.num_envs)]
         # Per-lane episode accounting (drivers read these like
         # run_vector_gym_loop's return value).
         self._ep_ret = np.zeros(self.num_envs, np.float64)
@@ -203,6 +223,15 @@ class AnakinActorHost:
         self._m_unstack_s = reg.histogram(
             "relayrl_actor_rollout_unstack_seconds",
             "fused rollout: host unstack of one window into trajectories")
+        self._m_encode_s = reg.histogram(
+            "relayrl_actor_rollout_encode_seconds",
+            "fused rollout: columnar frame encode of one window")
+        self._m_frames = reg.counter(
+            "relayrl_actor_columnar_frames_total",
+            "columnar trajectory frames encoded and handed to the wire")
+        self._m_frame_bytes = reg.counter(
+            "relayrl_actor_columnar_bytes_total",
+            "columnar trajectory frame bytes encoded")
         reg.gauge("relayrl_actor_lanes",
                   "env lanes per batched dispatch on this host").set(
                       self.num_envs)
@@ -230,58 +259,183 @@ class AnakinActorHost:
         window = jax.block_until_ready(window)
         t1 = time.monotonic()
         host_window = jax.device_get(window)
-        episodes = self._unstack(host_window)
+        if self.columnar_wire:
+            episodes = self._emit_columnar(host_window)
+        else:
+            episodes = self._unstack(host_window)
         t2 = time.monotonic()
         steps = self.num_envs * self.unroll_length
         self._m_steps.inc(steps)
         self._m_dispatches.inc()
         self._m_dispatch_s.observe(t1 - t0)
-        self._m_unstack_s.observe(t2 - t1)
+        if self.columnar_wire:
+            self._m_encode_s.observe(t2 - t1)
+        else:
+            self._m_unstack_s.observe(t2 - t1)
         return {"steps": steps, "episodes": episodes,
-                "dispatch_s": t1 - t0, "unstack_s": t2 - t1}
+                "dispatch_s": t1 - t0, "unstack_s": t2 - t1,
+                "encode_s": t2 - t1 if self.columnar_wire else 0.0,
+                "wire": "columnar" if self.columnar_wire else "records"}
+
+    @staticmethod
+    def _cat(chunks: list) -> np.ndarray:
+        return chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+
+    def _emit_columnar(self, w: dict) -> int:
+        """Columnar wire: slice each completed per-lane segment out of
+        the host-resident ``[lanes, unroll]`` window and ship it as one
+        contiguous frame (types/columnar.py), already in the FOLDED form
+        the server's native decoder produces from the per-record wire:
+        the final step carries its full reward (``r``), ``t``/``x`` mark
+        the terminal (terminated beats truncated), ``u`` mirrors
+        ``reward_updated`` (zero on the terminal step, whose reward
+        "rides the marker" — ``n_records`` counts it), and a pure
+        time-limit ending ships the pre-reset observation as
+        ``final_obs``. Episode-boundary detection is one vectorized
+        pass; the only per-episode Python is the frame flush."""
+        term, trunc = w["term"], w["trunc"]
+        done = np.logical_or(term, trunc)
+        episodes = 0
+        for lane in range(self.num_envs):
+            start = 0
+            for b in np.flatnonzero(done[lane]).tolist():
+                self._append_segment(lane, w, start, b + 1)
+                terminated = bool(term[lane, b])
+                self._flush_frame(
+                    lane, ended=True, truncated=not terminated,
+                    final=(None if terminated else
+                           np.asarray(w["final_obs"][lane, b], np.float32)))
+                episodes += 1
+                start = b + 1
+            if start < self.unroll_length:
+                self._append_segment(lane, w, start, self.unroll_length)
+        return episodes
+
+    def _append_segment(self, lane: int, w: dict, a: int, b: int) -> None:
+        """Stash window slice ``[a, b)`` on the lane's pending columns,
+        flushing max_traj_length-sized chunks exactly where the
+        per-record path would (Trajectory.add_action flushes when a real
+        step arrives at capacity, so chunks are exactly max_traj_length
+        steps and the terminal marker always joins its chunk)."""
+        p = self._pending[lane]
+        cols, aux_p = p["cols"], p["aux"]
+        while a < b:
+            if p["len"] >= self.max_traj_length:
+                self._flush_frame(lane, ended=False)
+            stop = min(b, a + self.max_traj_length - p["len"])
+            cols["o"].append(w["obs"][lane, a:stop])
+            cols["a"].append(w["act"][lane, a:stop])
+            cols["r"].append(w["rew"][lane, a:stop])
+            for k, v in w["aux"].items():
+                aux_p.setdefault(k, []).append(v[lane, a:stop])
+            p["len"] += stop - a
+            self._ep_ret[lane] += float(
+                np.sum(w["rew"][lane, a:stop], dtype=np.float64))
+            a = stop
+
+    def _flush_frame(self, lane: int, ended: bool, truncated: bool = False,
+                     final=None) -> None:
+        p = self._pending[lane]
+        n = p["len"]
+        if n == 0:
+            return
+        r = self._cat(p["cols"]["r"])
+        t_col = np.zeros(n, np.uint8)
+        x_col = np.zeros(n, np.uint8)
+        u_col = (r != 0.0).astype(np.uint8)
+        if ended:
+            t_col[-1] = 1
+            u_col[-1] = 0
+            if truncated:
+                x_col[-1] = 1
+        time_limited = bool(ended and truncated)
+        dt = DecodedTrajectory(
+            agent_id="",  # attribution rides the transport envelope
+            n_steps=n, n_records=n + (1 if ended else 0),
+            marker_truncated=time_limited,
+            columns={"o": self._cat(p["cols"]["o"]),
+                     "a": self._cat(p["cols"]["a"]),
+                     "r": r, "t": t_col, "u": u_col, "x": x_col},
+            aux={k: self._cat(chunks) for k, chunks in p["aux"].items()},
+            final_obs=final if time_limited else None)
+        frame = encode_columnar_frame(dt)
+        self._m_frames.inc()
+        self._m_frame_bytes.inc(len(frame))
+        if self._on_send is not None:
+            self._on_send(lane, frame)
+        if ended:
+            self.episode_returns[lane].append(float(self._ep_ret[lane]))
+            self._ep_ret[lane] = 0.0
+        p["len"] = 0
+        for chunks in p["cols"].values():
+            chunks.clear()
+        for chunks in p["aux"].values():
+            chunks.clear()
 
     def _unstack(self, w: dict) -> int:
-        """Replay one host-side window into the per-lane trajectories,
-        reproducing the live loop's wire shape exactly: reward r_t lands
-        on the record of the action that EARNED it (``reward_updated``
-        set only for nonzero rewards, as ``update_reward`` would have),
-        the final action of an episode keeps rew=0 with its reward riding
-        the terminal marker (``flag_last_action`` semantics), terminated
-        beats truncated, and a pure time-limit ending ships the pre-reset
-        observation for the value bootstrap."""
+        """Per-record fallback (``columnar_wire=False``): replay one
+        host-side window into the per-lane trajectories, reproducing the
+        live loop's wire shape exactly: reward r_t lands on the record of
+        the action that EARNED it (``reward_updated`` set only for
+        nonzero rewards, as ``update_reward`` would have), the final
+        action of an episode keeps rew=0 with its reward riding the
+        terminal marker (``flag_last_action`` semantics), terminated
+        beats truncated, and a pure time-limit ending ships the
+        pre-reset observation for the value bootstrap.
+
+        Episode boundaries come from one vectorized pass
+        (``np.flatnonzero(term | trunc)``), scalars bulk-convert via
+        ``tolist``, and records land through the bulk
+        ``Trajectory.add_actions`` — O(episodes) loop control instead of
+        the old per-step ``add_action`` calls."""
         obs, act, rew = w["obs"], w["act"], w["rew"]
         term, trunc, final_obs = w["term"], w["trunc"], w["final_obs"]
-        aux = w["aux"]
-        aux_items = list(aux.items())
+        aux_items = list(w["aux"].items())
+        done = np.logical_or(term, trunc)
         episodes = 0
         for lane in range(self.num_envs):
             traj = self.trajectories[lane]
-            for t in range(self.unroll_length):
-                done = bool(term[lane, t]) or bool(trunc[lane, t])
-                r = float(rew[lane, t])
-                self._ep_ret[lane] += r
-                record = ActionRecord(
-                    obs=obs[lane, t],
-                    act=np.asarray(act[lane, t]),
+            obs_l, act_l = obs[lane], act[lane]
+            rew_l = rew[lane].tolist()
+            aux_l = [(k, v[lane]) for k, v in aux_items]
+
+            def seg_records(a, b, last_masked, _obs_l=obs_l, _act_l=act_l,
+                            _rew_l=rew_l, _aux_l=aux_l):
+                # last_masked: index whose record keeps rew=0 (the
+                # terminal step — its reward rides the marker), -1 for
+                # an unterminated trailing segment.
+                return [ActionRecord(
+                    obs=_obs_l[t],
+                    act=np.asarray(_act_l[t]),
                     mask=None,
-                    rew=0.0 if done else r,
-                    reward_updated=bool(not done and r != 0.0),
-                    data={k: np.asarray(v[lane, t]) for k, v in aux_items},
+                    rew=0.0 if t == last_masked else _rew_l[t],
+                    reward_updated=bool(t != last_masked
+                                        and _rew_l[t] != 0.0),
+                    data={k: np.asarray(v[t]) for k, v in _aux_l},
                     done=False,
-                )
-                traj.add_action(record, send_if_done=True)
-                if done:
-                    terminated = bool(term[lane, t])
-                    time_limited = not terminated
-                    marker = ActionRecord(
-                        obs=(np.asarray(final_obs[lane, t], np.float32)
-                             if time_limited else None),
-                        rew=r, done=True, truncated=time_limited)
-                    traj.add_action(marker, send_if_done=True)
-                    self.episode_returns[lane].append(
-                        float(self._ep_ret[lane]))
-                    self._ep_ret[lane] = 0.0
-                    episodes += 1
+                ) for t in range(a, b)]
+
+            start = 0
+            for b in np.flatnonzero(done[lane]).tolist():
+                records = seg_records(start, b + 1, last_masked=b)
+                terminated = bool(term[lane, b])
+                time_limited = not terminated
+                records.append(ActionRecord(
+                    obs=(np.asarray(final_obs[lane, b], np.float32)
+                         if time_limited else None),
+                    rew=rew_l[b], done=True, truncated=time_limited))
+                traj.add_actions(records)
+                self._ep_ret[lane] += float(
+                    np.sum(rew[lane, start:b + 1], dtype=np.float64))
+                self.episode_returns[lane].append(float(self._ep_ret[lane]))
+                self._ep_ret[lane] = 0.0
+                episodes += 1
+                start = b + 1
+            if start < self.unroll_length:
+                traj.add_actions(seg_records(start, self.unroll_length,
+                                             last_masked=-1))
+                self._ep_ret[lane] += float(
+                    np.sum(rew[lane, start:], dtype=np.float64))
         return episodes
 
     # -- model hot-swap (one gate, all lanes, whole windows) --

@@ -114,6 +114,17 @@ class TrainingServer:
         self._m_decode = reg.histogram(
             "relayrl_server_decode_seconds",
             "one payload decode on a staging worker")
+        self._m_columnar_frames = reg.counter(
+            "relayrl_server_columnar_frames_total",
+            "columnar trajectory frames decoded straight into "
+            "DecodedTrajectory (the wire fast path)")
+        self._m_columnar_bytes = reg.counter(
+            "relayrl_server_columnar_bytes_total",
+            "columnar trajectory frame bytes decoded")
+        self._m_columnar_rejects = reg.counter(
+            "relayrl_server_columnar_rejects_total",
+            "columnar frames refused at decode (CRC mismatch / "
+            "malformed layout) — also counted in dropped_total")
         self._m_dispatch = reg.histogram(
             "relayrl_server_dispatch_seconds",
             "learner-thread host work per trajectory: accumulate + "
@@ -811,7 +822,11 @@ class TrainingServer:
 
     # -- staging: raw payload -> decoded trajectory (overlaps learner) --
     def _staging_loop(self) -> None:
-        from relayrl_tpu.types.columnar import RawTrajectory
+        from relayrl_tpu.types.columnar import (
+            RawTrajectory,
+            is_columnar_frame,
+            parse_frame,
+        )
 
         decoder = None
         try:
@@ -829,9 +844,19 @@ class TrainingServer:
             if guard is not None and guard.admission is not None:
                 guard.admission.note_dequeued(agent_id)
             item = None
+            columnar = False
             t0 = time.monotonic()
             try:
-                if decoder is not None:
+                if is_columnar_frame(payload):
+                    # Columnar wire fast path (anakin actors): the frame
+                    # IS the folded column layout — a CRC check plus a
+                    # handful of np.frombuffer views, no msgpack, no
+                    # per-step objects, on every transport.
+                    columnar = True
+                    item = parse_frame(payload, agent_id=agent_id)
+                    self._m_columnar_frames.inc()
+                    self._m_columnar_bytes.inc(len(payload))
+                elif decoder is not None:
                     # off-GIL msgpack -> columns; falls back to the Python
                     # decoder only for payloads the columnar schema can't
                     # represent
@@ -848,6 +873,13 @@ class TrainingServer:
                 else:
                     item = deserialize_actions(payload)
             except Exception:
+                if columnar:
+                    self._m_columnar_rejects.inc()
+                # Un-see the seq: the payload never reached the learner
+                # (CRC/parse failure), so the actor's spool replay must be
+                # able to land its retained clean copy later.
+                if seq is not None and self._ingest_ledger is not None:
+                    self._ingest_ledger.retract(agent_id, seq)
                 self._count_dropped()
             if item is not None and guard is not None:
                 # Ingest validation + per-agent strike accounting: the
@@ -863,6 +895,12 @@ class TrainingServer:
                 try:
                     self._decoded.put_nowait(item)
                 except queue.Full:
+                    # Same contract as every other shed path: un-see the
+                    # seq so the sender's spool replay can land this
+                    # trajectory once pressure clears (a shed is
+                    # backpressure, not loss).
+                    if seq is not None and self._ingest_ledger is not None:
+                        self._ingest_ledger.retract(agent_id, seq)
                     self._count_dropped()
             # task_done only after the decoded item is enqueued, so
             # drain()'s two-queue emptiness check never races the handoff

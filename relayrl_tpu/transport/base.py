@@ -42,6 +42,12 @@ MODEL_TOPIC = b"model"
 
 
 def pack_trajectory_envelope(agent_id: str, payload: bytes) -> bytes:
+    """``payload`` is opaque to the transport plane: per-record msgpack
+    (``types/trajectory.serialize_actions``) or a columnar trajectory
+    frame (``types/columnar.encode_columnar_frame`` — the anakin tier's
+    wire form, sniffed server-side by the RLD1 magic). Envelopes carry
+    attribution + the spool's ``#s<seq>`` tag identically for both, so
+    the whole delivery plane is wire-form-agnostic."""
     return msgpack.packb({"id": agent_id, "traj": payload}, use_bin_type=True)
 
 
@@ -406,7 +412,9 @@ class AgentTransport(abc.ABC):
     @abc.abstractmethod
     def send_trajectory(self, payload: bytes,
                         agent_id: str | None = None) -> None:
-        """Ship one serialized trajectory. ``agent_id`` stamps the wire
+        """Ship one serialized trajectory (per-record msgpack or a
+        columnar frame — opaque bytes either way, see
+        :func:`pack_trajectory_envelope`). ``agent_id`` stamps the wire
         envelope (defaults to the connection identity) — vector hosts pass
         the owning logical lane's id so server-side attribution is
         per-logical-agent, not per-socket."""

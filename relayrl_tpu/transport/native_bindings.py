@@ -236,9 +236,25 @@ class NativeServerTransportImpl(ServerTransport):
             Registration,
             RawTrajectory,
             Unregistration,
+            is_columnar_frame,
             parse_drain,
+            parse_frame,
         )
 
+        from relayrl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        m_frames = reg.counter(
+            "relayrl_server_columnar_frames_total",
+            "columnar trajectory frames decoded straight into "
+            "DecodedTrajectory (the wire fast path)")
+        m_frame_bytes = reg.counter(
+            "relayrl_server_columnar_bytes_total",
+            "columnar trajectory frame bytes decoded")
+        m_frame_rejects = reg.counter(
+            "relayrl_server_columnar_rejects_total",
+            "columnar frames refused at decode (CRC mismatch / "
+            "malformed layout) — also counted in dropped_total")
         cap = 1 << 20
         buf = (ctypes.c_uint8 * cap)()
         n_items = ctypes.c_int(0)
@@ -279,6 +295,26 @@ class NativeServerTransportImpl(ServerTransport):
                             # but count it, and re-raise non-data errors
                             swallow_decode_error("native",
                                                  "trajectory_ingest", e)
+                    if is_columnar_frame(payload):
+                        # Columnar wire frame: the C++ envelope decoder
+                        # carried it through verbatim (raw fallback, id
+                        # intact incl. any seq tag); parse it here and
+                        # join the decoded batch — same funnel as the
+                        # C++-decoded items (seq dedup + guardrails in
+                        # _on_trajectory_decoded).
+                        try:
+                            batch.append(parse_frame(payload,
+                                                     agent_id=agent_id))
+                            m_frames.inc()
+                            m_frame_bytes.inc(len(payload))
+                        except Exception as e:
+                            # Same operator surface as the zmq/grpc
+                            # staging path: a refused frame is visible
+                            # on every transport.
+                            m_frame_rejects.inc()
+                            swallow_decode_error("native",
+                                                 "columnar_frame", e)
+                        continue
                     self.on_trajectory(agent_id, payload)
                 elif isinstance(item, Registration):
                     self.on_register(item.agent_id)

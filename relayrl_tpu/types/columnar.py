@@ -23,19 +23,52 @@ import ctypes
 import dataclasses
 import struct
 import threading
+import zlib
 
 import numpy as np
 
 from relayrl_tpu.types.action import ActionRecord
-from relayrl_tpu.types.dtypes import DType, to_numpy_dtype
-from relayrl_tpu.types.tensor import decode_tensor
+from relayrl_tpu.types.dtypes import DType, from_numpy_dtype, to_numpy_dtype
+from relayrl_tpu.types.tensor import decode_tensor, encode_tensor
 
 _BLOB_MAGIC = 0x31444C52  # "RLD1"
+MAGIC_BYTES = b"RLD1"  # little-endian prefix of every blob/frame
 KIND_COLUMNAR = 0
 KIND_RAW = 1
 KIND_REGISTER = 2
 KIND_RAW_ENVELOPE = 3
 KIND_UNREGISTER = 4
+
+# -- columnar WIRE frames (the trajectory fast path, ISSUE 9) --
+#
+# A columnar frame is an RLD1 kind-0 blob shipped AS the trajectory
+# payload (inside the usual transport envelope, so attribution and the
+# spool's ``#s<seq>`` tag ride the envelope id unchanged), extended with
+# a footer the wire needs but the in-process drain does not:
+#
+#     flags bit 3 (8): u8 frame_version | u32 crc32
+#
+# The CRC covers every preceding byte of the blob (header through the
+# final-tensor sections), so a corrupt frame is detected at decode time
+# instead of poisoning the staging slabs. The native C++ codec never
+# emits the footer bit, so its drain blobs parse exactly as before; a
+# frame arriving over the native transport rides the C++ envelope
+# decoder's raw-fallback path verbatim (codec.cc carries unknown
+# payloads through untouched) and is parsed HERE, so one Python parser
+# serves all three transports.
+FRAME_VERSION = 1
+FLAG_MARKER_TRUNCATED = 1
+FLAG_FINAL_OBS = 2
+FLAG_FINAL_MASK = 4
+FLAG_FOOTER = 8
+_FOOTER = struct.Struct("<BI")  # frame_version, crc32
+
+
+def is_columnar_frame(payload) -> bool:
+    """Cheap wire sniff: does this trajectory payload carry an RLD1
+    columnar frame (vs a msgpack per-record trajectory, which always
+    starts with a msgpack map byte)?"""
+    return len(payload) >= _HDR.size and bytes(payload[:4]) == MAGIC_BYTES
 
 
 @dataclasses.dataclass
@@ -178,8 +211,16 @@ _COL_FIXED = struct.Struct("<BB")     # dtype, ndim (after name)
 _META = struct.Struct("<IIBH")        # n_steps, n_records, flags, n_cols
 
 
-def parse_blob(view: memoryview, off: int = 0):
-    """Parse one RLD1 blob at ``off``; returns ``(item, next_off)``."""
+def parse_blob(view: memoryview, off: int = 0, verify_crc: bool = True):
+    """Parse one RLD1 blob at ``off``; returns ``(item, next_off)``.
+
+    Blobs carrying the wire footer (``flags & FLAG_FOOTER``, produced by
+    :func:`encode_columnar_frame`) are CRC-verified here — a mismatch
+    raises ``ValueError`` so the ingest path counts the frame as
+    malformed instead of staging corrupt columns. ``verify_crc=False``
+    skips the recompute for callers that already checked the footer
+    (:func:`parse_frame` verifies integrity BEFORE parsing)."""
+    start = off
     magic, kind, id_len = _HDR.unpack_from(view, off)
     if magic != _BLOB_MAGIC:
         raise ValueError(f"bad RLD1 magic {magic:#x}")
@@ -236,6 +277,15 @@ def parse_blob(view: memoryview, off: int = 0):
         off += 4
         final_mask = decode_tensor(view[off:off + n])
         off += n
+    if flags & FLAG_FOOTER:
+        version, crc = _FOOTER.unpack_from(view, off)
+        if version != FRAME_VERSION:
+            raise ValueError(
+                f"unsupported columnar frame version: {version}")
+        if (verify_crc
+                and zlib.crc32(view[start:off]) & 0xFFFFFFFF != crc):
+            raise ValueError("columnar frame CRC mismatch")
+        off += _FOOTER.size
     return DecodedTrajectory(
         agent_id=agent_id, n_steps=n_steps, n_records=n_records,
         marker_truncated=bool(flags & 1), columns=columns, aux=aux,
@@ -257,6 +307,123 @@ def parse_drain(buf: memoryview | bytes) -> list:
         items.append(item)
         off = end
     return items
+
+
+# -- columnar frame encode/decode (the trajectory wire fast path) --
+
+_CANONICAL_COLS = ("o", "a", "m", "r", "t", "u", "x")
+
+
+# dtype-tag memo keyed by the dtype object: the emitter encodes tens of
+# thousands of small frames per second, and from_numpy_dtype's
+# np.dtype() + dict hop per column was measurable at that rate.
+_TAG_BY_DTYPE: dict = {}
+
+
+def _dtype_tag(dtype) -> int:
+    tag = _TAG_BY_DTYPE.get(dtype)
+    if tag is None:
+        tag = int(from_numpy_dtype(dtype))
+        _TAG_BY_DTYPE[dtype] = tag
+    return tag
+
+
+def encode_columnar_frame(dt: DecodedTrajectory,
+                          agent_id: str | None = None) -> bytes:
+    """One :class:`DecodedTrajectory` → wire frame bytes.
+
+    The layout is the RLD1 kind-0 blob the native drain already emits
+    (so :func:`parse_blob` is the one parser for both), plus the CRC
+    footer (``FLAG_FOOTER``). Attribution normally rides the transport
+    envelope — ``agent_id`` defaults to the trajectory's own id and may
+    be empty to save wire bytes when the envelope carries it."""
+    ident = (dt.agent_id if agent_id is None else agent_id).encode()
+    flags = FLAG_FOOTER
+    if dt.marker_truncated:
+        flags |= FLAG_MARKER_TRUNCATED
+    if dt.final_obs is not None:
+        flags |= FLAG_FINAL_OBS
+    if dt.final_mask is not None:
+        flags |= FLAG_FINAL_MASK
+    names = [n for n in _CANONICAL_COLS if n in dt.columns]
+    names += [n for n in dt.columns if n not in _CANONICAL_COLS]
+    cols = [(name.encode(), dt.columns[name]) for name in names]
+    cols += [(b"d:" + name.encode(), arr) for name, arr in dt.aux.items()]
+    out = bytearray(_HDR.pack(_BLOB_MAGIC, KIND_COLUMNAR, len(ident)))
+    out += ident
+    out += _META.pack(dt.n_steps, dt.n_records, flags, len(cols))
+    pack = struct.pack
+    off = 0
+    payloads = []
+    for name, arr in cols:
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        nbytes = arr.nbytes
+        # one pack per column: name_len|name|dtype|ndim|dims|off|nbytes
+        out += pack(f"<B{len(name)}sBB{arr.ndim}IQQ", len(name), name,
+                    _dtype_tag(arr.dtype), arr.ndim, *arr.shape,
+                    off, nbytes)
+        padded = (nbytes + 7) & ~7  # 8-align each column
+        payloads.append((arr, padded - nbytes))
+        off += padded
+    out += pack("<Q", off)
+    for arr, pad in payloads:
+        out += arr.tobytes()
+        if pad:
+            out += b"\x00" * pad
+    for final in (dt.final_obs, dt.final_mask):
+        if final is not None:
+            frame = encode_tensor(final)
+            out += pack("<I", len(frame))
+            out += frame
+    out += _FOOTER.pack(FRAME_VERSION, zlib.crc32(out) & 0xFFFFFFFF)
+    return bytes(out)
+
+
+def parse_frame(payload, agent_id: str | None = None) -> DecodedTrajectory:
+    """Wire frame bytes → :class:`DecodedTrajectory` (CRC verified).
+
+    The strict wire-side entry point: exactly one CRC-footed columnar
+    blob, nothing trailing. ``agent_id`` (the transport envelope's
+    attribution, seq tag already stripped by the caller) overrides the
+    frame-embedded id when given — the envelope owns attribution on
+    every transport, mirroring the msgpack decode path."""
+    view = memoryview(payload)
+    try:
+        _, kind, id_len = _HDR.unpack_from(view, 0)
+        if kind != KIND_COLUMNAR:
+            raise ValueError(
+                f"payload is an RLD1 blob but not a columnar frame "
+                f"(kind {kind})")
+        if not view[_HDR.size + id_len + 8] & FLAG_FOOTER:
+            # Wire frames are always CRC-footed (encode_columnar_frame);
+            # an unfooted kind-0 blob on the wire is foreign/corrupt.
+            raise ValueError("columnar wire frame missing CRC footer")
+        # Integrity FIRST: the footer sits in the last 5 bytes, so the
+        # whole frame is checksummed before any column is trusted — a
+        # corrupt frame fails here with the CRC verdict, never as a
+        # numpy shape error halfway through a poisoned parse.
+        version, crc = _FOOTER.unpack_from(view, len(view) - _FOOTER.size)
+        if version != FRAME_VERSION:
+            raise ValueError(
+                f"unsupported columnar frame version: {version}")
+        if zlib.crc32(view[:len(view) - _FOOTER.size]) & 0xFFFFFFFF != crc:
+            raise ValueError("columnar frame CRC mismatch")
+        # verify_crc=False: the full-frame checksum above already covered
+        # every byte parse_blob will walk — no second pass on the ingest
+        # hot path.
+        item, end = parse_blob(view, verify_crc=False)
+    except (struct.error, IndexError) as e:
+        # Truncated/hostile frames surface as data-shaped errors, the
+        # class transport receive loops classify as droppable.
+        raise ValueError(f"malformed columnar frame: {e}") from e
+    if end != len(view):
+        raise ValueError(
+            f"columnar frame framing mismatch: {len(view) - end} "
+            f"trailing bytes")
+    if agent_id is not None:
+        item.agent_id = agent_id
+    return item
 
 
 # -- ctypes wrapper over rl_decode (shared with the zmq/grpc ingest path) --

@@ -73,17 +73,58 @@ class Trajectory:
         """
         is_marker = action.act is None
         if not is_marker and len(self._actions) >= self.max_length:
-            if send_if_done and self._on_send is not None:
-                self.flush()
-            else:
-                # No transport attached: evict oldest rather than grow
-                # unbounded.
-                del self._actions[: max(1, self.max_length // 2)]
+            self._flush_or_evict_at_capacity(send_if_done)
         self._actions.append(action)
         if action.done and send_if_done and self._on_send is not None:
             self.flush()
             return True
         return False
+
+    def _flush_or_evict_at_capacity(self, send_if_done: bool) -> bool:
+        """The ONE copy of the capacity rule (a real step arriving at
+        ``max_length``): flush to the transport when one is attached,
+        else evict the oldest half rather than grow unbounded. Shared by
+        :meth:`add_action` and :meth:`add_actions` so the per-step and
+        bulk wire chunking can never diverge. Returns True iff a
+        transport flush happened."""
+        if send_if_done and self._on_send is not None:
+            self.flush()
+            return True
+        del self._actions[: max(1, self.max_length // 2)]
+        return False
+
+    def add_actions(self, records: list[ActionRecord],
+                    send_if_done: bool = True) -> int:
+        """Bulk append: wire-identical to calling :meth:`add_action` per
+        record, but runs of non-terminal steps extend the buffer in one
+        slice, so the Python overhead is O(flushes), not O(steps) — the
+        anakin fallback unstacker's path (runtime/anakin.py). Returns
+        the number of transport flushes performed."""
+        acts = self._actions
+        flushes = 0
+        i, n = 0, len(records)
+        while i < n:
+            rec = records[i]
+            is_marker = rec.act is None
+            if not is_marker and len(acts) >= self.max_length:
+                flushes += self._flush_or_evict_at_capacity(send_if_done)
+            if rec.done or is_marker:
+                acts.append(rec)
+                i += 1
+                if rec.done and send_if_done and self._on_send is not None:
+                    self.flush()
+                    flushes += 1
+                continue
+            # run of plain steps: extend up to capacity / the next record
+            # that needs per-record handling (done or marker)
+            j = i
+            stop = min(n, i + self.max_length - len(acts))
+            while (j < stop and not records[j].done
+                   and records[j].act is not None):
+                j += 1
+            acts.extend(records[i:j])
+            i = j
+        return flushes
 
     def flush(self) -> None:
         """Serialize + hand off to the transport, then clear.

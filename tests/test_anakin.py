@@ -50,6 +50,7 @@ class TestUnstackWireSemantics:
         sent: list[tuple[int, bytes]] = []
         host = AnakinActorHost(
             _bundle(), "CartPole-v1", num_envs=4, unroll_length=64,
+            columnar_wire=False,  # this suite pins the per-record fallback
             on_send=lambda lane, p: sent.append((lane, p)), seed=2)
         host.rollout()
         assert {lane for lane, _ in sent} == {0, 1, 2, 3}
@@ -77,7 +78,8 @@ class TestUnstackWireSemantics:
         sent: list[bytes] = []
         host = AnakinActorHost(
             _bundle(), JaxCartPole(max_steps=5), num_envs=2,
-            unroll_length=40, on_send=lambda lane, p: sent.append(p),
+            unroll_length=40, columnar_wire=False,
+            on_send=lambda lane, p: sent.append(p),
             seed=0)
         host.rollout()
         truncated_markers = terminal_markers = 0
@@ -102,6 +104,7 @@ class TestUnstackWireSemantics:
         per_lane: dict[int, list[bytes]] = {}
         host = AnakinActorHost(
             _bundle(), "CartPole-v1", num_envs=3, unroll_length=50,
+            columnar_wire=False,
             on_send=lambda lane, p: per_lane.setdefault(lane, []).append(p),
             seed=5)
         host.rollout()

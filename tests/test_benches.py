@@ -209,10 +209,15 @@ def test_bench_anakin_quick_emits_json(tmp_path):
     base = [r for r in lines if r.get("bench") == "anakin_vector_baseline"]
     fused = [r for r in lines if r.get("bench") == "anakin_fused_rollout"]
     assert base and fused
+    # both wire forms measured per grid cell (ISSUE 9)
+    assert {r["config"]["wire"] for r in fused} == {"columnar", "records"}
     headline = next(r for r in lines if r.get("bench") == "anakin_headline")
     for lanes, speedup in headline["speedup_rollout_at_equal_lanes"].items():
         assert speedup > 1.0, (lanes, speedup)
     assert headline["best_rollout"]["rollout_steps_per_sec"] > 0
+    assert headline["best_e2e_columnar"] > 0
+    assert headline["speedup_columnar_e2e_vs_records"], \
+        "columnar-vs-records e2e map missing"
 
 
 @pytest.mark.telemetry

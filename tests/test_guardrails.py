@@ -76,7 +76,9 @@ def _decoded(rew=1.0, n=2, agent="a"):
         columns={"o": np.zeros((n, OBS_DIM), np.float32),
                  "a": np.zeros((n,), np.int32),
                  "r": np.array([0.0] * (n - 1) + [rew], np.float32),
-                 "t": np.array([False] * (n - 1) + [True])},
+                 "t": np.array([False] * (n - 1) + [True]),
+                 "u": np.zeros((n,), np.uint8),
+                 "x": np.zeros((n,), np.uint8)},
         aux={"v": np.zeros((n,), np.float32),
              "logp_a": np.zeros((n,), np.float32)})
 

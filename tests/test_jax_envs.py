@@ -255,9 +255,76 @@ class TestInScanAutoreset:
         assert not np.array_equal(run(9), run(10))
 
 
+class TestGridWorldParity:
+    def test_full_bitwise_parity(self):
+        """All-integer dynamics (int32 positions, clamped moves,
+        integral rewards): obs, reward, and BOTH flags are bit-equal to
+        the numpy twin across injected states, terminations (goal
+        reached), and time-limit truncations."""
+        from relayrl_tpu.envs import GridWorldEnv
+
+        jenv = make_jax("GridWorld-v0", size=4, max_steps=10)
+        nenv = GridWorldEnv(size=4, max_steps=10)
+        nenv.reset(seed=0)
+        step = jax.jit(jenv.step)
+        rng = np.random.default_rng(5)
+        key = jax.random.PRNGKey(5)
+        key, sub = jax.random.split(key)
+        state, jobs = jenv.reset(sub)
+        assert np.asarray(jobs).dtype == np.int32
+        terms = truncs = 0
+        for _ in range(400):
+            nenv._pos = np.asarray(state.pos, np.int32).copy()
+            nenv._t = int(state.t)
+            action = int(rng.integers(4))
+            state, jobs, jrew, jterm, jtrunc = step(state, jnp.int32(action))
+            nobs, nrew, nterm, ntrunc, _ = nenv.step(action)
+            np.testing.assert_array_equal(np.asarray(jobs), nobs)
+            assert np.asarray(jobs).dtype == nobs.dtype == np.int32
+            assert float(jrew) == nrew
+            assert bool(jterm) == nterm and bool(jtrunc) == ntrunc
+            terms += bool(jterm)
+            truncs += bool(jtrunc) and not bool(jterm)
+            if bool(jterm) or bool(jtrunc):
+                key, sub = jax.random.split(key)
+                state, jobs = jenv.reset(sub)
+        assert terms >= 3 and truncs >= 3, (terms, truncs)
+
+    def test_reset_distribution_excludes_goal(self):
+        jenv = make_jax("GridWorld-v0", size=3)
+        for i in range(32):
+            state, obs = jenv.reset(jax.random.PRNGKey(i))
+            assert not bool(np.all(np.asarray(state.pos) == 2)), i
+            np.testing.assert_array_equal(np.asarray(obs),
+                                          np.asarray(state.pos))
+        # same key ⇒ same start, the reproducibility half
+        a = np.asarray(jenv.reset(jax.random.PRNGKey(0))[1])
+        b = np.asarray(jenv.reset(jax.random.PRNGKey(0))[1])
+        np.testing.assert_array_equal(a, b)
+
+    def test_goal_pays_exactly_once(self):
+        from relayrl_tpu.envs.jax.gridworld import GridWorldState
+
+        jenv = make_jax("GridWorld-v0", size=3, max_steps=20)
+        step = jax.jit(jenv.step)
+        # one cell left of the goal: move right -> terminal, reward 1.0
+        state = GridWorldState(pos=jnp.array([2, 1], jnp.int32),
+                               t=jnp.int32(0))
+        state, obs, rew, term, trunc = step(state, jnp.int32(3))
+        assert float(rew) == 1.0 and bool(term) and not bool(trunc)
+        np.testing.assert_array_equal(np.asarray(obs), [2, 2])
+        # stepping at a border clamps and pays nothing
+        state = GridWorldState(pos=jnp.array([0, 0], jnp.int32),
+                               t=jnp.int32(0))
+        state, obs, rew, term, _ = step(state, jnp.int32(0))  # up at top
+        assert float(rew) == 0.0 and not bool(term)
+        np.testing.assert_array_equal(np.asarray(obs), [0, 0])
+
+
 class TestRegistry:
     def test_jax_registry_covers_builtins(self):
-        assert set(JAX_ENVS) == {"CartPole-v1", "Pendulum-v1", "Recall-v0"}
+        assert set(JAX_ENVS) == {"CartPole-v1", "Pendulum-v1", "Recall-v0",
+                                 "GridWorld-v0"}
 
     def test_list_envs_has_both_planes(self):
         known = list_envs()

@@ -58,7 +58,9 @@ class TestFiniteGuard:
                 columns={"o": np.zeros((2, 4), np.float32),
                          "a": np.zeros((2,), np.int32),
                          "r": np.array([0.0, rew], np.float32),
-                         "t": np.array([False, True])},
+                         "t": np.array([False, True]),
+                         "u": np.zeros((2,), np.uint8),
+                         "x": np.zeros((2,), np.uint8)},
                 aux={"v": np.zeros((2,), np.float32),
                      "logp_a": np.zeros((2,), np.float32)})
 
