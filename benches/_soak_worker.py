@@ -398,6 +398,7 @@ def anakin_host_loop(cfg: dict) -> list[dict]:
         # None → config "auto" → columnar frames (the anakin default);
         # bench_soak --per-record forces False for A/B rows.
         columnar_wire=cfg.get("columnar_wire"),
+        async_emit=cfg.get("async_emit"),
         **addr_overrides,
     )
     receipts: list[tuple[int, int]] = []
@@ -418,6 +419,9 @@ def anakin_host_loop(cfg: dict) -> list[dict]:
             unstack_s += stats["unstack_s"]
     except Exception as e:
         crashed = repr(e)
+    # Async-emit hosts: every dispatched window must reach the wire (and
+    # the episode ledgers) before the rows below read them.
+    agent.host.flush_emits()
     window_end_ns = time.monotonic_ns()
     drain_receipt_grace(agent.transport, receipts, has_ledger,
                         cfg.get("receipt_grace_s", 8.0))
@@ -442,6 +446,104 @@ def anakin_host_loop(cfg: dict) -> list[dict]:
     return rows
 
 
+def serving_client_loop(cfg: dict, agent_idx: int, out: dict,
+                        barrier: threading.Barrier):
+    """Thin-client mode (``"serving": true``): one RemoteActorClient per
+    thread — NO local params, NO model subscription; every action is a
+    request/response round-trip to the server-colocated InferenceService.
+    The row shape mirrors agent_loop's, plus the per-agent action-latency
+    summary (p50/p95/p99/max over request_for_action round-trips) and a
+    bounded latency sample so the coordinator can pool exact fleet
+    percentiles. Receipts are structurally empty with a zero-width
+    subscription window: thin clients hold no model, so the fan-out
+    accounting must not expect deliveries for them."""
+    import numpy as np
+
+    from relayrl_tpu.runtime.inference import RemoteActorClient
+
+    ident = f"soak-{cfg['worker_id']}-{agent_idx}"
+    addr_overrides = transport_addr_overrides(cfg)
+    client = RemoteActorClient(
+        config_path=cfg.get("config_path"),
+        seed=cfg["worker_id"] * 1000 + agent_idx,
+        server_type=cfg.get("server_type", "zmq"),
+        identity=ident,
+        serving_addr=cfg.get("serving_addr"),
+        **addr_overrides,
+    )
+    rng = np.random.default_rng(agent_idx)
+    obs_dim, ep_len = cfg["obs_dim"], cfg["episode_len"]
+    steps = episodes = 0
+    lats: list[float] = []  # per-action round-trip seconds
+    try:
+        barrier.wait(timeout=cfg["handshake_timeout_s"] + 30)
+    except threading.BrokenBarrierError:
+        pass
+    start_barrier_wait(cfg, ident, publish_ready=agent_idx == 0)
+    timeline: dict[int, int] = {}
+    window_start_ns = time.monotonic_ns()
+    deadline = time.time() + cfg["duration_s"]
+    crashed = None
+    try:
+        while time.time() < deadline:
+            obs = rng.standard_normal(obs_dim).astype(np.float32)
+            reward = 0.0
+            for _ in range(ep_len):
+                t0 = time.monotonic()
+                client.request_for_action(obs, reward=reward)
+                lats.append(time.monotonic() - t0)
+                obs = rng.standard_normal(obs_dim).astype(np.float32)
+                reward = 1.0
+                steps += 1
+                bucket = int(time.time())
+                timeline[bucket] = timeline.get(bucket, 0) + 1
+                if time.time() >= deadline:
+                    break
+            client.flag_last_action(reward, terminated=True)
+            episodes += 1
+    except Exception as e:
+        crashed = repr(e)
+    window_end_ns = time.monotonic_ns()
+    lats.sort()
+    from common import percentile_sorted
+
+    def pct(q: float) -> float | None:
+        got = percentile_sorted(lats, q)
+        return None if got is None else round(1000 * got, 3)
+
+    stamp = time.monotonic_ns()
+    row = {
+        "identity": ident,
+        "steps": steps,
+        "episodes": episodes,
+        "final_version": client.model_version,
+        "receipts": [],
+        "sub_ts": stamp,  # zero-width window: no model subscription
+        "window_start_ns": window_start_ns,
+        "window_end_ns": window_end_ns,
+        "timeline": {str(k): v for k, v in timeline.items()},
+        "unsub_ts": stamp,
+        "crashed": crashed,
+        "latency_ms": {"count": len(lats), "p50": pct(0.50),
+                       "p95": pct(0.95), "p99": pct(0.99),
+                       "max": (round(1000 * lats[-1], 3) if lats
+                               else None)},
+        # Bounded evenly-strided sample of the SORTED latencies (always
+        # including the last element — a stride that misses index len-1
+        # would systematically underreport the pooled max/p99): the
+        # coordinator pools these for fleet-level percentiles without
+        # shipping every measurement.
+        "lat_sample_ms": [round(1000 * lats[i], 3)
+                          for i in sorted(set(
+                              list(range(0, len(lats),
+                                         max(1, len(lats) // 256)))
+                              + ([len(lats) - 1] if lats else [])))],
+    }
+    chaos_finish(client, row, cfg)
+    out[agent_idx] = row
+    client.disable_agent()
+
+
 def main():
     import faulthandler
 
@@ -450,6 +552,31 @@ def main():
     cfg = json.loads(sys.argv[1])
     os.environ["JAX_PLATFORMS"] = "cpu"
     chaos_setup(cfg)
+
+    if cfg.get("serving"):
+        out: dict = {}
+        barrier = threading.Barrier(cfg["agents_per_proc"])
+        threads = [
+            threading.Thread(target=serving_client_loop,
+                             args=(cfg, i, out, barrier), daemon=True)
+            for i in range(cfg["agents_per_proc"])
+        ]
+        for t in threads:
+            t.start()
+        barrier_s = cfg.get("go_timeout_s", 360.0) if cfg.get(
+            "start_barrier") else 0.0
+        for t in threads:
+            t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"]
+                   + barrier_s + 120)
+        result = {"worker_id": cfg["worker_id"],
+                  "agents": list(out.values())}
+        if cfg.get("chaos_telemetry"):
+            from relayrl_tpu import telemetry
+
+            result["telemetry"] = telemetry.get_registry().snapshot()
+        with open(cfg["result_path"], "w") as f:
+            json.dump(result, f)
+        return
 
     if cfg.get("anakin") or cfg.get("vector"):
         rows = (anakin_host_loop(cfg) if cfg.get("anakin")

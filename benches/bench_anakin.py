@@ -82,6 +82,7 @@ def run_vector_baseline(lanes: int, min_steps: int = 4000,
 
 
 def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
+               async_emit: bool = False,
                min_steps: int = 20000, min_wall_s: float = 2.0) -> dict:
     """Fused rollout at (lanes, unroll, wire): the full
     dispatch / encode / ingest split per row —
@@ -99,9 +100,11 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
     host = AnakinActorHost(_bundle(), "CartPole-v1", num_envs=lanes,
                            unroll_length=unroll,
                            columnar_wire=(wire == "columnar"),
+                           async_emit=async_emit,
                            on_send=lambda lane, p: sink.append(p),
                            seed=0)
     host.rollout()  # warmup + compile
+    host.flush_emits()
     sink.clear()
     total = windows = 0
     dispatch_s = host_s = 0.0
@@ -111,8 +114,15 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
         total += stats["steps"]
         windows += 1
         dispatch_s += stats["dispatch_s"]
+        # async_emit: this is the hand-off/backpressure wait the rollout
+        # thread pays — exactly the host cost the off-thread emitter is
+        # supposed to take off this thread (the encode itself runs on
+        # the emitter core and is covered by the wall clock via
+        # flush_emits below).
         host_s += stats["unstack_s"]
+    host.flush_emits()  # every produced window reaches the wire sink
     wall = time.perf_counter() - t0
+    host.close()
 
     # Ingest side: decode everything the run produced, the way the
     # server's staging loop would.
@@ -144,6 +154,7 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
     host_key = "encode" if wire == "columnar" else "unstack"
     return {
         "lanes": lanes, "unroll_length": unroll, "wire": wire,
+        "emit": "async" if async_emit else "sync",
         "windows": windows, "env_steps_total": total,
         "rollout_steps_per_sec": round(total / dispatch_s, 1),
         "e2e_steps_per_sec": round(total / wall, 1),
@@ -179,11 +190,16 @@ def main():
 
     best = None
     e2e_by_cell: dict[tuple, dict[str, float]] = {}
+    # The emitter-shave A/B (ROADMAP item 1 leftover): columnar cells run
+    # twice — sync emit (encode on the rollout thread) vs async emit
+    # (dedicated emitter thread, overlapping the next dispatch). The
+    # records wire keeps its single sync row for the wire-form A/B.
+    variants = [("columnar", False), ("columnar", True), ("records", False)]
     for lanes in lanes_grid:
         for unroll in unroll_grid:
-            for wire in ("columnar", "records"):
+            for wire, async_emit in variants:
                 row = run_anakin(
-                    lanes, unroll, wire=wire,
+                    lanes, unroll, wire=wire, async_emit=async_emit,
                     min_steps=2000 if is_quick else 20000,
                     min_wall_s=0.5 if is_quick else 2.0)
                 row["speedup_rollout_vs_vector"] = round(
@@ -191,12 +207,14 @@ def main():
                 row["speedup_e2e_vs_vector"] = round(
                     row["e2e_steps_per_sec"] / vector_rates[lanes], 1)
                 emit("anakin_fused_rollout",
-                     {"lanes": lanes, "unroll": unroll, "wire": wire},
+                     {"lanes": lanes, "unroll": unroll, "wire": wire,
+                      "emit": row["emit"]},
                      row["e2e_steps_per_sec"], "env_steps/s")
                 rows.append({"bench": "anakin_fused_rollout", **row})
-                e2e_by_cell.setdefault((lanes, unroll), {})[wire] = \
+                cell = e2e_by_cell.setdefault((lanes, unroll), {})
+                cell[f"{wire}_async" if async_emit else wire] = \
                     row["e2e_steps_per_sec"]
-                if wire == "columnar" and (
+                if wire == "columnar" and not async_emit and (
                         best is None or (row["rollout_steps_per_sec"]
                                          > best["rollout_steps_per_sec"])):
                     best = row
@@ -227,6 +245,14 @@ def main():
             f"{lanes}x{unroll}": round(cell["columnar"] / cell["records"], 2)
             for (lanes, unroll), cell in sorted(e2e_by_cell.items())
             if "records" in cell and cell["records"]},
+        # The emitter shave (ISSUE 10 satellite): async-emit e2e vs sync
+        # at the same (lanes, unroll) on the columnar wire — >1 means
+        # the off-thread encode bought real wall clock.
+        "speedup_async_emit_vs_sync": {
+            f"{lanes}x{unroll}": round(
+                cell["columnar_async"] / cell["columnar"], 2)
+            for (lanes, unroll), cell in sorted(e2e_by_cell.items())
+            if cell.get("columnar_async") and cell.get("columnar")},
         "note": ("columnar wire (ISSUE 9): whole rollout segments ship "
                  "as contiguous frames — the per-step record assembly + "
                  "per-record msgpack that bounded e2e is gone; every row "

@@ -99,7 +99,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              transport: str = "zmq", vector: bool = False,
              anakin: bool = False, unroll_length: int = 32,
              jax_env: str = "CartPole-v1",
-             columnar_wire: bool | None = None) -> dict:
+             columnar_wire: bool | None = None,
+             serving: bool = False, max_batch: int | None = None,
+             batch_timeout_ms: float = 5.0) -> dict:
     """``vector=True`` runs the fleet as vector actor hosts: each worker
     process is ONE VectorAgent stepping ``agents_per_proc`` logical
     agents through a single batched jitted policy dispatch (the
@@ -129,6 +131,33 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
     addrs, worker_addrs = _transport_addrs(transport)
+    config_path = None
+    if serving:
+        # Thin-client topology (ISSUE 10): the server hosts the
+        # InferenceService (serving.enabled) and every "actor" is a
+        # RemoteActorClient — no local params, no model subscription.
+        # One shared config file carries the serving knobs to both ends.
+        if max_batch is None:
+            max_batch = max(2, min(32, n_actors))
+        config_path = os.path.join(scratch, "serving_config.json")
+        with open(config_path, "w") as f:
+            json.dump({"serving": {
+                "enabled": True, "max_batch": int(max_batch),
+                "batch_timeout_ms": float(batch_timeout_ms),
+            }}, f)
+        if transport != "grpc":
+            # zmq fleets (and native passthrough) need the dedicated
+            # ROUTER action plane; grpc rides the in-band GetActions.
+            serving_addr = f"tcp://127.0.0.1:{free_port()}"
+            addrs["serving_addr"] = serving_addr
+            worker_addrs["serving_addr"] = serving_addr
+        else:
+            # In-band GetActions lives on the pure-grpcio server only
+            # (the native C++ gRPC core does not speak the serving RPC).
+            addrs["native_grpc"] = False
+        addrs["config_path"] = config_path
+        worker_addrs["serving"] = True
+        worker_addrs["config_path"] = config_path
     # IMPALA is the async-fleet north star (BASELINE.md "256 IMPALA
     # actors"): staleness-corrected, so a big fleet on old versions is the
     # intended regime, not an edge case.
@@ -302,7 +331,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                  if v in pub_times and _counts(a, pub_times[v])]
     expected = sum(1 for _, pub_ns in publishes for a in agents
                    if _counts(a, pub_ns))
-    mode = "anakin" if anakin else "vector" if vector else "process"
+    mode = ("serving" if serving else "anakin" if anakin
+            else "vector" if vector else "process")
     result = {
         "bench": (f"soak_multi_actor_{transport}"
                   + ("" if mode == "process" else f"_{mode}")),
@@ -310,6 +340,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                    "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
                    "mode": mode,
+                   **({"max_batch": max_batch,
+                       "batch_timeout_ms": batch_timeout_ms}
+                      if serving else {}),
                    **({"unroll_length": unroll_length, "jax_env": jax_env,
                        "obs_dim": obs_dim, "act_dim": act_dim}
                       if anakin else {}),
@@ -361,8 +394,61 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     from relayrl_tpu import telemetry
 
     result["telemetry"] = telemetry.get_registry().snapshot()
+    if serving:
+        result["serving"] = _serving_row_block(server, agents,
+                                               result["telemetry"])
     server.disable_server()
     return result
+
+
+def _serving_row_block(server, agents: list[dict], snap: dict) -> dict:
+    """The serving-plane SLO block embedded per --serving row: fleet
+    action-latency percentiles (pooled from the workers' sorted-sample
+    digests), batch occupancy, close-reason split, and the overload
+    counters — the evidence the ISSUE 10 acceptance reads."""
+    from common import percentile_sorted
+
+    samples = sorted(s for a in agents
+                     for s in (a.get("lat_sample_ms") or []))
+
+    def spct(q: float):
+        got = percentile_sorted(samples, q)
+        return None if got is None else round(got, 3)
+
+    def counter(name: str, labels: dict | None = None) -> float:
+        total = 0.0
+        for m in snap["metrics"]:
+            if m["name"] != name:
+                continue
+            got = m.get("labels") or {}
+            if labels is not None and any(got.get(k) != v
+                                          for k, v in labels.items()):
+                continue
+            total += m.get("value") or 0
+        return total
+
+    occ = next((m for m in snap["metrics"]
+                if m["name"] == "relayrl_serving_batch_occupancy"), None)
+    per_agent_p99 = [a["latency_ms"]["p99"] for a in agents
+                     if a.get("latency_ms", {}).get("p99") is not None]
+    return {
+        **server.inference.accounting(),
+        "action_latency_ms": {
+            "p50": spct(0.50), "p95": spct(0.95), "p99": spct(0.99),
+            "max": samples[-1] if samples else None},
+        "per_agent_p99_ms_max": max(per_agent_p99, default=None),
+        "requests_total": counter("relayrl_serving_requests_total"),
+        "rejected_total": counter("relayrl_serving_rejected_total"),
+        "request_errors_total": counter(
+            "relayrl_serving_request_errors_total"),
+        "close_reasons": {
+            "size": counter("relayrl_serving_batches_total",
+                            {"reason": "size"}),
+            "deadline": counter("relayrl_serving_batches_total",
+                                {"reason": "deadline"})},
+        "batch_occupancy_mean": (round(occ["sum"] / occ["count"], 2)
+                                 if occ and occ.get("count") else None),
+    }
 
 
 def _grpc_raw_request(stream_id: int, grpc_body: bytes) -> bytes:
@@ -1421,6 +1507,7 @@ def main():
     quick = "--quick" in sys.argv
     vector = "--vector" in sys.argv
     anakin = "--anakin" in sys.argv
+    serving = "--serving" in sys.argv
     # --anakin ships columnar trajectory frames by DEFAULT (ISSUE 9,
     # actor.columnar_wire "auto"); --per-record forces the ActionRecord
     # wire for A/B rows against the same fused engine.
@@ -1496,14 +1583,18 @@ def main():
                          agents_per_proc=min(16, n) if batched else min(8, n),
                          duration_s=10.0 if quick else 20.0,
                          transport=transport, vector=vector, anakin=anakin,
-                         columnar_wire=columnar_wire)
+                         columnar_wire=columnar_wire, serving=serving)
             print(json.dumps(r))
             assert r["server_stats"]["dropped"] == 0
             assert r["agents_crashed"] == 0
             assert r["agents_completed"] == n, "fleet silently shrank"
+            if serving:
+                assert (r["serving"]["rejected_total"] or 0) == 0, \
+                    "thin clients were overload-nacked in a steady soak"
             rows.append(r)
         if "--write" in sys.argv:
-            suffix = "_anakin" if anakin else "_vector" if vector else ""
+            suffix = ("_serving" if serving else "_anakin" if anakin
+                      else "_vector" if vector else "")
             _write_results(
                 f"soak_scaling_{transport}{suffix}.json", rows)
         return
@@ -1517,6 +1608,17 @@ def main():
         return
     if "--blast" in sys.argv:
         run_blast_matrix(quick)
+        return
+    if serving:
+        # Thin-client topology row (ISSUE 10): 64 RemoteActorClients
+        # (8 procs x 8 threads; quick: 8 as 2x4) against the ONE
+        # server-colocated InferenceService — the "millions of users"
+        # shape in miniature, with the latency SLO block embedded.
+        result = run_soak(n_actors=8 if quick else 64,
+                          agents_per_proc=4 if quick else 8,
+                          duration_s=8.0 if quick else 30.0,
+                          transport=transport, serving=True)
+        _finish(result, f"soak64_{transport}_serving.json")
         return
     if anakin:
         # The fused-rollout e2e row: 64 logical agents as 4 processes x

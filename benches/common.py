@@ -81,6 +81,17 @@ def emit(bench: str, config: dict, value: float, unit: str) -> None:
                       "value": round(value, 6), "unit": unit}), flush=True)
 
 
+def percentile_sorted(values, q: float):
+    """Index-quantile over an ALREADY-SORTED sequence:
+    ``values[min(len-1, int(q*len))]``, None when empty. The one
+    convention the serving-latency rows use on both ends (per-agent
+    digests in _soak_worker, fleet pooling in bench_soak) — keep it
+    here so the two can never drift to different rank rules."""
+    if not values:
+        return None
+    return values[min(len(values) - 1, int(q * len(values)))]
+
+
 def load_results(path) -> list:
     """Load a committed ``benches/results/*.json`` file as a list of rows.
 

@@ -72,6 +72,26 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
             exporter = telemetry.serve(port=0)
             print(f"[actor {tag}] telemetry at {exporter.url}", flush=True)
 
+    if host_mode == "remote":
+        # Thin-client topology (actor.host_mode="remote"): NO local
+        # params, NO model subscription — every action is a round-trip
+        # to the server-colocated InferenceService (the driver started
+        # the server with serving=True). The trajectory plane is the
+        # standard one, so run_gym_loop drives it unchanged.
+        from relayrl_tpu.runtime.inference import RemoteActorClient
+
+        client = RemoteActorClient(server_type=server_type, seed=idx,
+                                   identity=f"remote-{idx}",
+                                   **agent_addrs)
+        _serve_actor_telemetry(f"{idx} remote")
+        env = make(_ENV_IDS[env_id])
+        t0 = time.time()
+        returns = run_gym_loop(client, env, episodes=episodes,
+                               max_steps=max_steps)
+        train_s = time.time() - t0
+        queue.put((idx, returns, client.model_version, [], train_s))
+        client.disable_agent()
+        return
     if host_mode == "anakin":
         # Fused on-device topology (actor.host_mode="anakin"): the env
         # runs as pure JAX inside the policy dispatch; each rollout()
@@ -159,12 +179,15 @@ def main():
                          "config actor.num_envs when actor.host_mode is "
                          "\"vector\" or \"anakin\", else 1 (process mode)")
     ap.add_argument("--host-mode", default=None,
-                    choices=["process", "vector", "anakin"],
+                    choices=["process", "vector", "anakin", "remote"],
                     help="actor topology override: \"anakin\" fuses env + "
                          "policy into one on-device lax.scan dispatch per "
                          "[num-envs, unroll-length] window "
                          "(runtime/anakin.py; the env must be in the JAX "
-                         "registry, envs.list_envs()['jax'])")
+                         "registry, envs.list_envs()['jax']); \"remote\" "
+                         "runs thin clients against the server-colocated "
+                         "batched InferenceService (runtime/inference.py "
+                         "— no local params, no model subscription)")
     ap.add_argument("--unroll-length", type=int, default=None, metavar="U",
                     help="anakin mode: env steps per lane per fused "
                          "dispatch (default: config actor.unroll_length)")
@@ -251,10 +274,20 @@ def main():
     if host_mode != "process" and args.greedy_eval > 0:
         print(f"[driver] --greedy-eval ignored in {host_mode} mode (no "
               "batched greedy path)", flush=True)
+    if host_mode == "remote":
+        # Thin clients need the serving plane up server-side; the zmq
+        # (and native-passthrough) action channel gets its own port.
+        if args.transport != "grpc":
+            serving_addr = f"tcp://127.0.0.1:{free_port()}"
+            server_addrs["serving_addr"] = serving_addr
+            agent_addrs["serving_addr"] = serving_addr
+        else:
+            server_addrs["native_grpc"] = False  # GetActions is grpcio-only
 
     server = TrainingServer(
         args.algo, obs_dim=obs_dim, act_dim=act_dim,
         server_type=args.transport, env_dir=".",
+        serving=(True if host_mode == "remote" else None),
         tensorboard=args.tensorboard, hyperparams=hp, **server_addrs)
 
     ctx = mp.get_context("spawn")

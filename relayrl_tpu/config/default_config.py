@@ -168,7 +168,11 @@ DEFAULT_CONFIG: dict = {
         # "anakin" = fused on-device rollout (runtime/anakin.py): the env
         # itself runs as pure JAX (actor.jax_env) and one
         # jit(vmap(lax.scan)) dispatch produces num_envs x unroll_length
-        # env steps — the fastest tier, for envs in the JAX registry.
+        # env steps — the fastest tier, for envs in the JAX registry;
+        # "remote" = thin client (runtime/inference.py
+        # RemoteActorClient): no local params or model subscription —
+        # actions come from the serving plane (serving.enabled on the
+        # training server), the "millions of users" topology.
         # examples/train_distributed.py reads it to pick the actor
         # topology (--num-envs overrides); benches/bench_soak.py's
         # --vector/--anakin flags are the bench-plane equivalents.
@@ -184,6 +188,13 @@ DEFAULT_CONFIG: dict = {
         # On-device env id for the anakin tier, resolved through the JAX
         # env registry (envs/jax/__init__.py; see envs.list_envs()).
         "jax_env": "CartPole-v1",
+        # Anakin host shave (ROADMAP item 1): move the frame
+        # encode/unstack + send onto a dedicated emitter thread so it
+        # overlaps the next window's device dispatch (bounded depth-2
+        # hand-off — a slow wire backpressures the rollout loop).
+        # Worth it when host_share_of_wall is high and a spare core
+        # exists; single-core hosts should leave it off.
+        "async_emit": False,
         # Trajectory wire form. "auto" (the default) picks per tier:
         # anakin hosts ship whole rollout segments as contiguous columnar
         # frames (types/columnar.py — decoded server-side straight into
@@ -324,6 +335,41 @@ DEFAULT_CONFIG: dict = {
         "agent_share": 0.5,
         "nack_retry_after_s": 1.0,
     },
+    # -- disaggregated batched-inference serving plane
+    #    (runtime/inference.py, docs/architecture.md "serving tier") --
+    "serving": {
+        # false = no InferenceService is built: the training server
+        # serves no action plane and thin clients cannot connect.
+        "enabled": False,
+        # Batch close triggers (TorchBeast's dynamic-batching server):
+        # a batch closes at max_batch requests OR batch_timeout_ms after
+        # its first request enqueued, whichever fires first. Bigger
+        # batches amortize the dispatch; the timeout bounds worst-case
+        # action latency (see docs/operations.md sizing note).
+        "max_batch": 16,
+        "batch_timeout_ms": 5.0,
+        # Compiled batch shapes (pick_bucket): null derives powers of
+        # two up to max_batch. Short batches pad to the nearest bucket
+        # (pad rows are sliced off; vmap rows are independent).
+        "buckets": None,
+        # Requests allowed to wait in the batching queue; beyond it new
+        # arrivals nack NACK_OVERLOADED with retry_after_s — bounded
+        # queue = bounded worst-case latency, and an inference flood
+        # cannot starve the learner's ingest plane.
+        "queue_limit": 1024,
+        "retry_after_s": 0.05,
+        # Ghost-work guard: a queued request older than this was
+        # abandoned by its timed-out client (whose retry is already
+        # queued behind it) — it is nacked unserved at batch-gather
+        # time instead of double-serving every retry round under
+        # backlog. Keep it above request_timeout_s. 0 disables.
+        "stale_after_s": 5.0,
+        # Thin-client budgets: per-attempt wire timeout, and the total
+        # per-action budget (covers a service restart window before the
+        # env loop gives up).
+        "request_timeout_s": 2.0,
+        "infer_deadline_s": 60.0,
+    },
     # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
     "telemetry": {
         # false = the process-global registry stays a NullRegistry: every
@@ -350,6 +396,10 @@ DEFAULT_CONFIG: dict = {
         "training_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "50051"},
         "trajectory_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7776"},
         "agent_listener": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7777"},
+        # Serving-plane action channel (zmq ROUTER/DEALER; also the
+        # native fleets' passthrough plane — grpc fleets ride the
+        # in-band GetActions RPC on training_server instead).
+        "inference_server": {"prefix": "tcp://", "host": "127.0.0.1", "port": "7778"},
     },
     "training_tensorboard": {
         "launch_tb_on_startup": False,

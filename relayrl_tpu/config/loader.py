@@ -81,6 +81,7 @@ _FALLBACK_ENDPOINTS = {
     "training_server": Endpoint(port="50051"),
     "trajectory_server": Endpoint(port="7776"),
     "agent_listener": Endpoint(port="7777"),
+    "inference_server": Endpoint(port="7778"),
 }
 
 
@@ -155,7 +156,7 @@ class ConfigLoader:
         # Sections whose key set IS the contract (algorithms excluded:
         # hyperparam overrides are open-ended by design).
         for section in ("actor", "transport", "learner", "telemetry",
-                        "guardrails", "model_paths", "server",
+                        "guardrails", "serving", "model_paths", "server",
                         "training_tensorboard"):
             defaults = DEFAULT_CONFIG.get(section)
             loaded = self._section(section)
@@ -210,6 +211,12 @@ class ConfigLoader:
     def get_agent_listener(self) -> Endpoint:
         return self._endpoint("agent_listener")
 
+    def get_inference_server(self) -> Endpoint:
+        """Serving-plane action channel (zmq ROUTER/DEALER — the thin
+        clients' request/response endpoint; grpc fleets use the in-band
+        GetActions RPC on training_server instead)."""
+        return self._endpoint("inference_server")
+
     def get_tb_params(self) -> dict[str, Any]:
         params = dict(DEFAULT_CONFIG["training_tensorboard"])
         params.update(self._section("training_tensorboard"))
@@ -259,7 +266,8 @@ class ConfigLoader:
             params["num_envs"] = max(1, int(params.get("num_envs", 1)))
         except (TypeError, ValueError):
             params["num_envs"] = 1
-        if params.get("host_mode") not in ("process", "vector", "anakin"):
+        if params.get("host_mode") not in ("process", "vector", "anakin",
+                                           "remote"):
             params["host_mode"] = "process"
         try:
             params["unroll_length"] = max(1, int(
@@ -269,6 +277,7 @@ class ConfigLoader:
         jax_env = params.get("jax_env")
         params["jax_env"] = (str(jax_env) if jax_env
                              else DEFAULT_CONFIG["actor"]["jax_env"])
+        params["async_emit"] = bool(params.get("async_emit", False))
         # columnar_wire: "auto" resolves per tier (anakin -> columnar
         # frames, host-bound tiers -> per-record); booleans force it.
         cw = params.get("columnar_wire", "auto")
@@ -385,6 +394,46 @@ class ConfigLoader:
         if params.get("shed_policy") not in ("drop_oldest", "nack"):
             params["shed_policy"] = "drop_oldest"
         params["loss_key"] = str(params.get("loss_key") or "auto")
+        return params
+
+    def get_serving_params(self) -> dict[str, Any]:
+        """Disaggregated batched-inference knobs (``serving.*`` — see
+        docs/operations.md "Serving plane"), defaults merged under user
+        overrides; malformed values degrade to the built-ins (the
+        serving plane must not crash the training server hosting it)."""
+        params = dict(DEFAULT_CONFIG["serving"])
+        params.update(self._section("serving"))
+        params["enabled"] = bool(params.get("enabled", False))
+        for key, default, lo in (("max_batch", 16, 1),
+                                 ("queue_limit", 1024, 1)):
+            try:
+                params[key] = max(lo, int(params.get(key, default)))
+            except (TypeError, ValueError):
+                params[key] = default
+        for key, default in (("batch_timeout_ms", 5.0),
+                             ("retry_after_s", 0.05),
+                             ("stale_after_s", 5.0),
+                             ("request_timeout_s", 2.0),
+                             ("infer_deadline_s", 60.0)):
+            try:
+                value = params.get(key, default)
+                params[key] = max(0.0, float(default if value is None
+                                             else value))
+            except (TypeError, ValueError):
+                params[key] = default
+        buckets = params.get("buckets")
+        if isinstance(buckets, (list, tuple)) and buckets:
+            try:
+                clean = sorted({max(1, int(b)) for b in buckets})
+                # The largest bucket must cover max_batch or full-size
+                # closes could never dispatch without a clamp.
+                if clean[-1] < params["max_batch"]:
+                    clean.append(params["max_batch"])
+                params["buckets"] = clean
+            except (TypeError, ValueError):
+                params["buckets"] = None
+        else:
+            params["buckets"] = None
         return params
 
     def get_telemetry_params(self) -> dict[str, Any]:

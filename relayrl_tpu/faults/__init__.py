@@ -14,6 +14,9 @@ Hook sites (see plan.KNOWN_SITES and docs/operations.md):
 
 * ``agent.send``     — trajectory envelopes leaving an agent transport
 * ``agent.model``    — model frames arriving at an agent transport
+* ``agent.infer``    — serving-plane action requests leaving a thin
+  client (RemoteActorClient; drop → timeout-retry, corrupt → service
+  decode guard → error reply → retry)
 * ``server.publish`` — model frames leaving the server transport
 * ``server.ingest``  — trajectory envelopes arriving at the server
 * ``actor.step``     — env-loop steps (kill_process drills)

@@ -54,8 +54,12 @@ FAULT_OPS = ("drop", "delay", "duplicate", "reorder", "corrupt",
 
 #: Hook sites the runtime/transports expose (free-form sites are legal —
 #: a rule naming a site nobody hooks simply never fires).
-KNOWN_SITES = ("agent.send", "agent.model", "server.publish",
-               "server.ingest", "actor.step")
+#: ``agent.infer`` is the serving plane's request/response channel
+#: (runtime/inference.RemoteActorClient): drop surfaces as a timeout →
+#: retry, corrupt dies in the service's decode guard → error reply →
+#: retry, delay stalls the attempt — the thin-client chaos drill.
+KNOWN_SITES = ("agent.send", "agent.model", "agent.infer",
+               "server.publish", "server.ingest", "actor.step")
 
 
 def _u01(seed: int, site: str, op_index: int, rule_index: int,

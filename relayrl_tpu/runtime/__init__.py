@@ -1,12 +1,13 @@
 """Runtime processes: actors, vector actor hosts, local runner, training
-server."""
+server, and the batched-inference serving plane."""
 
 from relayrl_tpu.runtime.application import ApplicationAbstract
 from relayrl_tpu.runtime.policy_actor import PolicyActor
 from relayrl_tpu.runtime.local_runner import LocalRunner
 
 __all__ = ["ApplicationAbstract", "PolicyActor", "LocalRunner",
-           "VectorActorHost", "VectorAgent"]
+           "VectorActorHost", "VectorAgent", "InferenceService",
+           "RemoteActorClient", "StandaloneInferenceHost"]
 
 
 def __getattr__(name):
@@ -20,4 +21,9 @@ def __getattr__(name):
         from relayrl_tpu.runtime import vector_actor as _va
 
         return _va.VectorActorHost
+    if name in ("InferenceService", "RemoteActorClient",
+                "StandaloneInferenceHost"):
+        from relayrl_tpu.runtime import inference as _inf
+
+        return getattr(_inf, name)
     raise AttributeError(f"module 'relayrl_tpu.runtime' has no attribute {name!r}")

@@ -316,6 +316,7 @@ class VectorAgent:
         jax_env: str | None = None,
         unroll_length: int | None = None,
         columnar_wire: bool | None = None,
+        async_emit: bool | None = None,
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
@@ -346,6 +347,10 @@ class VectorAgent:
         self.columnar_wire = (self.host_mode == "anakin"
                               if not isinstance(columnar_wire, bool)
                               else bool(columnar_wire))
+        # actor.async_emit: off-thread frame emitter on the anakin tier
+        # (the ROADMAP item 1 host shave); inert on the vector tier.
+        self.async_emit = bool(actor_params.get("async_emit", False)
+                               if async_emit is None else async_emit)
         self.server_type = server_type
         self._addr_overrides = addr_overrides
         self._identity = identity
@@ -387,6 +392,10 @@ class VectorAgent:
         self.agent_ids = [f"{self.transport.identity}.lane{k}"
                           for k in range(self.num_envs)]
         _bind_spool_impl(self, self._identity or "vector")
+        if self.host is not None and hasattr(self.host, "start_emitter"):
+            # Re-enable after a disable: the emitter thread was closed
+            # with the transport; a reused host needs it back.
+            self.host.start_emitter()
         if self.host is None:
             if self.host_mode == "anakin":
                 from relayrl_tpu.runtime.anakin import AnakinActorHost
@@ -400,6 +409,7 @@ class VectorAgent:
                     on_send=self._send_lane,
                     seed=self._seed,
                     columnar_wire=self.columnar_wire,
+                    async_emit=self.async_emit,
                 )
             else:
                 self.host = VectorActorHost(
@@ -430,6 +440,12 @@ class VectorAgent:
     def disable_agent(self) -> None:
         if not self.active:
             return
+        if hasattr(self.host, "close"):
+            # Async-emit anakin hosts: drain queued windows onto the
+            # wire, then stop the emitter thread — a disable/enable
+            # cycle must not leak one thread (and one pinned host) per
+            # cycle; enable_agent restarts it via start_emitter.
+            self.host.close()
         if self.spool is not None:
             self.spool.send_fn = None  # see Agent.disable_agent
         self.transport.close()

@@ -126,6 +126,7 @@ class AnakinActorHost:
         validate: bool = True,
         rng_keys=None,
         columnar_wire: bool = True,
+        async_emit: bool = False,
         **env_kwargs,
     ):
         if num_envs < 1:
@@ -208,6 +209,26 @@ class AnakinActorHost:
         self.episode_returns: list[list[float]] = [
             [] for _ in range(self.num_envs)]
 
+        # Off-thread emitter (ROADMAP item 1's host shave): with
+        # host_share_of_wall at 0.43-0.55, frame encode is ~coequal with
+        # device dispatch — ``async_emit=True`` (config
+        # ``actor.async_emit``) moves the encode/unstack + send off the
+        # rollout thread onto a dedicated emitter, overlapping it with
+        # the NEXT window's device compute. The hand-off queue is
+        # bounded (depth 2): a slow wire backpressures the rollout loop
+        # instead of ballooning host memory, and one emitter thread
+        # keeps per-lane trajectory order intact. ``flush_emits`` drains
+        # it (drivers call it before reading episode_returns or tearing
+        # down).
+        self.async_emit = bool(async_emit)
+        self._emit_cond = threading.Condition()
+        self._emit_queue: list[dict] = []
+        self._emit_pending = 0
+        self._emit_error: Exception | None = None
+        self._emit_stop = False
+        self._emit_thread: threading.Thread | None = None
+        self.start_emitter()
+
         from relayrl_tpu import telemetry
 
         reg = telemetry.get_registry()
@@ -259,7 +280,21 @@ class AnakinActorHost:
         window = jax.block_until_ready(window)
         t1 = time.monotonic()
         host_window = jax.device_get(window)
-        if self.columnar_wire:
+        if self.async_emit:
+            if self._emit_error is not None:
+                err, self._emit_error = self._emit_error, None
+                raise RuntimeError(
+                    f"anakin emitter thread failed: {err!r}") from err
+            with self._emit_cond:
+                # Bounded hand-off: past depth 2 the rollout thread
+                # waits — backpressure, not unbounded window buffering.
+                while self._emit_pending >= 2 and not self._emit_stop:
+                    self._emit_cond.wait(0.5)
+                self._emit_queue.append(host_window)
+                self._emit_pending += 1
+                self._emit_cond.notify_all()
+            episodes = 0  # completed counts surface via episode_returns
+        elif self.columnar_wire:
             episodes = self._emit_columnar(host_window)
         else:
             episodes = self._unstack(host_window)
@@ -268,14 +303,92 @@ class AnakinActorHost:
         self._m_steps.inc(steps)
         self._m_dispatches.inc()
         self._m_dispatch_s.observe(t1 - t0)
-        if self.columnar_wire:
-            self._m_encode_s.observe(t2 - t1)
-        else:
-            self._m_unstack_s.observe(t2 - t1)
+        if not self.async_emit:
+            if self.columnar_wire:
+                self._m_encode_s.observe(t2 - t1)
+            else:
+                self._m_unstack_s.observe(t2 - t1)
         return {"steps": steps, "episodes": episodes,
                 "dispatch_s": t1 - t0, "unstack_s": t2 - t1,
                 "encode_s": t2 - t1 if self.columnar_wire else 0.0,
                 "wire": "columnar" if self.columnar_wire else "records"}
+
+    # -- off-thread emitter (async_emit=True) --
+    def start_emitter(self) -> None:
+        """(Re)start the async emitter thread — a no-op when
+        ``async_emit`` is off or it is already running. The re-enable
+        half of :meth:`close`: an agent cycling disable/enable must get
+        a live emitter back, or the depth-2 hand-off would deadlock on
+        the third window."""
+        if not self.async_emit or self._emit_thread is not None:
+            return
+        self._emit_stop = False
+        self._emit_thread = threading.Thread(
+            target=self._emit_loop, name="anakin-emitter", daemon=True)
+        self._emit_thread.start()
+
+    def _emit_loop(self) -> None:
+        while True:
+            with self._emit_cond:
+                while not self._emit_queue and not self._emit_stop:
+                    self._emit_cond.wait(0.5)
+                if self._emit_stop and not self._emit_queue:
+                    return
+                w = self._emit_queue.pop(0)
+            t0 = time.monotonic()
+            try:
+                if self.columnar_wire:
+                    self._emit_columnar(w)
+                    self._m_encode_s.observe(time.monotonic() - t0)
+                else:
+                    self._unstack(w)
+                    self._m_unstack_s.observe(time.monotonic() - t0)
+            except Exception as e:
+                # Surfaced on the NEXT rollout() — the emitter must not
+                # die silently with windows still queuing behind it.
+                self._emit_error = e
+            finally:
+                with self._emit_cond:
+                    self._emit_pending -= 1
+                    self._emit_cond.notify_all()
+
+    def flush_emits(self, timeout_s: float = 30.0) -> bool:
+        """Drain the async emitter's hand-off queue (no-op when
+        ``async_emit`` is off): drivers call this before reading
+        ``episode_returns`` or tearing down, so every dispatched window
+        has reached the wire. True when fully drained in time. A
+        pending emit failure re-raises HERE too, not only on the next
+        rollout — otherwise an error on the FINAL window (no next
+        rollout coming) would silently lose it at teardown, where the
+        sync path would have raised."""
+        if not self.async_emit:
+            return True
+        deadline = time.monotonic() + timeout_s
+        drained = True
+        with self._emit_cond:
+            while self._emit_pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    drained = False
+                    break
+                self._emit_cond.wait(min(0.5, remaining))
+        if self._emit_error is not None:
+            err, self._emit_error = self._emit_error, None
+            raise RuntimeError(
+                f"anakin emitter thread failed: {err!r}") from err
+        return drained
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Stop the emitter thread after draining its queue (hosts
+        without ``async_emit`` have nothing to do)."""
+        if self._emit_thread is None:
+            return
+        self.flush_emits(timeout_s)
+        with self._emit_cond:
+            self._emit_stop = True
+            self._emit_cond.notify_all()
+        self._emit_thread.join(timeout=5)
+        self._emit_thread = None
 
     @staticmethod
     def _cat(chunks: list) -> np.ndarray:

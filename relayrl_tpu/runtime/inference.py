@@ -1,0 +1,947 @@
+"""Disaggregated batched-inference serving plane (ROADMAP item 2).
+
+Every actor tier so far holds its own policy replica and swaps full
+params — the right shape for rollout throughput, the wrong one for the
+"millions of users" serving scenario, where the fleet is wide, stateless,
+and latency-bound. TorchBeast (arXiv:1910.03552) showed the answer is a
+**dynamic-batching inference server**: accept observation requests, close
+a batch on a size-or-deadline trigger, run ONE batched policy step, and
+stream the actions back; Podracer's Sebulba split (arXiv:2104.06272)
+colocates that service with the learner devices so actors become
+near-stateless thin clients.
+
+This module is both halves:
+
+* :class:`InferenceService` — the latency-bounded dynamic-batching queue
+  plus ONE ``jit(vmap)`` policy dispatch per closed batch
+  (``make_batched_step`` — the exact composition every other actor tier
+  jits, so a served action is bit-identical to a locally computed one for
+  the same key). Batch shapes are bucketed to a small compiled set
+  (``pick_bucket`` over ``serving.buckets``) and padded rows are sliced
+  off before replies, so arbitrary occupancies never retrace. The service
+  always serves the latest fenced params version: params are read ONCE
+  per batch under the shared swap gate (``apply_bundle_swap`` — the same
+  attribute contract PolicyActor/VectorActorHost/AnakinActorHost share),
+  so a batch is single-model-version by construction even against a
+  racing swapper. Overload (queue at ``serving.queue_limit``) answers
+  with a typed ``NACK_OVERLOADED`` + retry-after instead of queueing
+  unboundedly — a flood of inference clients cannot starve the learner's
+  ingest plane.
+
+* :class:`RemoteActorClient` — the thin-client actor
+  (``actor.host_mode: "remote"``): no params, no model subscription, no
+  swap gate; just a request/response loop carrying its PRNG key (the
+  service splits it in-dispatch and returns the successor, so the
+  client's action stream IS a PolicyActor's for the same seed). The
+  trajectory plane — Trajectory assembly, spool/seq tagging, transport
+  envelopes — is byte-identical to a local actor's, so the learner's
+  ingest funnel cannot tell the tiers apart.
+
+Colocated mode: the TrainingServer feeds :meth:`install_params` from its
+publish path in-process — the service sees every published version with
+ZERO wire hops. Standalone mode (dedicated serving devices):
+:class:`StandaloneInferenceHost` subscribes over any agent transport like
+an actor would and hosts the same service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from relayrl_tpu.data.batching import pick_bucket
+from relayrl_tpu.transport.base import (
+    NACK_OK,
+    NACK_OVERLOADED,
+    NACK_UNAVAILABLE,
+)
+from relayrl_tpu.transport.serving import (
+    pack_action_reply,
+    pack_infer_nack,
+    pack_infer_request,
+    unpack_infer_request,
+)
+from relayrl_tpu.types.action import ActionRecord
+from relayrl_tpu.types.model_bundle import ModelBundle, exploration_kwargs
+from relayrl_tpu.types.trajectory import Trajectory
+
+CLOSE_SIZE = "size"
+CLOSE_DEADLINE = "deadline"
+
+
+class InferRequest:
+    """One queued observation request (decoded, transport-agnostic)."""
+
+    __slots__ = ("agent_id", "req_id", "key", "obs", "mask", "reply",
+                 "t_enqueue")
+
+    def __init__(self, agent_id, req_id, key, obs, mask, reply):
+        self.agent_id = agent_id
+        self.req_id = req_id
+        self.key = key
+        self.obs = obs
+        self.mask = mask
+        self.reply = reply
+        self.t_enqueue = time.monotonic()
+
+
+def default_buckets(max_batch: int) -> list[int]:
+    """Powers of two up to ``max_batch`` (inclusive, deduped): at most
+    ~log2(max_batch) compiled dispatch shapes serve every occupancy."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return sorted(set(out))
+
+
+class InferenceService:
+    """Latency-bounded dynamic-batching policy server.
+
+    Requests accumulate until ``max_batch`` arrivals (close reason
+    ``size``) or ``batch_timeout_ms`` after the FIRST queued request of
+    the batch (close reason ``deadline``), whichever fires first — the
+    TorchBeast batching-server contract. ``queue_limit`` bounds waiting
+    requests; beyond it submissions nack ``NACK_OVERLOADED`` with
+    ``retry_after_s`` so clients back off instead of piling on.
+
+    Swap surface: the service exposes the shared actor-host attribute
+    contract (``version``/``arch``/``params``/``_explore_kwargs``/
+    ``_lock``/``_wire_decoder``) so :func:`apply_bundle_swap` /
+    :func:`apply_wire_swap` gate installs exactly as on every other
+    actor tier — one params read per batch under ``_lock`` makes a batch
+    single-version by construction.
+    """
+
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        max_batch: int = 16,
+        batch_timeout_ms: float = 5.0,
+        buckets=None,
+        queue_limit: int = 1024,
+        retry_after_s: float = 0.05,
+        stale_after_s: float = 5.0,
+        validate: bool = True,
+    ):
+        import jax
+
+        from relayrl_tpu.models import build_policy, validate_policy
+
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._lock = threading.Lock()
+        self.arch = dict(bundle.arch)
+        self.policy = build_policy(self.arch)
+        if self.policy.step_window is not None:
+            raise ValueError(
+                "sequence policies are not servable yet: the per-client "
+                "rolling window would have to live server-side; use a "
+                "local actor tier (process/vector) for transformer "
+                "policies")
+        if validate:
+            validate_policy(self.policy, bundle.params)
+        self.params = bundle.params
+        self.version = bundle.version
+        self._explore_kwargs = exploration_kwargs(self.arch)
+        self._wire_decoder = None
+        from relayrl_tpu.runtime.policy_actor import make_batched_step
+
+        self._batched_fn = make_batched_step(self.policy)
+        self._jax = jax
+
+        self.max_batch = int(max_batch)
+        self.batch_timeout_s = max(0.0, float(batch_timeout_ms)) / 1000.0
+        self.buckets = sorted(set(
+            int(b) for b in (buckets or default_buckets(self.max_batch))))
+        if self.buckets[-1] < self.max_batch:
+            # The largest bucket must cover a size-closed full batch, or
+            # pick_bucket would clamp DOWN and the pad computation go
+            # negative — every full batch would then fail forever. (The
+            # ConfigLoader applies the same clamp; direct constructions
+            # get it here.)
+            self.buckets.append(self.max_batch)
+        self.queue_limit = max(1, int(queue_limit))
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        # Ghost-work guard: a request older than this has been abandoned
+        # by its client (whose per-attempt timeout elapsed and whose
+        # retry is already queued behind it) — dispatching it anyway
+        # would double-serve every retry round and amplify exactly the
+        # backlog that made it stale. Such entries are answered with a
+        # retryable nack at batch-gather time instead. 0 disables.
+        self.stale_after_s = max(0.0, float(stale_after_s))
+
+        self._queue: deque[InferRequest] = deque()
+        self._cond = threading.Condition()
+        self._worker: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._zmq_plane = None
+        self._zmq_addr = None
+
+        from relayrl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_requests = reg.counter(
+            "relayrl_serving_requests_total",
+            "observation requests accepted into the batching queue")
+        self._m_rejected = reg.counter(
+            "relayrl_serving_rejected_total",
+            "requests nacked NACK_OVERLOADED at the queue limit")
+        self._m_errors = reg.counter(
+            "relayrl_serving_request_errors_total",
+            "malformed/unservable requests answered with an error reply")
+        self._m_batches = {
+            reason: reg.counter(
+                "relayrl_serving_batches_total",
+                "closed inference batches by close trigger",
+                {"reason": reason})
+            for reason in (CLOSE_SIZE, CLOSE_DEADLINE)}
+        self._m_stale = reg.counter(
+            "relayrl_serving_stale_dropped_total",
+            "queued requests nacked unserved because they outlived "
+            "serving.stale_after_s (their client already timed out and "
+            "retried — dispatching them would double-serve ghost work)")
+        self._m_occupancy = reg.histogram(
+            "relayrl_serving_batch_occupancy",
+            "requests per closed batch (occupancy > 1 = batching works)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._m_dispatch_s = reg.histogram(
+            "relayrl_serving_dispatch_seconds",
+            "one batched policy dispatch (device compute + reply encode)")
+        self._m_request_s = reg.histogram(
+            "relayrl_serving_request_seconds",
+            "request enqueue to reply handoff (queue wait + batch close "
+            "wait + dispatch share)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1.0, 5.0))
+        import weakref
+
+        wref = weakref.ref(self)
+
+        def _depth():
+            svc = wref()
+            return None if svc is None else len(svc._queue)
+
+        reg.gauge_fn("relayrl_serving_queue_depth", _depth,
+                     "observation requests awaiting a batch close")
+
+    @classmethod
+    def from_config(cls, bundle: ModelBundle, config,
+                    validate: bool = True) -> "InferenceService":
+        p = config.get_serving_params()
+        return cls(bundle, max_batch=p["max_batch"],
+                   batch_timeout_ms=p["batch_timeout_ms"],
+                   buckets=p["buckets"], queue_limit=p["queue_limit"],
+                   retry_after_s=p["retry_after_s"],
+                   stale_after_s=p["stale_after_s"], validate=validate)
+
+    # -- lifecycle --
+    def bind_zmq(self, addr: str) -> None:
+        """Bind (or re-bind on restart) the ROUTER serving plane at
+        ``addr`` — the action channel for zmq fleets AND the native
+        passthrough (the C++ core has no request/response action RPC)."""
+        self._zmq_addr = addr
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._stop.clear()
+        if self._zmq_addr is not None:
+            from relayrl_tpu.transport.serving import ZmqServingPlane
+
+            self._zmq_plane = ZmqServingPlane(self._zmq_addr,
+                                              self.handle_request)
+            self._zmq_plane.start()
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="inference-batcher", daemon=True)
+        self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=10)
+            self._worker = None
+        # Parked requests answer with a retryable nack, not silence: a
+        # restarting service must not wedge clients for a full timeout.
+        # This must happen BEFORE the zmq plane closes — the nack rides
+        # the plane's reply pipe, and a closed PUSH socket would drop it
+        # silently (the plane's own stop() drains the pipe).
+        with self._cond:
+            pending, self._queue = list(self._queue), deque()
+        for req in pending:
+            self._safe_reply(req, pack_infer_nack(
+                req.req_id, NACK_OVERLOADED, "inference service stopping",
+                max(self.retry_after_s, 0.05)))
+        if self._zmq_plane is not None:
+            self._zmq_plane.stop()
+            self._zmq_plane = None
+
+    # -- model install --
+    def maybe_swap(self, bundle: ModelBundle) -> bool:
+        """Install a newer model (shared gate with every actor host):
+        in-flight batches finish on the old version, the next batch reads
+        the new one — single-version-per-batch either way."""
+        from relayrl_tpu.runtime.policy_actor import apply_bundle_swap
+
+        return apply_bundle_swap(self, bundle)
+
+    def swap_from_wire(self, version: int, blob: bytes):
+        """Wire-v2-aware swap for standalone hosts subscribing over an
+        agent transport (same decode path as every actor)."""
+        from relayrl_tpu.runtime.policy_actor import apply_wire_swap
+
+        return apply_wire_swap(self, version, blob)
+
+    def install_params(self, version: int, arch: dict, host_params) -> bool:
+        """Colocated feed: the TrainingServer hands the freshly published
+        host tree straight in (zero wire hops). The install owns its
+        memory (the publisher's buffers keep moving) and lands on the
+        serving device where one exists — the same placement rules as
+        ``apply_wire_swap``."""
+        jax = self._jax
+        params = jax.tree.map(np.array, host_params)
+        if jax.default_backend() != "cpu":
+            params = jax.device_put(params)
+        return self.maybe_swap(ModelBundle(version=int(version),
+                                           arch=dict(arch), params=params))
+
+    # -- request intake (transport threads) --
+    def handle_request(self, payload: bytes, reply) -> InferRequest | None:
+        """Transport callback: decode + enqueue (never dispatches here).
+        Malformed frames answer code 0; a full queue answers the typed
+        overload nack with retry-after. Returns the queued request (None
+        when it was answered instead of queued) so blocking adapters can
+        retract it on their own timeout. Runs on transport threads."""
+        try:
+            req = unpack_infer_request(payload)
+        except Exception:
+            self._m_errors.inc()
+            reply(pack_infer_nack(-1, 0, "malformed inference request"))
+            return None
+        request = InferRequest(req["id"], req["req"], req["key"],
+                               req["obs"], req["mask"], reply)
+        return request if self.submit(request) else None
+
+    def handle_request_blocking(self, payload: bytes) -> bytes:
+        """RPC-thread adapter (grpc ``GetActions``): enqueue, then block
+        this thread until its batch executes. The wait bound covers the
+        worst batch close + dispatch; beyond it the client gets a
+        retryable nack instead of a hung RPC — and the orphaned request
+        is RETRACTED from the queue (if still there): under sustained
+        overload a timed-out RPC must not leave ghost work behind that
+        amplifies the very backlog that timed it out."""
+        box: dict = {}
+        done = threading.Event()
+
+        def reply(b: bytes) -> None:
+            box["reply"] = b
+            done.set()
+
+        request = self.handle_request(payload, reply)
+        # Park bound: batch close + a stale-sweep interval, NOT a flat
+        # 30 s — the caller's RPC deadline is ~request_timeout_s, and a
+        # thread still parked long after it has been abandoned occupies
+        # a slot in the gRPC pool the trajectory/long-poll planes share
+        # (64 retrying clients would exhaust max_workers=128 and stall
+        # ingest fleet-wide).
+        done.wait(timeout=self.batch_timeout_s
+                  + (self.stale_after_s or 5.0) + 2.0)
+        if "reply" not in box and request is not None:
+            with self._cond:
+                try:
+                    self._queue.remove(request)
+                except ValueError:
+                    pass  # already dispatched: its reply lands in the
+                    #       abandoned box, a harmless one-off
+        return box.get("reply") or pack_infer_nack(
+            -1, NACK_OVERLOADED, "inference batch timed out",
+            max(self.retry_after_s, 0.05))
+
+    def submit(self, req: InferRequest) -> bool:
+        """Queue one decoded request (True), or answer the overload nack
+        when the queue is at ``serving.queue_limit`` (False — bounded
+        queue = bounded worst-case latency; the client's retry-after
+        honor is the backpressure loop)."""
+        with self._cond:
+            if len(self._queue) >= self.queue_limit or self._stop.is_set():
+                overloaded = True
+            else:
+                overloaded = False
+                self._queue.append(req)
+                self._cond.notify()
+        if overloaded:
+            self._m_rejected.inc()
+            self._safe_reply(req, pack_infer_nack(
+                req.req_id, NACK_OVERLOADED, "inference queue full",
+                self.retry_after_s))
+            return False
+        self._m_requests.inc()
+        return True
+
+    # -- the batching loop (worker thread) --
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            batch, reason = self._gather_batch()
+            if batch:
+                self._execute(batch, reason)
+
+    def _gather_batch(self) -> tuple[list[InferRequest], str]:
+        """Block for the first request, then accumulate until
+        ``max_batch`` (size close) or ``batch_timeout_ms`` past the first
+        request's enqueue (deadline close). The deadline anchors at
+        ENQUEUE, not batch open: time a request spent queued behind the
+        previous dispatch counts against its latency budget, so a loaded
+        service degrades to immediate closes instead of stacking
+        timeouts."""
+        stale: list[InferRequest] = []
+
+        def pop_fresh():
+            # Ghost-work guard: entries older than stale_after_s were
+            # abandoned by their (timed-out, already-retrying) client —
+            # nack them unserved instead of double-serving every retry
+            # round under backlog. Collected here, answered outside the
+            # lock.
+            while self._queue:
+                req = self._queue.popleft()
+                if (self.stale_after_s
+                        and time.monotonic() - req.t_enqueue
+                        > self.stale_after_s):
+                    stale.append(req)
+                    continue
+                return req
+            return None
+
+        batch: list[InferRequest] = []
+        with self._cond:
+            first = pop_fresh()
+            # Exit the wait as soon as there is ANYTHING to act on —
+            # a fresh request to batch, or stale ones to nack (their
+            # clients must not wait for unrelated traffic to arrive
+            # before learning their request was shed).
+            while first is None and not stale:
+                if self._stop.is_set():
+                    break
+                self._cond.wait(0.1)
+                first = pop_fresh()
+            if first is not None:
+                batch = [first]
+                deadline = first.t_enqueue + self.batch_timeout_s
+                while len(batch) < self.max_batch:
+                    if self._queue:
+                        got = pop_fresh()
+                        if got is not None:
+                            batch.append(got)
+                        continue
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop.is_set():
+                        break
+                    self._cond.wait(remaining)
+        for req in stale:
+            self._m_stale.inc()
+            self._safe_reply(req, pack_infer_nack(
+                req.req_id, NACK_OVERLOADED, "request went stale in queue",
+                self.retry_after_s))
+        reason = CLOSE_SIZE if len(batch) >= self.max_batch \
+            else CLOSE_DEADLINE
+        return batch, reason
+
+    def _execute(self, batch: list[InferRequest], reason: str) -> None:
+        t0 = time.monotonic()
+        # Close accounting rides AHEAD of the dispatch: a reply observer
+        # (test, bench row) reading the counters right after its reply
+        # arrives must already see this batch counted — the timing
+        # histograms below stay post-dispatch because they measure it.
+        self._m_batches[reason].inc()
+        self._m_occupancy.observe(len(batch))
+        # ONE params/version/explore read under the swap gate for the
+        # whole batch: no request in it can ever be served by a different
+        # model version than its batchmates (the invariant the vector
+        # host enforces per dispatch, test-locked against a racing
+        # swapper).
+        with self._lock:
+            params = self.params
+            version = self.version
+            explore = self._explore_kwargs
+        # Mixed fleets may interleave request shapes (masked vs maskless,
+        # pixel vs vector observations): group by signature, one bucketed
+        # dispatch per group. Homogeneous fleets — the common case — see
+        # exactly one group.
+        groups: dict[tuple, list[InferRequest]] = {}
+        for req in batch:
+            sig = (req.obs.shape, str(req.obs.dtype), req.mask is not None,
+                   str(req.key.dtype), req.key.shape)
+            groups.setdefault(sig, []).append(req)
+        for group in groups.values():
+            try:
+                self._dispatch_group(group, params, version, explore)
+            except Exception as e:
+                # One unservable group (bad shapes, dtype surprises) must
+                # not take down the worker or its batchmates: every
+                # member gets a retryable error reply.
+                self._m_errors.inc(len(group))
+                for req in group:
+                    self._safe_reply(req, pack_infer_nack(
+                        req.req_id, 0, f"dispatch failed: {e!r}"))
+        now = time.monotonic()
+        self._m_dispatch_s.observe(now - t0)
+        for req in batch:
+            self._m_request_s.observe(now - req.t_enqueue)
+
+    def _dispatch_group(self, group: list[InferRequest], params,
+                        version: int, explore: dict) -> None:
+        jnp = self._jax.numpy
+        n = len(group)
+        bucket = pick_bucket(n, self.buckets)
+
+        def padded(stack: np.ndarray) -> np.ndarray:
+            # Pad to the bucket by repeating the last row: vmap rows are
+            # independent, so pad content cannot perturb real rows (the
+            # padding-invariance test locks it); repeating a REAL row
+            # keeps dtypes/shapes trivially right.
+            if bucket == n:
+                return stack
+            return np.concatenate(
+                [stack, np.repeat(stack[-1:], bucket - n, axis=0)])
+
+        keys = padded(np.stack([r.key for r in group]))
+        obs = padded(np.stack([r.obs for r in group]))
+        masks = None
+        if group[0].mask is not None:
+            masks = padded(np.stack([r.mask for r in group]))
+        acts, aux, next_keys = self._batched_fn(
+            params, jnp.asarray(keys), obs, masks, explore)
+        acts_np = np.asarray(acts)
+        keys_np = np.asarray(next_keys)
+        aux_np = {k: np.asarray(v) for k, v in aux.items()}
+        for i, req in enumerate(group):
+            # np.asarray on the indexed rows: a stacked [N] column
+            # indexes to a numpy scalar, and the wire must carry the 0-d
+            # ndarray's exact dtype (the vector-host float64 lesson).
+            reply = pack_action_reply(
+                req.req_id, version, np.asarray(acts_np[i]), keys_np[i],
+                {k: np.asarray(v[i]) for k, v in aux_np.items()})
+            self._safe_reply(req, reply)
+
+    @staticmethod
+    def _safe_reply(req: InferRequest, payload: bytes) -> None:
+        """Reply-delivery isolation: one dead client connection must not
+        take down the batch that served its neighbors."""
+        try:
+            req.reply(payload)
+        except Exception as e:
+            print(f"[InferenceService] reply delivery failed: {e!r}",
+                  flush=True)
+
+    def accounting(self) -> dict:
+        """Bench/drill evidence block (mirrors the registry counters)."""
+        return {
+            "queue_depth": len(self._queue),
+            "max_batch": self.max_batch,
+            "batch_timeout_ms": self.batch_timeout_s * 1000.0,
+            "buckets": list(self.buckets),
+        }
+
+
+class RemoteActorClient:
+    """Thin-client actor (``actor.host_mode: "remote"``): holds NO
+    params, NO model subscription, NO swap gate — every action is a
+    request/response round-trip to an :class:`InferenceService`. The
+    trajectory plane (Trajectory assembly, spool sequence tags, transport
+    envelopes) is the standard actor plane, byte-identical on the wire.
+
+    The client carries its PRNG key and round-trips it through the
+    service (which splits it inside the jitted dispatch, exactly
+    ``_fuse_rng``), so for the same ``seed`` the served action stream is
+    bit-identical to a local ``PolicyActor(seed=seed)`` holding the same
+    params version — the parity contract tests/test_serving.py locks.
+
+    Overload nacks honor the server's ``retry_after_s`` without charging
+    the circuit breaker (the server is alive and answered — the spool's
+    nack lesson); transport failures back off under the shared
+    ``transport.retry`` policy behind a breaker, so a killed service
+    never wedges the env loop in a hot retry spin.
+    """
+
+    def __init__(
+        self,
+        config_path: str | None = None,
+        server_type: str = "zmq",
+        seed: int | None = None,
+        identity: str | None = None,
+        start: bool = True,
+        handshake_timeout_s: float = 60.0,
+        **addr_overrides,
+    ):
+        import os
+
+        from relayrl_tpu.config import ConfigLoader
+
+        self.config = ConfigLoader(None, config_path)
+        from relayrl_tpu import faults, telemetry
+
+        telemetry.configure_from_config(self.config)
+        faults.maybe_install_from_env()
+        self._fault_infer = faults.site("agent.infer")
+        self.server_type = server_type
+        self._addr_overrides = addr_overrides
+        self._identity = identity
+        self._handshake_timeout_s = handshake_timeout_s
+        self._seed = os.getpid() if seed is None else seed
+        serving = self.config.get_serving_params()
+        self._request_timeout_s = serving["request_timeout_s"]
+        self._infer_deadline_s = serving["infer_deadline_s"]
+        self._lock = threading.Lock()
+        self._req_counter = 0
+        self.version = -1  # latest service version that answered us
+        self.transport = None
+        self.spool = None
+        self._serving = None
+        self._breaker = None
+        self._retry = None
+        self.trajectory = Trajectory(
+            max_length=self.config.get_max_traj_length(),
+            on_send=self._send_traj)
+        import jax
+
+        self._rng = np.asarray(jax.random.PRNGKey(self._seed))
+        reg = telemetry.get_registry()
+        self._m_steps = reg.counter(
+            "relayrl_actor_env_steps_total",
+            "policy steps served (one per env step per lane)")
+        self._m_request_s = reg.histogram(
+            "relayrl_serving_client_request_seconds",
+            "one action round-trip on the client (send to decoded reply, "
+            "retries included)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1.0, 5.0))
+        self._m_retries = reg.counter(
+            "relayrl_serving_client_retries_total",
+            "inference request attempts beyond the first")
+        self._m_nacked = reg.counter(
+            "relayrl_serving_client_nacked_total",
+            "overload nacks honored (slept retry_after_s, no breaker "
+            "charge)")
+        self.active = False
+        if start:
+            self.enable_agent()
+
+    # -- lifecycle (Agent-compatible surface) --
+    def enable_agent(self) -> None:
+        if self.active:
+            return
+        from relayrl_tpu.transport import make_agent_transport
+        from relayrl_tpu.transport.retry import (
+            RetryPolicy,
+            breaker_from_config,
+        )
+        from relayrl_tpu.transport.serving import make_serving_client
+
+        overrides = dict(self._addr_overrides)
+        overrides.setdefault("negotiate_window_s",
+                             min(self._handshake_timeout_s * 0.5, 30.0))
+        if self._identity is not None:
+            overrides.setdefault("identity", self._identity)
+        serving_overrides = {
+            k: overrides.pop(k)
+            for k in ("serving_addr", "serving_plane")
+            if k in overrides}
+        self.transport = make_agent_transport(
+            self.server_type, self.config, **overrides)
+        # No fetch_model: the whole point is that this actor never holds
+        # a model. Registration still announces the logical agent.
+        try:
+            self.transport.register(self.transport.identity, timeout_s=10.0)
+        except Exception as e:
+            print(f"[RemoteActorClient] registration failed (continuing "
+                  f"unregistered): {e!r}", flush=True)
+        self._bind_spool()
+        self.transport.on_reconnect = self._handle_reconnect
+        retry_cfg = self.config.get_transport_params()["retry"]
+        self._retry = RetryPolicy.from_dict(retry_cfg)
+        if self._breaker is None:
+            self._breaker = breaker_from_config(
+                f"infer:{self._identity or 'remote'}", retry_cfg)
+        self._serving = make_serving_client(
+            self.server_type, self.config, transport=self.transport,
+            **serving_overrides)
+        self.active = True
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("agent_register", agent_id=self.transport.identity,
+                       side="agent", mode="remote")
+
+    def disable_agent(self) -> None:
+        if not self.active:
+            return
+        if self.spool is not None:
+            self.spool.send_fn = None
+        if self._serving is not None:
+            self._serving.close()
+            self._serving = None
+        self.transport.close()
+        self.transport = None
+        self.active = False
+
+    def _bind_spool(self) -> None:
+        from relayrl_tpu.runtime.agent import _bind_spool_impl
+
+        _bind_spool_impl(self, self._identity or "remote")
+
+    def _handle_reconnect(self) -> None:
+        from relayrl_tpu.runtime.agent import _handle_reconnect_impl
+
+        _handle_reconnect_impl(self, [self.transport.identity])
+
+    def _send_traj(self, payload: bytes) -> None:
+        if self.spool is not None:
+            self.spool.send(payload, self.transport.identity)
+        else:
+            from relayrl_tpu.transport.base import IngestNack
+
+            try:
+                self.transport.send_trajectory(payload)
+            except IngestNack:
+                pass  # guardrail verdict, spool-less: drop (see Agent)
+
+    # -- action API (PolicyActor-shaped) --
+    def request_for_action(self, obs, mask=None,
+                           reward: float = 0.0) -> ActionRecord:
+        """One served action: ship the observation + current PRNG key,
+        append the returned action to the trajectory. Reward credit
+        semantics identical to ``PolicyActor.request_for_action`` (the
+        reward lands on the PREVIOUS record)."""
+        self._require_active()
+        from relayrl_tpu.runtime.policy_actor import normalize_obs
+
+        # Byte frames stay bytes on the wire, everything else float32 —
+        # the shared rule every tier uses (the parity contract rides on
+        # it staying ONE body).
+        obs = normalize_obs(obs)
+        mask_arr = None if mask is None else np.asarray(mask, np.float32)
+        with self._lock:
+            if reward and self.trajectory.get_actions():
+                self.trajectory.get_actions()[-1].update_reward(
+                    float(reward))
+            act, aux = self._infer(obs, mask_arr)
+            record = ActionRecord(
+                obs=obs, act=act, mask=mask_arr,
+                rew=0.0,  # filled by the NEXT request / terminal marker
+                data=aux, done=False)
+            self.trajectory.add_action(record, send_if_done=True)
+        self._m_steps.inc()
+        return record
+
+    def flag_last_action(self, reward: float = 0.0, truncated: bool = False,
+                         final_obs=None, terminated: bool | None = None,
+                         final_mask=None) -> None:
+        """Terminal marker — same semantics as PolicyActor's (terminated
+        beats truncated, the bootstrap final_obs rides the marker); no
+        serving state to reset because the client holds none."""
+        self._require_active()
+        if terminated:
+            truncated = False
+        with self._lock:
+            record = ActionRecord(
+                obs=(None if final_obs is None
+                     else np.asarray(final_obs, np.float32)),
+                mask=(None if final_mask is None
+                      else np.asarray(final_mask, np.float32)),
+                rew=float(reward), done=True, truncated=bool(truncated))
+            self.trajectory.add_action(record, send_if_done=True)
+
+    def record_action(self, action: ActionRecord) -> None:
+        self._require_active()
+        with self._lock:
+            self.trajectory.add_action(action, send_if_done=True)
+
+    def _infer(self, obs: np.ndarray, mask) -> tuple[np.ndarray, dict]:
+        """One request/response round-trip with overload + failure
+        handling (lock held — the env loop is serial per client):
+
+        * overload nack → honor ``retry_after_s``, no breaker charge;
+        * timeout / connection error → breaker charge + jittered backoff
+          under ``transport.retry`` (a dead service opens the breaker and
+          the loop waits out half-open probes instead of hot-spinning);
+        * total budget ``serving.infer_deadline_s`` → RuntimeError (the
+          env loop's caller decides; nothing is appended mid-failure).
+        """
+        self._req_counter += 1
+        req_id = self._req_counter
+        clean = pack_infer_request(
+            self.transport.identity, req_id, self._rng, obs, mask)
+        first_attempt = clean
+        dropped_first = False
+        if self._fault_infer is not None:
+            # chaos plane (agent.infer): the injection applies to the
+            # FIRST attempt only — drop surfaces as a timeout → retry,
+            # corrupt dies in the service's decode guard → retry, delay
+            # sleeps here. Retries always carry the clean payload (one
+            # fault per op, the plan's per-op contract — a corrupted
+            # attempt retried corrupted forever would turn a 20%-corrupt
+            # drill into guaranteed deadline exhaustion).
+            parts = self._fault_infer.inject(clean)
+            if not parts:
+                dropped_first = True
+            else:
+                delay_s, first_attempt = parts[-1]
+                if delay_s > 0:
+                    time.sleep(delay_s)
+        deadline = time.monotonic() + self._infer_deadline_s
+        attempt = 0
+        t0 = time.monotonic()
+        last_error = ""
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"inference request exhausted its "
+                    f"{self._infer_deadline_s:.0f}s budget "
+                    f"(service down? breaker={self._breaker.state}"
+                    f"{f'; last error: {last_error}' if last_error else ''})")
+            if dropped_first:
+                # fault-dropped first attempt: exactly a timeout's shape
+                dropped_first = False
+                self._note_failure(attempt, remaining)
+                attempt += 1
+                continue
+            if not self._breaker.allow():
+                time.sleep(min(0.2, remaining))
+                continue
+            try:
+                reply = self._serving.request(
+                    first_attempt if attempt == 0 else clean, req_id,
+                    min(self._request_timeout_s, remaining))
+            except (TimeoutError, ConnectionError, OSError):
+                self._breaker.record_failure()
+                self._note_failure(attempt, deadline - time.monotonic())
+                attempt += 1
+                continue
+            self._breaker.record_success()
+            code = reply["code"]
+            if code == NACK_OVERLOADED:
+                # The service is ALIVE and shed us: honor the hint, keep
+                # the breaker closed (the IngestNack lesson).
+                self._m_nacked.inc()
+                time.sleep(min(max(reply["retry_after_s"], 0.001),
+                               max(0.0, deadline - time.monotonic())))
+                continue
+            if code == NACK_UNAVAILABLE:
+                # PERMANENT: the endpoint answered but no inference
+                # service is installed (serving.enabled false) — a
+                # misconfiguration, not an outage; retrying would only
+                # bury the pointed error under a deadline exhaustion.
+                raise RuntimeError(
+                    f"inference unavailable: {reply['error']}")
+            if code != NACK_OK or "act" not in reply:
+                # code-0 error (malformed/failed dispatch): retryable —
+                # the chaos corrupt drill lands here.
+                last_error = reply.get("error") or last_error
+                self._note_failure(attempt, deadline - time.monotonic())
+                attempt += 1
+                continue
+            self._rng = np.frombuffer(
+                reply["key"], dtype=self._rng.dtype).copy()
+            self.version = reply["ver"]
+            self._m_request_s.observe(time.monotonic() - t0)
+            return reply["act"], reply["aux"]
+
+    def _note_failure(self, attempt: int, remaining: float) -> None:
+        self._m_retries.inc()
+        if remaining > 0:
+            time.sleep(min(self._retry.delay(attempt), remaining))
+
+    @property
+    def model_version(self) -> int:
+        """Latest service-side params version that served this client an
+        action (-1 before the first reply) — the thin client's analogue
+        of an actor's installed version."""
+        return self.version
+
+    def _require_active(self) -> None:
+        if not self.active or self._serving is None:
+            raise RuntimeError(
+                "remote actor client is not active (call enable_agent())")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disable_agent()
+
+
+class StandaloneInferenceHost:
+    """An InferenceService on dedicated devices: subscribes to the model
+    plane over any agent transport exactly like an actor (handshake →
+    wire-v2 deltas → shared swap gate) and serves the zmq ROUTER action
+    plane. The Sebulba "dedicated inference devices" placement; the
+    colocated placement lives inside TrainingServer (zero wire hops).
+    """
+
+    def __init__(self, config_path: str | None = None,
+                 server_type: str = "zmq", serving_addr: str | None = None,
+                 handshake_timeout_s: float = 60.0, start: bool = True,
+                 **addr_overrides):
+        from relayrl_tpu.config import ConfigLoader
+        from relayrl_tpu.transport import make_agent_transport
+
+        self.config = ConfigLoader(None, config_path)
+        from relayrl_tpu import telemetry
+
+        telemetry.configure_from_config(self.config)
+        self.transport = make_agent_transport(server_type, self.config,
+                                              **addr_overrides)
+        version, bundle_bytes = self.transport.fetch_model(
+            handshake_timeout_s)
+        bundle = ModelBundle.from_bytes(
+            bundle_bytes, params_template=ModelBundle.RAW_TREE)
+        bundle.version = version
+        self.service = InferenceService.from_config(bundle, self.config)
+        self.service.bind_zmq(
+            serving_addr or self.config.get_inference_server().address)
+        self.transport.on_model = self._on_model
+        self.active = False
+        if start:
+            self.start()
+
+    def _on_model(self, version: int, blob: bytes) -> None:
+        from relayrl_tpu.transport.modelwire import WireBaseMismatch
+
+        try:
+            self.service.swap_from_wire(version, blob)
+        except WireBaseMismatch:
+            self.transport.request_resync()
+        except Exception as e:
+            print(f"[StandaloneInferenceHost] rejected model update: "
+                  f"{e!r}", flush=True)
+
+    def start(self) -> None:
+        if self.active:
+            return
+        self.service.start()
+        self.transport.start_model_listener()
+        self.active = True
+
+    def stop(self) -> None:
+        if not self.active:
+            return
+        self.service.stop()
+        self.transport.close()
+        self.active = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+__all__ = ["InferenceService", "InferRequest", "RemoteActorClient",
+           "StandaloneInferenceHost", "default_buckets",
+           "CLOSE_SIZE", "CLOSE_DEADLINE"]

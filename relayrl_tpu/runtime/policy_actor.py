@@ -145,6 +145,20 @@ def apply_wire_swap(actor, version: int, blob: bytes):
     return bundle if apply_bundle_swap(actor, bundle) else None
 
 
+def normalize_obs(obs) -> np.ndarray:
+    """The ONE wire-dtype rule for observations entering any actor tier
+    (PolicyActor, VectorActorHost, RemoteActorClient): byte frames stay
+    bytes (uint8 pixel payloads are 4x smaller on the wire; the CNN
+    trunk casts + scales on-device) with a defensive copy — envs
+    commonly hand out views of a reused frame buffer, and a stored view
+    would turn every recorded step into the episode's final frame —
+    while everything else normalizes to float32. Shared so the tiers'
+    byte-identical-trajectory parity can never drift on this rule."""
+    obs = np.asarray(obs)
+    return (obs.copy() if obs.dtype == np.uint8
+            else obs.astype(np.float32, copy=False))
+
+
 def make_batched_step(policy):
     """One jitted, vmapped sampling step over stacked per-lane inputs:
     ``fn(params, keys[N,2], obs[N,...], masks, explore) -> (acts, aux,
@@ -292,18 +306,11 @@ class PolicyActor:
         deliberate departure, SURVEY.md §7.5 spirit. The only reward that
         can be lost is one spanning a capacity-flush chunk boundary (the
         previous record already left the process)."""
-        # Preserve byte frames: a uint8 pixel obs must reach the wire as
-        # uint8 (4x smaller trajectories; the CNN trunk casts + scales
-        # on-device) — an unconditional float32 cast here silently made
-        # every "byte-sized" pixel payload 112,989 B/step instead of
-        # 28,226. Everything else normalizes to float32 as before. The
-        # uint8 branch copies defensively: envs commonly hand out views
-        # of a reused frame buffer, and a stored view would turn every
-        # recorded step into the episode's final frame (28 KB per step —
-        # negligible next to the policy apply).
-        obs = np.asarray(obs)
-        obs = (obs.copy() if obs.dtype == np.uint8
-               else obs.astype(np.float32, copy=False))
+        # Byte frames stay bytes, everything else float32 — the shared
+        # rule (see normalize_obs: an unconditional float32 cast here
+        # silently made every "byte-sized" pixel payload 112,989 B/step
+        # instead of 28,226).
+        obs = normalize_obs(obs)
         mask_arr = None if mask is None else np.asarray(mask, dtype=np.float32)
         with self._lock:
             if reward and self.trajectory.get_actions():

@@ -85,6 +85,7 @@ class TrainingServer:
         start: bool = True,
         resume: bool = False,
         handle_signals: bool = False,
+        serving: bool | None = None,
         **addr_overrides,
     ):
         self.config = ConfigLoader(algorithm_name, config_path)
@@ -387,6 +388,33 @@ class TrainingServer:
                 self.transport.get_model_version = (
                     lambda: self.latest_model_version)
 
+        # Disaggregated batched-inference serving plane (ROADMAP item 2,
+        # runtime/inference.py): colocated with this learner, fed
+        # in-process from the publish path — thin clients
+        # (actor.host_mode: "remote") get batched actions with zero
+        # model-distribution wire hops. grpc fleets ride the in-band
+        # GetActions RPC; zmq/native fleets the dedicated ROUTER plane.
+        self.inference = None
+        serving_cfg = self.config.get_serving_params()
+        if serving is not None:
+            # Ctor override for drivers/benches that decide the topology
+            # programmatically (examples/train_distributed.py
+            # --host-mode remote); config holds every other knob.
+            serving_cfg["enabled"] = bool(serving)
+        if serving_cfg["enabled"] and self.transport is not None:
+            from relayrl_tpu.runtime.inference import InferenceService
+
+            try:
+                self.inference = InferenceService.from_config(
+                    self.algorithm.bundle(), self.config, validate=False)
+            except ValueError as e:
+                # Sequence policies are not servable yet — the server
+                # must still come up for the local actor tiers.
+                print(f"[TrainingServer] serving disabled: {e}",
+                      flush=True)
+            if self.inference is not None:
+                self._wire_serving_plane(addr_overrides)
+
         self._stop = threading.Event()
         self._learner_thread: threading.Thread | None = None
         self._staging_threads: list[threading.Thread] = []
@@ -528,6 +556,20 @@ class TrainingServer:
             print("[TrainingServer] handle_signals requested off the main "
                   "thread — skipped (install handlers in your main thread "
                   "and call disable_server there instead)", flush=True)
+
+    def _wire_serving_plane(self, addr_overrides: dict) -> None:
+        """Attach the InferenceService's action channel to the fleet's
+        transport kind: in-band ``GetActions`` where the backend carries
+        request/response RPCs (pure-grpcio), else the dedicated zmq
+        ROUTER plane at ``server.inference_server`` (zmq fleets natively;
+        native framed-TCP fleets as the documented passthrough — the C++
+        core has no action RPC)."""
+        if getattr(self.transport, "supports_inband_infer", False):
+            self.transport.on_infer = self.inference.handle_request_blocking
+        else:
+            self.inference.bind_zmq(addr_overrides.get(
+                "serving_addr",
+                self.config.get_inference_server().address))
 
     # -- transport callbacks (transport threads!) --
     def _count_dropped(self, n: int = 1) -> None:
@@ -1585,6 +1627,18 @@ class TrainingServer:
             # Distance-gated; a transient publish error must not starve
             # the on-disk artifact (the multi-host path always wrote it).
             self._write_model_artifact(None, version)
+            # Colocated serving feed: the inference plane sees every
+            # published version straight from the host tree — no wire
+            # hop, no subscription, same finite-publish gate as the
+            # fleet (the non-finite early-return above never reaches
+            # here with poisoned params).
+            if self.inference is not None:
+                try:
+                    self.inference.install_params(version, arch,
+                                                  host_params)
+                except Exception as e:
+                    print(f"[TrainingServer] serving install error: "
+                          f"{e!r}", flush=True)
 
     def _faulted_publish(self, version: int, frame: bytes,
                          **kwargs) -> None:
@@ -1740,6 +1794,8 @@ class TrainingServer:
                 for i in range(self._staging_count)]
             for t in self._staging_threads:
                 t.start()
+        if self.inference is not None:
+            self.inference.start()
         if (self.transport is not None and not multi_host
                 and self._async_publish and self._publisher is None):
             from relayrl_tpu.runtime.pipeline import ModelPublisher
@@ -1779,6 +1835,11 @@ class TrainingServer:
         if not self.active:
             return
         self._stop.set()
+        # Serving plane first: parked thin-client requests answer with a
+        # retryable nack instead of hanging out their timeouts against a
+        # closing socket (clients ride their breaker until a restart).
+        if self.inference is not None:
+            self.inference.stop()
         # Join the learner BEFORE stopping the transport: a trajectory being
         # processed right now may still publish, which needs a live socket.
         # (Multi-host: the coordinator's learner thread broadcasts STOP on
@@ -1848,6 +1909,8 @@ class TrainingServer:
             self.transport.on_unregister = self._on_unregister
             if self.guardrails is not None:
                 self.transport.check_ingest = self._check_ingest
+            if self.inference is not None:
+                self._wire_serving_plane(self._addr_overrides)
         self.enable_server()
 
     def __enter__(self):

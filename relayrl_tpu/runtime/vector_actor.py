@@ -41,6 +41,7 @@ from relayrl_tpu.runtime.policy_actor import (
     apply_wire_swap,
     make_batched_step,
     make_batched_window_step,
+    normalize_obs,
     resolve_actor_context,
 )
 from relayrl_tpu.types.action import ActionRecord
@@ -142,11 +143,10 @@ class VectorActorHost:
         if obs.shape[0] != self.num_envs:
             raise ValueError(
                 f"obs batch {obs.shape[0]} != num_envs {self.num_envs}")
-        # Byte frames stay bytes on the wire (pixel payloads 4x smaller;
-        # the CNN trunk casts on-device) — same policy as PolicyActor,
-        # including the defensive copy of possibly-reused frame buffers.
-        obs = (obs.copy() if obs.dtype == np.uint8
-               else obs.astype(np.float32, copy=False))
+        # Byte frames stay bytes on the wire, everything else float32 —
+        # the shared rule (normalize_obs), including the defensive copy
+        # of possibly-reused frame buffers.
+        obs = normalize_obs(obs)
         masks_arr = (None if masks is None
                      else np.asarray(masks, dtype=np.float32))
         with self._lock:

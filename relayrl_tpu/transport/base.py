@@ -125,6 +125,11 @@ NACK_OK = 1
 NACK_MALFORMED = 0
 NACK_QUARANTINED = 2
 NACK_OVERLOADED = 3
+# Serving plane only: the endpoint exists but no InferenceService is
+# installed (serving.enabled false / misconfigured fleet). PERMANENT —
+# thin clients fail fast with the reply's error text instead of
+# retrying a misconfiguration into a deadline exhaustion.
+NACK_UNAVAILABLE = 4
 
 
 class IngestNack(RuntimeError):
@@ -326,6 +331,13 @@ class ServerTransport(abc.ABC):
     #: alongside any v2 ``publish_model`` frame.
     needs_handshake_bytes = False
 
+    #: True when this backend carries the serving plane in-band (a
+    #: request/response action RPC routed through ``on_infer``) — the
+    #: pure-grpcio backend's ``GetActions``. Broadcast backends and the
+    #: native C++ cores leave it False; their fleets serve inference on
+    #: the dedicated zmq ROUTER plane instead.
+    supports_inband_infer = False
+
     def __init__(self):
         self.on_trajectory: Callable[[str, bytes], None] = lambda *_: None
         self.get_model: Callable[[], tuple[int, bytes]] = lambda: (0, b"")
@@ -351,6 +363,14 @@ class ServerTransport(abc.ABC):
         # DecodedTrajectory objects here when the embedder sets it; raw
         # payload bytes always fall back to ``on_trajectory``.
         self.on_trajectory_decoded = None
+        # Serving plane (disaggregated batched inference,
+        # transport/serving.py): backends with an in-band
+        # request/response action RPC (pure-grpcio ``GetActions``) call
+        # ``on_infer(request_bytes) -> reply_bytes`` when the embedder
+        # set it — the InferenceService's blocking adapter. None (the
+        # default, and on every broadcast-only backend) answers clients
+        # with a pointed "serving disabled" error instead of hanging.
+        self.on_infer = None
 
     @abc.abstractmethod
     def start(self) -> None: ...

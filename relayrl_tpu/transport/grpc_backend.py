@@ -101,6 +101,38 @@ class _Servicer:
             return int(fn())
         return self._owner.get_model()[0]
 
+    def get_actions(self, request: bytes, context) -> bytes:
+        """Serving-plane RPC (disaggregated batched inference): hand the
+        observation request to the embedder's InferenceService and block
+        this RPC thread until its batch executes. Without a service
+        installed the reply is a pointed error, not a hang.
+
+        Parked inference RPCs share the worker pool with SendActions and
+        the ClientPoll long-polls, so their CONCURRENCY is capped at half
+        the pool (``_infer_slots``): beyond it, arrivals get an immediate
+        typed overload nack — an inference flood must degrade to client
+        backoff, never to fleet-wide ingest starvation."""
+        from relayrl_tpu.transport.base import (
+            NACK_OVERLOADED,
+            NACK_UNAVAILABLE,
+        )
+        from relayrl_tpu.transport.serving import pack_infer_nack
+
+        if self._owner.on_infer is None:
+            return pack_infer_nack(
+                -1, NACK_UNAVAILABLE,
+                "inference serving is not enabled on this server "
+                "(set serving.enabled: true)")
+        if not self._owner._infer_slots.acquire(blocking=False):
+            return pack_infer_nack(
+                -1, NACK_OVERLOADED,
+                "inference RPC slots exhausted (serving shares the RPC "
+                "pool with ingest)", 0.05)
+        try:
+            return self._owner.on_infer(request)
+        finally:
+            self._owner._infer_slots.release()
+
     def client_poll(self, request: bytes, context) -> bytes:
         req = msgpack.unpackb(request, raw=False)
         agent_id = str(req.get("id", "?"))
@@ -149,6 +181,11 @@ class _Servicer:
 
 
 class GrpcServerTransport(ServerTransport):
+    #: GetActions rides this server in-band (see base.ServerTransport);
+    #: every thin client parks one RPC thread per in-flight request, so
+    #: max_workers bounds the serving fleet alongside the long-polls.
+    supports_inband_infer = True
+
     def __init__(self, bind_addr: str, idle_timeout_s: float = 30.0,
                  max_workers: int = 128):
         # max_workers bounds concurrent RPCs, and every subscribed agent
@@ -163,6 +200,10 @@ class GrpcServerTransport(ServerTransport):
         self._max_workers = max_workers
         self._server: grpc.Server | None = None
         self._model_cv = threading.Condition()
+        # In-band serving concurrency bound: at most half the RPC pool
+        # may park in GetActions waits, so trajectory ingest and the
+        # long-polls always keep worker headroom (see get_actions).
+        self._infer_slots = threading.Semaphore(max(8, max_workers // 2))
         # publish here is a long-poll wakeup, not a broadcast: there are
         # no broadcast bytes to count.
         self._m = server_wire_metrics("grpc", include_publish_bytes=False)
@@ -175,6 +216,9 @@ class GrpcServerTransport(ServerTransport):
                 request_deserializer=_identity, response_serializer=_identity),
             "ClientPoll": grpc.unary_unary_rpc_method_handler(
                 servicer.client_poll,
+                request_deserializer=_identity, response_serializer=_identity),
+            "GetActions": grpc.unary_unary_rpc_method_handler(
+                servicer.get_actions,
                 request_deserializer=_identity, response_serializer=_identity),
         }
         self._server = grpc.server(

@@ -1,0 +1,406 @@
+"""Serving wire plane: the request/response action channel for thin-client
+actors (the disaggregated batched-inference tier, ROADMAP item 2).
+
+The trajectory/model planes are one-way (PUSH ingest, PUB model fan-out);
+batched inference needs the missing fourth lane — a request/response pair
+per action. TorchBeast's dynamic-batching server (arXiv:1910.03552) and
+Podracer's Sebulba split (arXiv:2104.06272) are the exemplars: actors ship
+observations, the service closes latency-bounded batches, one policy
+dispatch answers everyone.
+
+Backends:
+
+* **zmq** — a dedicated ROUTER (service) / DEALER (client) pair on the
+  ``server.inference_server`` endpoint. Replies are produced on the
+  batch-worker thread but zmq sockets are single-threaded, so the worker
+  hands them to the ROUTER loop over an inproc PUSH/PULL pipe (the same
+  pattern libzmq documents for cross-thread sends).
+* **grpc** — an in-band ``GetActions`` unary RPC on the existing service
+  (pure-grpcio ``GrpcServerTransport`` only: the RPC thread blocks until
+  its batch executes, the thread pool bounds concurrent clients). The
+  native C++ gRPC server does not speak this RPC — those fleets use the
+  zmq plane below.
+* **native** — passthrough: the framed-TCP core carries trajectories and
+  models; inference rides the zmq ROUTER plane bound alongside it (the
+  service binds it regardless of the fleet's trajectory transport).
+
+Wire codec (msgpack, raw array bytes — no per-element boxing):
+
+* request  ``{id, req, key, kd, obs, os, od, mask?, ms?}`` — the client's
+  CURRENT PRNG key rides the request and the service splits it inside the
+  jitted dispatch (exactly ``_fuse_rng``'s composition), returning the
+  carried-forward key in the reply. That is what makes a served action
+  stream bit-identical to a local PolicyActor holding the same key.
+* reply    ``{req, code: 1, ver, act, as, ad, key, aux}`` with ``aux``
+  mapping name → ``[bytes, shape, dtype]``.
+* nack     ``{req, code, error, retry_after_s}`` — ``code`` reuses the
+  typed ingest verdicts (``base.NACK_OVERLOADED`` when the batching queue
+  is at ``serving.queue_limit``; the client honors ``retry_after_s``
+  without charging its circuit breaker, mirroring the spool's nack
+  handling).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+import msgpack
+import numpy as np
+
+from relayrl_tpu.transport.base import NACK_OK
+
+
+def _pack_array(arr: np.ndarray) -> tuple[bytes, list, str]:
+    arr = np.asarray(arr)
+    # Shape captured BEFORE ascontiguousarray: it promotes 0-d arrays to
+    # 1-d, and scalar actions/aux must round-trip as exact 0-d ndarrays
+    # (the vector-host wire-dtype lesson applies to shape too).
+    shape = list(arr.shape)
+    return np.ascontiguousarray(arr).tobytes(), shape, str(arr.dtype)
+
+
+def _unpack_array(buf: bytes, shape: list, dtype: str) -> np.ndarray:
+    # .copy(): frombuffer views are read-only and alias the wire frame;
+    # ActionRecords built from them must own their memory.
+    return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape).copy()
+
+
+def pack_infer_request(agent_id: str, req_id: int, key: np.ndarray,
+                       obs: np.ndarray, mask: np.ndarray | None) -> bytes:
+    kb, _, kd = _pack_array(key)
+    ob, oshape, od = _pack_array(obs)
+    req = {"id": agent_id, "req": int(req_id),
+           "key": kb, "kd": kd, "obs": ob, "os": oshape, "od": od}
+    if mask is not None:
+        mb, mshape, _ = _pack_array(np.asarray(mask, np.float32))
+        req["mask"] = mb
+        req["ms"] = mshape
+    return msgpack.packb(req, use_bin_type=True)
+
+
+def unpack_infer_request(buf: bytes) -> dict:
+    """Decoded request: ``{id, req, key, obs, mask}`` with numpy arrays.
+    Raises the transport plane's droppable error classes on malformed
+    frames (ValueError/KeyError/TypeError)."""
+    req = msgpack.unpackb(buf, raw=False)
+    key = np.frombuffer(req["key"], dtype=np.dtype(req.get("kd", "uint32")))
+    out = {
+        "id": str(req.get("id", "?")),
+        "req": int(req["req"]),
+        "key": key.copy(),
+        "obs": _unpack_array(req["obs"], req["os"], req["od"]),
+        "mask": None,
+    }
+    if req.get("mask") is not None:
+        out["mask"] = _unpack_array(req["mask"], req["ms"], "float32")
+    return out
+
+
+def pack_action_reply(req_id: int, version: int, act: np.ndarray,
+                      next_key: np.ndarray, aux: dict) -> bytes:
+    ab, ashape, ad = _pack_array(act)
+    reply = {"req": int(req_id), "code": NACK_OK, "ver": int(version),
+             "act": ab, "as": ashape, "ad": ad,
+             "key": _pack_array(next_key)[0],
+             "aux": {k: list(_pack_array(v)) for k, v in aux.items()}}
+    return msgpack.packb(reply, use_bin_type=True)
+
+
+def pack_infer_nack(req_id: int, code: int, reason: str,
+                    retry_after_s: float = 0.0) -> bytes:
+    return msgpack.packb({"req": int(req_id), "code": int(code),
+                          "error": str(reason),
+                          "retry_after_s": float(retry_after_s)},
+                         use_bin_type=True)
+
+
+def unpack_infer_reply(buf: bytes) -> dict:
+    """Decoded reply: ``{req, code, ...}`` — on code 1 additionally
+    ``ver``, ``act`` (ndarray), ``key`` (the carried-forward PRNG key
+    bytes, kept raw: the client round-trips them verbatim), ``aux``
+    (name → 0-d/array ndarray)."""
+    reply = msgpack.unpackb(buf, raw=False)
+    out = {"req": int(reply.get("req", -1)), "code": int(reply.get("code", 0)),
+           "error": str(reply.get("error") or ""),
+           "retry_after_s": float(reply.get("retry_after_s") or 0.0)}
+    if out["code"] == NACK_OK and "act" in reply:
+        out["ver"] = int(reply.get("ver", -1))
+        out["act"] = _unpack_array(reply["act"], reply["as"], reply["ad"])
+        out["key"] = reply["key"]
+        out["aux"] = {k: _unpack_array(*v)
+                      for k, v in (reply.get("aux") or {}).items()}
+    return out
+
+
+# -- server side ------------------------------------------------------------
+
+class ZmqServingPlane:
+    """ROUTER request loop + inproc reply pipe for the InferenceService.
+
+    ``on_request(payload: bytes, reply: Callable[[bytes], None])`` runs on
+    the ROUTER loop thread (decode + enqueue only — the batching queue is
+    the service's); ``reply`` may be called from ANY thread (the batch
+    worker) and forwards the encoded reply to the requesting DEALER
+    through the inproc pipe, so the ROUTER socket is only ever touched by
+    its own loop thread.
+    """
+
+    def __init__(self, addr: str,
+                 on_request: Callable[[bytes, Callable[[bytes], None]], None]):
+        import zmq
+
+        self._zmq = zmq
+        self._addr = addr
+        self.on_request = on_request
+        self._ctx = zmq.Context.instance()
+        self._inproc = f"inproc://relayrl-serving-{id(self):x}"
+        self._router: object | None = None
+        self._pull: object | None = None
+        self._push: object | None = None
+        self._push_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        zmq = self._zmq
+        from relayrl_tpu.transport.zmq_backend import _bind_with_retry
+
+        self._stop.clear()
+        self._router = self._ctx.socket(zmq.ROUTER)
+        _bind_with_retry(self._router, self._addr)
+        # inproc: the PULL must bind before any PUSH connects.
+        self._pull = self._ctx.socket(zmq.PULL)
+        self._pull.bind(self._inproc)
+        self._push = self._ctx.socket(zmq.PUSH)
+        self._push.connect(self._inproc)
+        self._thread = threading.Thread(
+            target=self._loop, name="zmq-serving-router", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # Forward any replies still in the inproc pipe (the shutdown
+        # nacks the service just sent) before tearing the ROUTER down —
+        # the loop thread has exited, so this thread owns the sockets.
+        if self._pull is not None and self._router is not None:
+            zmq = self._zmq
+            try:
+                while self._pull.poll(0):
+                    self._router.send_multipart(
+                        self._pull.recv_multipart(zmq.NOBLOCK))
+            except zmq.ZMQError:
+                pass
+        for sock in (self._router, self._pull, self._push):
+            if sock is not None:
+                sock.close(linger=0)
+        self._router = self._pull = self._push = None
+
+    def _reply_fn(self, identity: bytes) -> Callable[[bytes], None]:
+        def reply(payload: bytes) -> None:
+            # The push socket is shared across batch-worker callers; the
+            # lock serializes whole sends (the ZmqAgentTransport
+            # _push_lock precedent). A reply after stop() drops silently
+            # — the client's retry owns that window.
+            with self._push_lock:
+                if self._push is not None:
+                    self._push.send_multipart([identity, payload])
+        return reply
+
+    def _loop(self) -> None:
+        zmq = self._zmq
+        from relayrl_tpu.transport.base import swallow_decode_error
+
+        poller = zmq.Poller()
+        poller.register(self._router, zmq.POLLIN)
+        poller.register(self._pull, zmq.POLLIN)
+        while not self._stop.is_set():
+            events = dict(poller.poll(100))
+            if self._pull in events:
+                # Drain every queued reply before the next request sweep:
+                # replies are latency-critical (the client is blocked on
+                # them) and cheap (one forward per reply).
+                while True:
+                    try:
+                        frames = self._pull.recv_multipart(zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    self._router.send_multipart(frames)
+            if self._router in events:
+                frames = self._router.recv_multipart()
+                if len(frames) < 2:
+                    continue
+                identity, payload = frames[0], frames[-1]
+                try:
+                    self.on_request(payload, self._reply_fn(identity))
+                except Exception as e:
+                    swallow_decode_error("zmq", "serving_request", e)
+
+
+# -- client side ------------------------------------------------------------
+
+class ZmqServingClient:
+    """One DEALER against the service's ROUTER. ``request`` is strictly
+    request/response per caller (the thin client's env loop is serial);
+    stale replies — answers to earlier attempts that timed out client-side
+    — are discarded by request-id match, so a retry can never consume its
+    predecessor's action."""
+
+    def __init__(self, addr: str, identity: str | None = None):
+        import os
+        import secrets
+
+        import zmq
+
+        self._zmq = zmq
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(
+            zmq.IDENTITY,
+            (identity or f"INFER-{os.getpid()}{secrets.token_hex(4)}")
+            .encode())
+        self._sock.connect(addr)
+        self._lock = threading.Lock()
+
+    def request(self, payload: bytes, req_id: int,
+                timeout_s: float) -> dict:
+        """Send one request and wait for ITS reply (req-id matched).
+        Raises TimeoutError when nothing matching arrives in time."""
+        zmq = self._zmq
+        with self._lock:
+            # Drain leftovers from PREVIOUS requests before sending:
+            # a late reply (or req=-1 nack) to an attempt that already
+            # timed out must not be adopted by THIS request — clearing
+            # the buffer first shrinks the -1 branch's ambiguity window
+            # to replies generated after this send.
+            try:
+                while self._sock.poll(0):
+                    # NOBLOCK recv after a 0-timeout poll: returns
+                    # immediately by construction, never blocks the lock.
+                    self._sock.recv(zmq.NOBLOCK)  # jaxlint: disable=CONC01
+            except zmq.ZMQError:
+                pass
+            self._sock.send(payload)
+            deadline = time.monotonic() + timeout_s
+            poller = zmq.Poller()
+            poller.register(self._sock, zmq.POLLIN)
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"inference reply not received in {timeout_s:.2f}s")
+                if not dict(poller.poll(max(1, int(remaining * 1000)))):
+                    continue
+                # deliberate blocking-under-lock: the lock EXISTS to
+                # serialize whole request/reply exchanges on the
+                # non-thread-safe DEALER (the _dealer_request precedent);
+                # poll() above guarantees recv returns immediately and
+                # the hold is bounded by the caller's timeout_s.
+                raw = self._sock.recv()  # jaxlint: disable=CONC01
+                try:
+                    reply = unpack_infer_reply(raw)
+                except Exception:
+                    continue  # corrupt frame: wait out the deadline
+                if reply["req"] == req_id:
+                    return reply
+                if reply["req"] == -1 and reply["code"] != NACK_OK:
+                    # The service could not decode the request, so its
+                    # error/unavailable reply carries req=-1. This
+                    # client is strictly one-request-outstanding, so the
+                    # verdict is unambiguously OURS — returning it makes
+                    # a corrupted request a fast error-reply retry
+                    # (the agent.infer chaos contract) instead of a full
+                    # timeout + an unearned breaker charge.
+                    return reply
+                # stale reply from a timed-out earlier attempt: discard
+
+    def close(self) -> None:
+        self._sock.close(linger=0)
+
+
+class GrpcServingClient:
+    """In-band ``GetActions`` unary RPC on the agent's existing channel
+    (pure-grpcio fleets). The request/response pairing is the RPC itself,
+    so there is no stale-reply window to filter."""
+
+    def __init__(self, agent_transport):
+        import grpc
+
+        self._grpc = grpc
+        self._transport = agent_transport
+        self._stub = None
+        self._stub_channel = None
+
+    def _get_stub(self):
+        # The agent transport may rebuild its channel after a persistent
+        # break (_rebuild_channel); re-derive the stub when it did.
+        channel = self._transport._channel
+        if self._stub is None or self._stub_channel is not channel:
+            self._stub = channel.unary_unary(
+                "/relayrl.RelayRLRoute/GetActions",
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x)
+            self._stub_channel = channel
+        return self._stub
+
+    def request(self, payload: bytes, req_id: int,
+                timeout_s: float) -> dict:
+        grpc = self._grpc
+        try:
+            raw = self._get_stub()(payload, timeout=timeout_s)
+        except grpc.RpcError as e:
+            code = getattr(e, "code", lambda: None)()
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise TimeoutError(
+                    f"inference RPC deadline ({timeout_s:.2f}s)") from None
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                # PERMANENT: this server has no GetActions RPC at all —
+                # the native C++ gRPC core. Retrying a misconfiguration
+                # would bury it in a deadline exhaustion (the
+                # NACK_UNAVAILABLE rationale); RuntimeError passes
+                # through the client's retry loop uncaught.
+                raise RuntimeError(
+                    "inference unavailable: this gRPC server does not "
+                    "implement GetActions (native C++ core?) — serve "
+                    "inference on the zmq plane (serving_plane=\"zmq\") "
+                    "or run the pure-grpcio server") from None
+            raise ConnectionError(f"inference RPC failed: {e}") from None
+        return unpack_infer_reply(raw)
+
+    def close(self) -> None:
+        pass  # the agent transport owns the channel
+
+
+def make_serving_client(server_type: str, config, transport=None,
+                        **overrides):
+    """The thin client's action channel for a fleet transport kind:
+    gRPC fleets ride the in-band ``GetActions`` RPC on the agent's
+    existing channel; zmq and native fleets use the dedicated zmq
+    DEALER against ``server.inference_server`` (native passthrough —
+    the C++ core has no request/response action RPC). Pass
+    ``serving_plane="zmq"`` to force the zmq plane on a grpc fleet whose
+    server runs the native C++ gRPC core (it does not speak GetActions)."""
+    plane = overrides.get("serving_plane") or (
+        "grpc" if server_type == "grpc" else "zmq")
+    if plane == "grpc":
+        if transport is None or not hasattr(transport, "_channel"):
+            raise ValueError(
+                "grpc serving plane needs the agent's GrpcAgentTransport")
+        return GrpcServingClient(transport)
+    addr = overrides.get("serving_addr")
+    if addr is None:
+        addr = config.get_inference_server().address
+    return ZmqServingClient(addr, identity=overrides.get("identity"))
+
+
+__all__ = [
+    "pack_infer_request", "unpack_infer_request", "pack_action_reply",
+    "pack_infer_nack", "unpack_infer_reply", "ZmqServingPlane",
+    "ZmqServingClient", "GrpcServingClient", "make_serving_client",
+]

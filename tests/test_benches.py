@@ -199,6 +199,53 @@ def test_bench_soak_anakin_quick_smoke(tmp_path):
     assert "relayrl_server_trajectories_total" in names
 
 
+@pytest.mark.serving
+def test_bench_soak_serving_quick_smoke(tmp_path):
+    """Fast --serving soak smoke (ISSUE 10): a tiny thin-client fleet
+    against the server-colocated InferenceService must complete >= 1
+    action round-trip per client (steps > 0 per row), land >= 1
+    trajectory per client through the UNCHANGED ingest plane, show
+    batching actually engaged (measured occupancy > 1), zero drops, and
+    carry the serving SLO block (latency percentiles + close-reason
+    split) in the row."""
+    import os
+
+    sys.path.insert(0, str(BENCH_DIR))
+    monkey_cwd = os.getcwd()
+    try:
+        import bench_soak
+
+        os.chdir(tmp_path)
+        result = bench_soak.run_soak(
+            n_actors=4, agents_per_proc=4, duration_s=4.0,
+            traj_per_epoch=8, serving=True, max_batch=4,
+            batch_timeout_ms=5.0)
+    finally:
+        os.chdir(monkey_cwd)
+        sys.path.pop(0)
+    assert result["config"]["mode"] == "serving"
+    assert result["agents_completed"] == 4
+    assert result["agents_crashed"] == 0
+    assert result["server_stats"]["dropped"] == 0
+    assert result["env_steps_total"] >= 4      # >= 1 round-trip each...
+    assert result["min_episodes_per_agent"] >= 1  # ...in fact episodes
+    assert result["distinct_traj_agents"] == 4  # ingest plane unchanged
+    serving = result["serving"]
+    assert serving["requests_total"] >= result["env_steps_total"]
+    assert serving["rejected_total"] == 0
+    assert serving["batch_occupancy_mean"] > 1, \
+        "dynamic batching never engaged"
+    assert (serving["close_reasons"]["size"]
+            + serving["close_reasons"]["deadline"]) > 0
+    assert serving["action_latency_ms"]["p50"] > 0
+    assert serving["action_latency_ms"]["p99"] >= \
+        serving["action_latency_ms"]["p50"]
+    snap = result["telemetry"]
+    assert snap["schema"] == "relayrl-telemetry-v1"
+    names = {m["name"] for m in snap["metrics"]}
+    assert "relayrl_serving_requests_total" in names
+
+
 @pytest.mark.anakin
 def test_bench_anakin_quick_emits_json(tmp_path):
     """bench_anakin --quick: baseline + fused rate lines for every grid

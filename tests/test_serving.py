@@ -1,0 +1,897 @@
+"""Disaggregated batched-inference serving plane (runtime/inference.py).
+
+The acceptance surface of ISSUE 10:
+
+* the dynamic-batching queue closes on BOTH triggers (max_batch = size,
+  batch_timeout_ms = deadline) and buckets dispatch shapes via
+  pick_bucket, with padded rows provably inert;
+* queue-limit overload answers a typed NACK_OVERLOADED with retry-after,
+  and the thin client honors it without charging its circuit breaker;
+* every batch is served by exactly ONE params version even against a
+  racing swapper (the single read under the shared swap gate);
+* served-mode parity: a RemoteActorClient's actions are BIT-identical to
+  a local PolicyActor holding the same params version and seed — and the
+  shipped trajectory bytes are byte-identical — on both the zmq ROUTER
+  plane and the in-band grpc GetActions RPC;
+* the agent.infer fault site + a killed/restarted service heal through
+  the shared RetryPolicy/breaker without wedging the env loop.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from _util import free_port
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture
+def fresh_registry():
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.transport.retry import reset_metrics_for_tests
+
+    reg = telemetry.Registry(run_id="serving-test")
+    telemetry.set_registry(reg)
+    reset_metrics_for_tests()
+    yield reg
+    telemetry.reset_for_tests()
+    reset_metrics_for_tests()
+
+
+def _reinforce_bundle(scratch, obs_dim=6, act_dim=3):
+    from relayrl_tpu.algorithms import build_algorithm
+
+    algo = build_algorithm(
+        "REINFORCE", env_dir=scratch, obs_dim=obs_dim, act_dim=act_dim,
+        hidden_sizes=[16], traj_per_epoch=4, with_vf_baseline=True)
+    return algo.bundle()
+
+
+def _versioned_bundle(bundle, version):
+    """Params whose value head outputs exactly ``version`` for any obs:
+    aux['v'] reveals which params produced each action (the
+    test_vector_actor atomic-swap probe)."""
+    import copy
+
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    params = jax.tree_util.tree_map(np.asarray, bundle.params)
+    params = copy.deepcopy(params)
+    params["params"]["vf_head"]["kernel"] = np.zeros_like(
+        params["params"]["vf_head"]["kernel"])
+    params["params"]["vf_head"]["bias"] = np.full_like(
+        params["params"]["vf_head"]["bias"], float(version))
+    for layer in params["params"]["vf_trunk"].values():
+        layer["bias"] = np.zeros_like(layer["bias"])
+    return ModelBundle(arch=dict(bundle.arch), params=params,
+                       version=version)
+
+
+def _submit(svc, key, obs, req_id=1, agent_id="t", mask=None):
+    """One decoded request against a live service; returns (event, box) —
+    box['reply'] is the decoded reply once event fires."""
+    from relayrl_tpu.transport.serving import (
+        pack_infer_request,
+        unpack_infer_reply,
+    )
+
+    box: dict = {}
+    done = threading.Event()
+
+    def reply(b):
+        box["reply"] = unpack_infer_reply(b)
+        done.set()
+
+    svc.handle_request(
+        pack_infer_request(agent_id, req_id, key, obs, mask), reply)
+    return done, box
+
+
+class TestServingCodec:
+    def test_scalar_and_array_round_trip(self):
+        """0-d actions/aux must survive the wire as exact 0-d ndarrays
+        (np.ascontiguousarray silently promotes them to 1-d — the shape
+        is captured first)."""
+        from relayrl_tpu.transport.serving import (
+            pack_action_reply,
+            unpack_infer_reply,
+        )
+
+        act = np.asarray(np.int32(2))
+        aux = {"logp_a": np.asarray(np.float32(-1.5)),
+               "vec": np.arange(3, dtype=np.float32)}
+        key = np.array([1, 2], np.uint32)
+        out = unpack_infer_reply(pack_action_reply(7, 3, act, key, aux))
+        assert out["req"] == 7 and out["ver"] == 3
+        assert out["act"].shape == () and out["act"].dtype == np.int32
+        assert out["aux"]["logp_a"].shape == ()
+        assert out["aux"]["logp_a"].dtype == np.float32
+        assert np.array_equal(out["aux"]["vec"], aux["vec"])
+        assert np.frombuffer(out["key"], np.uint32).tolist() == [1, 2]
+
+    def test_request_round_trip_with_mask_and_uint8(self):
+        from relayrl_tpu.transport.serving import (
+            pack_infer_request,
+            unpack_infer_request,
+        )
+
+        key = np.asarray(jax.random.PRNGKey(0))
+        obs = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        mask = np.array([1.0, 0.0], np.float32)
+        out = unpack_infer_request(
+            pack_infer_request("agent-1", 42, key, obs, mask))
+        assert out["id"] == "agent-1" and out["req"] == 42
+        assert out["obs"].dtype == np.uint8 and out["obs"].shape == (3, 4)
+        assert np.array_equal(out["obs"], obs)
+        assert np.array_equal(out["mask"], mask)
+        assert np.array_equal(out["key"], key)
+
+    def test_malformed_request_answers_error(self, tmp_cwd, fresh_registry):
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=2, batch_timeout_ms=1.0)
+        from relayrl_tpu.transport.serving import unpack_infer_reply
+
+        got = []
+        svc.handle_request(b"\x81\xa3junk", lambda b: got.append(
+            unpack_infer_reply(b)))
+        assert got and got[0]["code"] == 0
+
+
+class TestBatchingQueue:
+    def test_size_trigger_close(self, tmp_cwd, fresh_registry):
+        """max_batch requests close the batch immediately (reason
+        "size"), long before the deadline."""
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=4, batch_timeout_ms=5000.0)
+        svc.start()
+        try:
+            keys = np.asarray(jax.random.split(jax.random.PRNGKey(0), 4))
+            obs = np.random.default_rng(0).standard_normal(
+                (4, 6)).astype(np.float32)
+            # Warm the bucket-4 compile OUTSIDE the timed window (the
+            # first dispatch traces + compiles; this test times the batch
+            # CLOSE, not XLA).
+            warm = [_submit(svc, keys[i], obs[i], req_id=100 + i)
+                    for i in range(4)]
+            for done, _ in warm:
+                assert done.wait(60)
+            t0 = time.monotonic()
+            waits = [_submit(svc, keys[i], obs[i], req_id=i + 1)
+                     for i in range(4)]
+            for done, box in waits:
+                assert done.wait(10), "size-triggered batch never closed"
+                assert box["reply"]["code"] == 1
+            assert time.monotonic() - t0 < 2.0, \
+                "size close waited toward the deadline"
+            assert svc._m_batches["size"].total() == 2
+            assert svc._m_batches["deadline"].total() == 0
+        finally:
+            svc.stop()
+
+    def test_deadline_trigger_close(self, tmp_cwd, fresh_registry):
+        """A short batch closes batch_timeout_ms after its FIRST request
+        (reason "deadline") instead of waiting for max_batch forever."""
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=64, batch_timeout_ms=40.0)
+        svc.start()
+        try:
+            key = np.asarray(jax.random.PRNGKey(1))
+            obs = np.zeros(6, np.float32)
+            t0 = time.monotonic()
+            done, box = _submit(svc, key, obs)
+            assert done.wait(10), "deadline-triggered batch never closed"
+            dt = time.monotonic() - t0
+            assert box["reply"]["code"] == 1
+            assert dt >= 0.030, f"closed before the deadline ({dt:.3f}s)"
+            assert svc._m_batches["deadline"].total() == 1
+        finally:
+            svc.stop()
+
+    def test_bucket_selection_and_padding_inert(self, tmp_cwd,
+                                                fresh_registry):
+        """3 requests dispatch at bucket 4 (smallest bucket >= n), and
+        the padded row cannot perturb the real rows: every reply is
+        bit-identical to the unpadded singles."""
+        from relayrl_tpu.runtime.inference import InferenceService
+        from relayrl_tpu.runtime.policy_actor import _fuse_rng
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=8, batch_timeout_ms=30.0,
+                               buckets=[1, 2, 4, 8])
+        shapes = []
+        inner = svc._batched_fn
+
+        def spying(params, keys, obs, masks, explore):
+            shapes.append(tuple(np.asarray(keys).shape))
+            return inner(params, keys, obs, masks, explore)
+
+        svc._batched_fn = spying
+        svc.start()
+        try:
+            keys = np.asarray(jax.random.split(jax.random.PRNGKey(3), 3))
+            obs = np.random.default_rng(1).standard_normal(
+                (3, 6)).astype(np.float32)
+            waits = [_submit(svc, keys[i], obs[i], req_id=i + 1)
+                     for i in range(3)]
+            single = jax.jit(_fuse_rng(svc.policy.step))
+            for i, (done, box) in enumerate(waits):
+                assert done.wait(10)
+                reply = box["reply"]
+                assert reply["code"] == 1
+                act, aux, nk = single(bundle.params, keys[i], obs[i], None)
+                assert np.array_equal(reply["act"], np.asarray(act))
+                for k in aux:
+                    assert np.array_equal(reply["aux"][k],
+                                          np.asarray(aux[k])), k
+                assert np.array_equal(
+                    np.frombuffer(reply["key"], np.uint32),
+                    np.asarray(nk).ravel())
+            assert shapes and shapes[0][0] == 4, \
+                f"expected bucket-4 dispatch, saw {shapes}"
+        finally:
+            svc.stop()
+
+    def test_queue_limit_overload_nack(self, tmp_cwd, fresh_registry):
+        """Beyond serving.queue_limit, submissions answer the typed
+        NACK_OVERLOADED with a retry-after hint instead of queueing
+        unboundedly (the worker is NOT running, so nothing drains)."""
+        from relayrl_tpu.runtime.inference import InferenceService
+        from relayrl_tpu.transport.base import NACK_OVERLOADED
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=4, batch_timeout_ms=5.0,
+                               queue_limit=2, retry_after_s=0.25)
+        key = np.asarray(jax.random.PRNGKey(0))
+        obs = np.zeros(6, np.float32)
+        waits = [_submit(svc, key, obs, req_id=i + 1) for i in range(3)]
+        done, box = waits[2]
+        assert done.wait(5), "overload nack never delivered"
+        assert box["reply"]["code"] == NACK_OVERLOADED
+        assert box["reply"]["retry_after_s"] == pytest.approx(0.25)
+        assert svc._m_rejected.total() == 1
+        assert not waits[0][0].is_set() and not waits[1][0].is_set()
+        # stop() answers the parked requests with a retryable nack too —
+        # a restarting service must not leave clients hanging.
+        svc.stop()
+        for done_i, box_i in waits[:2]:
+            assert done_i.wait(5)
+            assert box_i["reply"]["code"] == NACK_OVERLOADED
+
+    def test_single_params_version_per_batch_under_racing_swapper(
+            self, tmp_cwd, fresh_registry):
+        """A swapper thread hammers version-coded params while requests
+        stream: every reply's aux['v'] must equal its reply 'ver' — no
+        request is ever served params from a version other than the one
+        its batch read under the gate."""
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        base = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(_versioned_bundle(base, 1), max_batch=4,
+                               batch_timeout_ms=2.0)
+        svc.start()
+        stop = threading.Event()
+        next_version = [2]
+
+        def swapper():
+            while not stop.is_set():
+                svc.maybe_swap(_versioned_bundle(base, next_version[0]))
+                next_version[0] += 1
+
+        t = threading.Thread(target=swapper, daemon=True)
+        t.start()
+        try:
+            key = np.asarray(jax.random.PRNGKey(5))
+            obs = np.random.default_rng(2).standard_normal(6).astype(
+                np.float32)
+            mismatches = []
+            for i in range(40):
+                done, box = _submit(svc, key, obs, req_id=i + 1)
+                assert done.wait(10)
+                reply = box["reply"]
+                assert reply["code"] == 1
+                v = float(reply["aux"]["v"])
+                if v != float(reply["ver"]):
+                    mismatches.append((reply["ver"], v))
+                key = np.frombuffer(reply["key"], np.uint32)
+            assert not mismatches, \
+                f"replies served by params of another version: {mismatches[:3]}"
+            assert svc.version >= 2  # swaps actually landed mid-run
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            svc.stop()
+
+    def test_stale_requests_nacked_unserved(self, tmp_cwd,
+                                            fresh_registry):
+        """Ghost-work guard: requests that outlive serving.stale_after_s
+        in the queue (their client timed out and retried) are answered
+        with a retryable nack at batch-gather time, never dispatched —
+        under backlog a retry round must not double-serve."""
+        from relayrl_tpu.runtime.inference import InferenceService
+        from relayrl_tpu.transport.base import NACK_OVERLOADED
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=4, batch_timeout_ms=5.0,
+                               stale_after_s=0.2)
+        key = np.asarray(jax.random.PRNGKey(0))
+        obs = np.zeros(6, np.float32)
+        # Enqueue while the worker is NOT running, let them go stale,
+        # then start the worker: the gather pass must nack both without
+        # serving them.
+        waits = [_submit(svc, key, obs, req_id=i + 1) for i in range(2)]
+        time.sleep(0.4)
+        svc.start()
+        try:
+            for done, box in waits:
+                assert done.wait(10), "stale request never answered"
+                assert box["reply"]["code"] == NACK_OVERLOADED
+                assert "stale" in box["reply"]["error"]
+            assert svc._m_stale.total() == 2
+            assert (svc._m_batches["size"].total()
+                    + svc._m_batches["deadline"].total()) == 0
+            # fresh traffic still serves normally afterwards
+            done, box = _submit(svc, key, obs, req_id=9)
+            assert done.wait(30) and box["reply"]["code"] == 1
+        finally:
+            svc.stop()
+
+    def test_sequence_policies_refused(self, tmp_cwd, fresh_registry):
+        from relayrl_tpu.models import build_policy
+        from relayrl_tpu.runtime.inference import InferenceService
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        arch = {"kind": "transformer_discrete", "obs_dim": 5, "act_dim": 3,
+                "d_model": 16, "n_layers": 1, "n_heads": 2,
+                "max_seq_len": 8}
+        policy = build_policy(arch)
+        bundle = ModelBundle(version=1, arch=dict(arch),
+                             params=policy.init_params(jax.random.PRNGKey(0)))
+        with pytest.raises(ValueError, match="sequence policies"):
+            InferenceService(bundle)
+
+    def test_install_params_owns_memory(self, tmp_cwd, fresh_registry):
+        """The colocated publish feed must copy: mutating the publisher's
+        host tree after install must not change served params."""
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        base = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(_versioned_bundle(base, 1), max_batch=1,
+                               batch_timeout_ms=1.0)
+        svc.start()
+        try:
+            host_tree = jax.tree_util.tree_map(
+                np.array, _versioned_bundle(base, 2).params)
+            assert svc.install_params(2, base.arch, host_tree)
+            host_tree["params"]["vf_head"]["bias"][:] = 777.0
+            key = np.asarray(jax.random.PRNGKey(0))
+            done, box = _submit(svc, key, np.zeros(6, np.float32))
+            assert done.wait(10)
+            assert float(box["reply"]["aux"]["v"]) == 2.0
+        finally:
+            svc.stop()
+
+
+class _FakeServingClient:
+    """Scripted reply stream for the thin client's retry loop."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def request(self, payload, req_id, timeout_s):
+        self.calls += 1
+        step = self.script.pop(0) if self.script else self.script_default
+        if isinstance(step, Exception):
+            raise step
+        out = dict(step)
+        out.setdefault("req", req_id)
+        return out
+
+    def close(self):
+        pass
+
+
+def _bare_client(fake, infer_deadline_s=5.0, request_timeout_s=0.2):
+    """A RemoteActorClient wired straight to a fake serving channel —
+    the retry/breaker/nack loop under test, no sockets."""
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.runtime.inference import RemoteActorClient
+    from relayrl_tpu.transport.retry import CircuitBreaker, RetryPolicy
+
+    client = object.__new__(RemoteActorClient)
+    client._serving = fake
+    client._breaker = CircuitBreaker("test", failure_threshold=3,
+                                     reset_timeout_s=0.2)
+    client._retry = RetryPolicy(base_delay_s=0.01, max_delay_s=0.05)
+    client._fault_infer = None
+    client._rng = np.asarray(jax.random.PRNGKey(0))
+    client._req_counter = 0
+    client._request_timeout_s = request_timeout_s
+    client._infer_deadline_s = infer_deadline_s
+    client.version = -1
+
+    class _T:
+        identity = "bare"
+
+    client.transport = _T()
+    reg = telemetry.get_registry()
+    client._m_request_s = reg.histogram("relayrl_serving_client_request_seconds", "t")
+    client._m_retries = reg.counter("relayrl_serving_client_retries_total", "t")
+    client._m_nacked = reg.counter("relayrl_serving_client_nacked_total", "t")
+    return client
+
+
+def _ok_reply(act=1, ver=3):
+    key = np.array([9, 9], np.uint32)
+    return {"code": 1, "ver": ver, "act": np.asarray(np.int32(act)),
+            "key": key.tobytes(), "aux": {"v": np.asarray(np.float32(0.5))}}
+
+
+class TestClientRetry:
+    def test_overload_nack_honors_retry_after_without_breaker_charge(
+            self, fresh_registry):
+        from relayrl_tpu.transport.base import NACK_OVERLOADED
+
+        fake = _FakeServingClient([
+            {"code": NACK_OVERLOADED, "error": "full",
+             "retry_after_s": 0.15},
+            _ok_reply(),
+        ])
+        client = _bare_client(fake)
+        t0 = time.monotonic()
+        act, aux = client._infer(np.zeros(4, np.float32), None)
+        dt = time.monotonic() - t0
+        assert int(act) == 1 and client.version == 3
+        assert dt >= 0.14, f"retry-after not honored ({dt:.3f}s)"
+        assert fake.calls == 2
+        assert client._breaker.state == "closed"
+        assert client._m_nacked.total() == 1
+        assert client._m_retries.total() == 0  # nacks are not failures
+
+    def test_timeouts_charge_breaker_then_heal(self, fresh_registry):
+        fake = _FakeServingClient([
+            TimeoutError("t"), TimeoutError("t"), TimeoutError("t"),
+            _ok_reply(ver=7),
+        ])
+        client = _bare_client(fake)
+        act, aux = client._infer(np.zeros(4, np.float32), None)
+        assert int(act) == 1 and client.version == 7
+        # 3 failures opened the breaker (threshold 3); the half-open
+        # probe then healed it — the env loop waited, never wedged.
+        assert client._m_retries.total() == 3
+        assert client._breaker.state == "closed"
+
+    def test_deadline_exhaustion_raises(self, fresh_registry):
+        fake = _FakeServingClient([])
+        fake.script_default = None
+
+        class _AlwaysTimeout(_FakeServingClient):
+            def request(self, payload, req_id, timeout_s):
+                self.calls += 1
+                raise TimeoutError("dead service")
+
+        client = _bare_client(_AlwaysTimeout([]), infer_deadline_s=0.6)
+        with pytest.raises(RuntimeError, match="budget"):
+            client._infer(np.zeros(4, np.float32), None)
+
+    def test_error_reply_retries(self, fresh_registry):
+        """A code-0 error (corrupt request drill: the service's decode
+        guard answered) is retryable, not fatal."""
+        fake = _FakeServingClient([
+            {"code": 0, "error": "malformed inference request"},
+            _ok_reply(ver=4),
+        ])
+        client = _bare_client(fake)
+        act, _ = client._infer(np.zeros(4, np.float32), None)
+        assert int(act) == 1 and client.version == 4
+        assert fake.calls == 2
+
+
+def _serving_stack(tmp_path, server_type="zmq", max_batch=4,
+                   batch_timeout_ms=3.0, traj_per_epoch=64,
+                   spool_entries=512):
+    """One TrainingServer with serving enabled + its address block, on a
+    fresh set of ports."""
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    scratch = str(tmp_path)
+    cfg_path = os.path.join(scratch, "serving_cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"serving": {"enabled": True, "max_batch": max_batch,
+                               "batch_timeout_ms": batch_timeout_ms},
+                   "actor": {"spool_entries": spool_entries}}, f)
+    if server_type == "grpc":
+        addrs = {"bind_addr": f"127.0.0.1:{free_port()}",
+                 "native_grpc": False}
+        client_addrs = {"server_addr": addrs["bind_addr"], "probe": False}
+    else:
+        addrs = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+            "serving_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        client_addrs = {
+            "agent_listener_addr": addrs["agent_listener_addr"],
+            "trajectory_addr": addrs["trajectory_addr"],
+            "model_sub_addr": addrs["model_pub_addr"],
+            "serving_addr": addrs["serving_addr"],
+            "probe": False,
+        }
+    server = TrainingServer(
+        "REINFORCE", obs_dim=6, act_dim=3, env_dir=scratch,
+        config_path=cfg_path, server_type=server_type,
+        hyperparams={"traj_per_epoch": traj_per_epoch,
+                     "hidden_sizes": [16], "with_vf_baseline": True},
+        **addrs)
+    return server, cfg_path, client_addrs
+
+
+class TestServedParityE2E:
+    @pytest.mark.parametrize("server_type", ["zmq", "grpc"])
+    def test_bit_identical_served_vs_local(self, tmp_cwd, fresh_registry,
+                                           server_type):
+        """The acceptance lock: at a pinned params version, a thin
+        client's action stream (and its shipped episode BYTES) are
+        identical to a local PolicyActor with the same seed holding the
+        same bundle — on the zmq ROUTER plane and the grpc GetActions
+        RPC."""
+        from relayrl_tpu.runtime.inference import RemoteActorClient
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        server, cfg_path, client_addrs = _serving_stack(
+            tmp_cwd, server_type=server_type, traj_per_epoch=10_000)
+        try:
+            bundle = ModelBundle(
+                version=server.algorithm.version,
+                arch=dict(server.algorithm.bundle().arch),
+                params=server.algorithm.bundle().params)
+            sent_local, sent_remote = [], []
+            local = PolicyActor(bundle, seed=23,
+                                on_send=lambda p: sent_local.append(p))
+            client = RemoteActorClient(
+                config_path=cfg_path, server_type=server_type, seed=23,
+                **client_addrs)
+            client.trajectory._on_send = lambda p: sent_remote.append(p)
+            rng = np.random.default_rng(11)
+            for i in range(10):
+                obs = rng.standard_normal(6).astype(np.float32)
+                reward = 0.0 if i == 0 else 0.5
+                r1 = local.request_for_action(obs, reward=reward)
+                r2 = client.request_for_action(obs, reward=reward)
+                assert np.array_equal(np.asarray(r1.act),
+                                      np.asarray(r2.act)), f"step {i}"
+                assert r1.act.dtype == r2.act.dtype
+                assert r1.act.shape == r2.act.shape
+                for k in r1.data:
+                    assert np.array_equal(np.asarray(r1.data[k]),
+                                          np.asarray(r2.data[k])), (i, k)
+                    assert r1.data[k].dtype == r2.data[k].dtype, (i, k)
+            local.flag_last_action(1.0, terminated=True)
+            client.flag_last_action(1.0, terminated=True)
+            assert sent_local == sent_remote and len(sent_local) == 1, \
+                "served episode bytes differ from the local actor's"
+            client.disable_agent()
+        finally:
+            server.disable_server()
+
+    def test_masked_served_parity(self, tmp_cwd, fresh_registry):
+        from relayrl_tpu.runtime.inference import RemoteActorClient
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        server, cfg_path, client_addrs = _serving_stack(
+            tmp_cwd, traj_per_epoch=10_000)
+        try:
+            bundle = ModelBundle(
+                version=server.algorithm.version,
+                arch=dict(server.algorithm.bundle().arch),
+                params=server.algorithm.bundle().params)
+            local = PolicyActor(bundle, seed=4)
+            client = RemoteActorClient(config_path=cfg_path, seed=4,
+                                       **client_addrs)
+            mask = np.array([1.0, 0.0, 1.0], np.float32)
+            rng = np.random.default_rng(3)
+            for _ in range(5):
+                obs = rng.standard_normal(6).astype(np.float32)
+                r1 = local.request_for_action(obs, mask=mask)
+                r2 = client.request_for_action(obs, mask=mask)
+                assert np.array_equal(np.asarray(r1.act),
+                                      np.asarray(r2.act))
+                assert int(np.asarray(r2.act)) != 1  # mask respected
+            client.disable_agent()
+        finally:
+            server.disable_server()
+
+    def test_trajectories_train_and_model_version_advances(
+            self, tmp_cwd, fresh_registry):
+        """The full loop: thin-client episodes reach the learner through
+        the UNCHANGED trajectory plane, updates publish, and the
+        colocated service starts serving the new version (visible as the
+        client's model_version advancing) — with batching provably
+        active (occupancy histogram saw > 1)."""
+        from relayrl_tpu.runtime.inference import RemoteActorClient
+
+        server, cfg_path, client_addrs = _serving_stack(
+            tmp_cwd, traj_per_epoch=2, max_batch=4, batch_timeout_ms=4.0)
+        try:
+            clients = [RemoteActorClient(config_path=cfg_path, seed=s,
+                                         identity=f"thin-{s}",
+                                         **client_addrs)
+                       for s in range(3)]
+            stop = threading.Event()
+
+            def drive(client, seed):
+                rng = np.random.default_rng(seed)
+                while not stop.is_set():
+                    obs = rng.standard_normal(6).astype(np.float32)
+                    for _ in range(8):
+                        client.request_for_action(obs, reward=1.0)
+                        obs = rng.standard_normal(6).astype(np.float32)
+                        if stop.is_set():
+                            break
+                    client.flag_last_action(1.0, terminated=True)
+
+            threads = [threading.Thread(target=drive, args=(c, i),
+                                        daemon=True)
+                       for i, c in enumerate(clients)]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 60
+            while (time.monotonic() < deadline
+                   and (server.stats["updates"] < 2
+                        or max(c.model_version for c in clients) < 2)):
+                time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert server.stats["updates"] >= 2, "thin-client episodes never trained"
+            assert max(c.model_version for c in clients) >= 2, \
+                "the colocated service never served the published version"
+            occ = server.inference._m_occupancy.totals()
+            counts, total, n = occ
+            assert n > 0 and total / n > 1.0, \
+                f"batching never engaged (mean occupancy {total}/{n})"
+            for c in clients:
+                c.disable_agent()
+        finally:
+            server.disable_server()
+
+
+class TestFaultPlaneAndHeal:
+    def test_agent_infer_fault_site_drop_and_corrupt_heal(
+            self, tmp_cwd, fresh_registry):
+        """agent.infer chaos: deterministic drops + corruption on the
+        request plane — every action still lands (drop → timeout retry,
+        corrupt → service decode-guard error reply → retry), and the
+        injection ledger counted the faults."""
+        from relayrl_tpu import faults
+        from relayrl_tpu.faults import FaultPlan
+        from relayrl_tpu.runtime.inference import (
+            InferenceService,
+            RemoteActorClient,
+        )
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=2, batch_timeout_ms=2.0)
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        svc.bind_zmq(addr)
+        svc.start()
+        plan = FaultPlan.from_dict({"seed": 3, "rules": [
+            {"site": "agent.infer", "op": "drop", "prob": 0.2},
+            {"site": "agent.infer", "op": "corrupt", "prob": 0.2},
+        ]})
+        faults.install_plan(plan)
+        try:
+            cfg_path = os.path.join(str(tmp_cwd), "cfg.json")
+            with open(cfg_path, "w") as f:
+                json.dump({"actor": {"spool_entries": 0},
+                           "serving": {"request_timeout_s": 0.3}}, f)
+            client = RemoteActorClient(
+                config_path=cfg_path, seed=1, serving_addr=addr,
+                probe=False,
+                agent_listener_addr=f"tcp://127.0.0.1:{free_port()}",
+                trajectory_addr=f"tcp://127.0.0.1:{free_port()}",
+                model_sub_addr=f"tcp://127.0.0.1:{free_port()}")
+            rng = np.random.default_rng(0)
+            for _ in range(30):
+                client.request_for_action(
+                    rng.standard_normal(6).astype(np.float32), reward=1.0)
+            site = plan.site("agent.infer")
+            assert site is not None and site.injected > 0, \
+                "the drill injected nothing"
+            client.disable_agent()
+        finally:
+            faults.install_plan(None)
+            svc.stop()
+
+    def test_killed_service_heals_clients_without_wedging(
+            self, tmp_cwd, fresh_registry):
+        """The chaos drill: the inference service dies mid-run and
+        restarts; a stepping client rides the breaker/backoff through
+        the outage and completes every action — the env loop never
+        wedges and never loses a step."""
+        from relayrl_tpu.runtime.inference import (
+            InferenceService,
+            RemoteActorClient,
+        )
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        svc = InferenceService(bundle, max_batch=2, batch_timeout_ms=2.0)
+        svc.bind_zmq(addr)
+        svc.start()
+        cfg_path = os.path.join(str(tmp_cwd), "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"actor": {"spool_entries": 0},
+                       "serving": {"request_timeout_s": 0.25,
+                                   "infer_deadline_s": 60.0}}, f)
+        client = RemoteActorClient(
+            config_path=cfg_path, seed=2, serving_addr=addr, probe=False,
+            agent_listener_addr=f"tcp://127.0.0.1:{free_port()}",
+            trajectory_addr=f"tcp://127.0.0.1:{free_port()}",
+            model_sub_addr=f"tcp://127.0.0.1:{free_port()}")
+        steps = []
+        stop_at = 60
+
+        def loop():
+            rng = np.random.default_rng(1)
+            for _ in range(stop_at):
+                steps.append(client.request_for_action(
+                    rng.standard_normal(6).astype(np.float32),
+                    reward=1.0))
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        # Let it step, kill the service, hold a real outage, restart.
+        deadline = time.monotonic() + 20
+        while len(steps) < 5 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(steps) >= 5
+        svc.stop()
+        time.sleep(1.0)
+        svc2 = InferenceService(bundle, max_batch=2, batch_timeout_ms=2.0)
+        svc2.bind_zmq(addr)
+        svc2.start()
+        t.join(timeout=90)
+        try:
+            assert not t.is_alive(), "env loop wedged through the outage"
+            assert len(steps) == stop_at, \
+                f"actions lost across the outage ({len(steps)}/{stop_at})"
+        finally:
+            client.disable_agent()
+            svc2.stop()
+
+
+class TestServingDisabledFailsFast:
+    def test_grpc_without_serving_raises_pointed_error(self, tmp_cwd,
+                                                       fresh_registry):
+        """A grpc fleet whose server has serving.enabled false answers
+        GetActions with the PERMANENT NACK_UNAVAILABLE — the thin client
+        must fail fast with the pointed message, not retry a
+        misconfiguration into a 60s deadline exhaustion."""
+        from relayrl_tpu.runtime.inference import RemoteActorClient
+        from relayrl_tpu.runtime.server import TrainingServer
+
+        bind_addr = f"127.0.0.1:{free_port()}"
+        server = TrainingServer(
+            "REINFORCE", obs_dim=6, act_dim=3, env_dir=str(tmp_cwd),
+            server_type="grpc", native_grpc=False, bind_addr=bind_addr,
+            hyperparams={"traj_per_epoch": 64, "hidden_sizes": [16]})
+        try:
+            assert server.inference is None  # serving defaults off
+            client = RemoteActorClient(
+                server_type="grpc", seed=1, probe=False,
+                server_addr=bind_addr)
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError,
+                               match="serving is not enabled"):
+                client.request_for_action(np.zeros(6, np.float32))
+            assert time.monotonic() - t0 < 10, \
+                "fail-fast path retried toward the deadline"
+            client.disable_agent()
+        finally:
+            server.disable_server()
+
+
+class TestAsyncEmitLifecycle:
+    def test_close_then_restart_emitter(self, tmp_cwd, fresh_registry):
+        """The emitter thread is restartable: close() (the
+        disable_agent path) then start_emitter() (the enable path) must
+        leave a working host — NOT a depth-2 hand-off deadlock on the
+        third window — and close() must not leak the thread."""
+        import jax as _jax
+
+        from relayrl_tpu.models import build_policy
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        arch = {"kind": "mlp_discrete", "obs_dim": 4, "act_dim": 2,
+                "hidden_sizes": [16]}
+        policy = build_policy(arch)
+        bundle = ModelBundle(
+            version=0, arch=arch,
+            params=policy.init_params(_jax.random.PRNGKey(0)))
+        sink = []
+        host = AnakinActorHost(bundle, "CartPole-v1", num_envs=2,
+                               unroll_length=8, async_emit=True,
+                               on_send=lambda lane, p: sink.append(p),
+                               seed=0)
+        host.rollout()
+        assert host.flush_emits()
+        n_before = len(sink)
+        assert n_before >= 0
+        host.close()
+        assert host._emit_thread is None
+        host.start_emitter()
+        for _ in range(4):  # past the depth-2 hand-off: would deadlock
+            host.rollout()  # if the emitter were still stopped
+        assert host.flush_emits()
+        assert len(sink) > n_before
+        host.close()
+
+
+class TestConfig:
+    def test_serving_params_defaults_and_clamps(self, tmp_cwd):
+        from relayrl_tpu.config import ConfigLoader
+
+        cfg_path = tmp_cwd / "cfg.json"
+        cfg_path.write_text(json.dumps({"serving": {
+            "enabled": True, "max_batch": "bogus",
+            "batch_timeout_ms": -5, "buckets": [8, 2, "x"],
+            "queue_limit": 0}}))
+        p = ConfigLoader(None, str(cfg_path)).get_serving_params()
+        assert p["enabled"] is True
+        assert p["max_batch"] == 16          # malformed → default
+        assert p["batch_timeout_ms"] == 0.0  # negative clamps to 0
+        assert p["buckets"] is None          # malformed list → derived
+        assert p["queue_limit"] == 1         # floor 1
+
+    def test_bucket_list_covers_max_batch(self, tmp_cwd):
+        from relayrl_tpu.config import ConfigLoader
+
+        cfg_path = tmp_cwd / "cfg.json"
+        cfg_path.write_text(json.dumps({"serving": {
+            "max_batch": 32, "buckets": [2, 8]}}))
+        p = ConfigLoader(None, str(cfg_path)).get_serving_params()
+        assert p["buckets"] == [2, 8, 32]
+
+    def test_default_buckets_powers_of_two(self):
+        from relayrl_tpu.runtime.inference import default_buckets
+
+        assert default_buckets(16) == [1, 2, 4, 8, 16]
+        assert default_buckets(24) == [1, 2, 4, 8, 16, 24]
+        assert default_buckets(1) == [1]
+
+    def test_constructor_buckets_clamped_to_max_batch(self, tmp_cwd,
+                                                      fresh_registry):
+        """Direct construction with buckets smaller than max_batch must
+        get the same cover-clamp the ConfigLoader applies — otherwise a
+        size-closed full batch would pick a bucket BELOW its size and
+        every full batch would fail the pad computation forever."""
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        bundle = _reinforce_bundle(str(tmp_cwd))
+        svc = InferenceService(bundle, max_batch=16, buckets=[4, 8])
+        assert svc.buckets[-1] == 16
+
+    def test_remote_host_mode_accepted(self, tmp_cwd):
+        from relayrl_tpu.config import ConfigLoader
+
+        cfg_path = tmp_cwd / "cfg.json"
+        cfg_path.write_text(json.dumps({"actor": {"host_mode": "remote"}}))
+        p = ConfigLoader(None, str(cfg_path)).get_actor_params()
+        assert p["host_mode"] == "remote"
