@@ -399,6 +399,7 @@ def anakin_host_loop(cfg: dict) -> list[dict]:
         # bench_soak --per-record forces False for A/B rows.
         columnar_wire=cfg.get("columnar_wire"),
         async_emit=cfg.get("async_emit"),
+        emit_coalesce_frames=cfg.get("emit_coalesce_frames"),
         **addr_overrides,
     )
     receipts: list[tuple[int, int]] = []

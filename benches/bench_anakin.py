@@ -82,7 +82,7 @@ def run_vector_baseline(lanes: int, min_steps: int = 4000,
 
 
 def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
-               async_emit: bool = False,
+               async_emit: bool = False, coalesce: int = 1,
                min_steps: int = 20000, min_wall_s: float = 2.0) -> dict:
     """Fused rollout at (lanes, unroll, wire): the full
     dispatch / encode / ingest split per row —
@@ -101,6 +101,7 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
                            unroll_length=unroll,
                            columnar_wire=(wire == "columnar"),
                            async_emit=async_emit,
+                           emit_coalesce_frames=coalesce,
                            on_send=lambda lane, p: sink.append(p),
                            seed=0)
     host.rollout()  # warmup + compile
@@ -135,8 +136,20 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
     decoded_steps = 0
     t_ing = time.perf_counter()
     if wire == "columnar":
+        from relayrl_tpu.transport.base import (
+            BATCH_KIND_FRAMES,
+            batch_kind,
+            split_batch,
+        )
+
         for payload in sink:
-            decoded_steps += parse_frame(payload, agent_id="bench").n_steps
+            # emit_coalesce_frames > 1 packs several frames into one
+            # container — the same split the staging worker runs.
+            parts = (split_batch(payload)
+                     if batch_kind(payload) == BATCH_KIND_FRAMES
+                     else (payload,))
+            for part in parts:
+                decoded_steps += parse_frame(part, agent_id="bench").n_steps
         ingest_path = "parse_frame"
     elif native_codec_available():
         dec = NativeDecoder()
@@ -155,6 +168,7 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
     return {
         "lanes": lanes, "unroll_length": unroll, "wire": wire,
         "emit": "async" if async_emit else "sync",
+        "emit_coalesce_frames": coalesce,
         "windows": windows, "env_steps_total": total,
         "rollout_steps_per_sec": round(total / dispatch_s, 1),
         "e2e_steps_per_sec": round(total / wall, 1),
@@ -194,12 +208,19 @@ def main():
     # twice — sync emit (encode on the rollout thread) vs async emit
     # (dedicated emitter thread, overlapping the next dispatch). The
     # records wire keeps its single sync row for the wire-form A/B.
-    variants = [("columnar", False), ("columnar", True), ("records", False)]
+    # (wire, async_emit, emit_coalesce_frames): the coalesce variant
+    # (ISSUE 11 satellite — ROADMAP item 5's next host shave) packs up
+    # to 8 completed segments per lane into one send; relays
+    # batch-forward with the same container, so this column measures
+    # the shared framing helper at the leaf.
+    variants = [("columnar", False, 1), ("columnar", False, 8),
+                ("columnar", True, 1), ("records", False, 1)]
     for lanes in lanes_grid:
         for unroll in unroll_grid:
-            for wire, async_emit in variants:
+            for wire, async_emit, coalesce in variants:
                 row = run_anakin(
                     lanes, unroll, wire=wire, async_emit=async_emit,
+                    coalesce=coalesce,
                     min_steps=2000 if is_quick else 20000,
                     min_wall_s=0.5 if is_quick else 2.0)
                 row["speedup_rollout_vs_vector"] = round(
@@ -208,15 +229,17 @@ def main():
                     row["e2e_steps_per_sec"] / vector_rates[lanes], 1)
                 emit("anakin_fused_rollout",
                      {"lanes": lanes, "unroll": unroll, "wire": wire,
-                      "emit": row["emit"]},
+                      "emit": row["emit"], "coalesce": coalesce},
                      row["e2e_steps_per_sec"], "env_steps/s")
                 rows.append({"bench": "anakin_fused_rollout", **row})
                 cell = e2e_by_cell.setdefault((lanes, unroll), {})
-                cell[f"{wire}_async" if async_emit else wire] = \
-                    row["e2e_steps_per_sec"]
-                if wire == "columnar" and not async_emit and (
-                        best is None or (row["rollout_steps_per_sec"]
-                                         > best["rollout_steps_per_sec"])):
+                key = (f"{wire}_coalesce" if coalesce > 1
+                       else f"{wire}_async" if async_emit else wire)
+                cell[key] = row["e2e_steps_per_sec"]
+                if wire == "columnar" and not async_emit and coalesce == 1 \
+                        and (best is None
+                             or (row["rollout_steps_per_sec"]
+                                 > best["rollout_steps_per_sec"])):
                     best = row
 
     headline = {
@@ -253,6 +276,15 @@ def main():
                 cell["columnar_async"] / cell["columnar"], 2)
             for (lanes, unroll), cell in sorted(e2e_by_cell.items())
             if cell.get("columnar_async") and cell.get("columnar")},
+        # The emit-coalesce shave (ISSUE 11 satellite): e2e with up to 8
+        # segments per send vs one-frame-per-send at the same cell —
+        # matters most where short episodes complete many segments per
+        # window (small unroll is the short-segment proxy here).
+        "speedup_emit_coalesce_vs_single": {
+            f"{lanes}x{unroll}": round(
+                cell["columnar_coalesce"] / cell["columnar"], 2)
+            for (lanes, unroll), cell in sorted(e2e_by_cell.items())
+            if cell.get("columnar_coalesce") and cell.get("columnar")},
         "note": ("columnar wire (ISSUE 9): whole rollout segments ship "
                  "as contiguous frames — the per-step record assembly + "
                  "per-record msgpack that bounded e2e is gone; every row "

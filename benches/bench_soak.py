@@ -92,6 +92,137 @@ def _transport_addrs(transport: str, server_type_in_server: bool = True
     return server, worker
 
 
+def _snapshot_metric(snap: dict, name: str,
+                     labels: dict | None = None) -> float | None:
+    """One metric value out of a /snapshot document (None if absent).
+    ``labels`` matches as a SUBSET — instance-distinguishing labels the
+    caller doesn't care about (the subscriber gauge's ``bind``) don't
+    break the lookup."""
+    for m in snap.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        have = m.get("labels") or {}
+        if labels is not None and any(have.get(k) != v
+                                      for k, v in labels.items()):
+            continue
+        return m.get("value")
+    return None
+
+
+def _leaf_arrival_ids(agent_id: str, payload: bytes) -> list[str]:
+    """Clean LEAF agent ids for one ingest arrival — unwrapping relay
+    batch containers exactly the way the server's ingest funnel does
+    (the ONE copy both the soak's attribution set and the chaos drill's
+    MTTR accounting share)."""
+    from relayrl_tpu.transport.base import (
+        BATCH_KIND_ENVELOPES,
+        batch_kind,
+        split_agent_seq,
+        split_batch,
+        unpack_trajectory_envelope,
+    )
+
+    if batch_kind(payload) != BATCH_KIND_ENVELOPES:
+        return [split_agent_seq(agent_id)[0]]
+    out = []
+    for part in split_batch(payload):
+        try:
+            inner_id, _ = unpack_trajectory_envelope(part)
+        except Exception:
+            continue
+        out.append(split_agent_seq(inner_id)[0])
+    return out
+
+
+def _spawn_relay_tree(scratch: str, upstream_worker_addrs: dict,
+                      n_relays: int, batch_max: int = 8,
+                      tag: str = "relay") -> tuple[list, list, str]:
+    """Spawn ``n_relays`` relay-node processes (``python -m
+    relayrl_tpu.relay``) subscribed to the root at
+    ``upstream_worker_addrs`` (zmq agent-side keys), each binding a
+    fresh downstream triple. Returns ``(procs, infos, stop_file)`` —
+    ``infos[r]["worker_addrs"]`` is what the subtree's workers use, and
+    each relay writes stats + telemetry snapshot to
+    ``infos[r]["result_path"]`` once the stop file appears."""
+    stop_file = os.path.join(scratch, f"{tag}_stop")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root
+    procs, infos = [], []
+    for r in range(n_relays):
+        name = f"{tag}{r}"
+        down = {
+            "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+            "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+            "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+        }
+        info = {
+            "name": name,
+            "downstream": down,
+            "worker_addrs": {
+                "agent_listener_addr": down["agent_listener_addr"],
+                "trajectory_addr": down["trajectory_addr"],
+                "model_sub_addr": down["model_pub_addr"],
+            },
+            "spool_dir": os.path.join(scratch, f"{name}_spool"),
+            "ready_file": os.path.join(scratch, f"{name}_ready"),
+            "result_path": os.path.join(scratch, f"{name}_result.json"),
+        }
+        cfg = {
+            "name": name,
+            "upstream_type": "zmq",
+            "upstream": {**upstream_worker_addrs, "probe": False},
+            "downstream_type": "zmq",
+            "downstream": down,
+            "spool_dir": info["spool_dir"],
+            "batch_max": batch_max,
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "relayrl_tpu.relay",
+             "--json", json.dumps(cfg),
+             "--ready-file", info["ready_file"],
+             "--stop-file", stop_file,
+             "--result-path", info["result_path"]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+        infos.append(info)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if all(os.path.exists(i["ready_file"]) for i in infos):
+            break
+        for p, i in zip(procs, infos):
+            if p.poll() is not None:
+                out, _ = p.communicate()
+                raise RuntimeError(
+                    f"relay {i['name']} died during bring-up "
+                    f"(rc={p.returncode}):\n{out[-3000:]}")
+        time.sleep(0.1)
+    else:
+        raise RuntimeError("relay tree never became ready")
+    return procs, infos, stop_file
+
+
+def _stop_relay_tree(procs: list, infos: list, stop_file: str) -> list[dict]:
+    """Signal the tree down and collect per-relay result rows."""
+    with open(stop_file, "w") as f:
+        f.write("stop")
+    rows = []
+    for p, info in zip(procs, infos):
+        try:
+            out, _ = p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        row = _read_json(info["result_path"])
+        if row is None:
+            raise RuntimeError(
+                f"relay {info['name']} left no result "
+                f"(rc={p.returncode}):\n{(out or '')[-3000:]}")
+        rows.append(row)
+    return rows
+
+
 def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              duration_s: float = 30.0, episode_len: int = 25,
              obs_dim: int = 8, act_dim: int = 4,
@@ -101,7 +232,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              jax_env: str = "CartPole-v1",
              columnar_wire: bool | None = None,
              serving: bool = False, max_batch: int | None = None,
-             batch_timeout_ms: float = 5.0) -> dict:
+             batch_timeout_ms: float = 5.0, relays: int = 0,
+             emit_coalesce_frames: int | None = None) -> dict:
     """``vector=True`` runs the fleet as vector actor hosts: each worker
     process is ONE VectorAgent stepping ``agents_per_proc`` logical
     agents through a single batched jitted policy dispatch (the
@@ -207,7 +339,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     orig_on_traj = server.transport.on_trajectory
 
     def counting_on_traj(agent_id, payload):
-        seen_traj_agents.add(split_agent_seq(agent_id)[0])
+        # Relay batch-forwards arrive as ONE envelope carrying N inner
+        # envelopes — attribution lives on the inner ids.
+        seen_traj_agents.update(_leaf_arrival_ids(agent_id, payload))
         orig_on_traj(agent_id, payload)
 
     server.transport.on_trajectory = counting_on_traj
@@ -221,6 +355,21 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
 
         server.transport.on_trajectory_decoded = counting_decoded
 
+    # Hierarchical relay tree (ISSUE 11): relays > 0 stands N relay
+    # processes between the root server and the workers — the root's
+    # broadcast plane then serves RELAYS streams while the workers'
+    # whole fleet rides the relays' fan-out planes. zmq only (the
+    # committed topology); each worker process parks its subtree on
+    # relay (worker_id % relays).
+    relay_procs: list = []
+    relay_infos: list = []
+    relay_stop = None
+    if relays:
+        if transport != "zmq" or serving:
+            raise ValueError("--relays topology rows run on plain zmq")
+        relay_procs, relay_infos, relay_stop = _spawn_relay_tree(
+            scratch, worker_addrs, relays)
+
     n_procs = (n_actors + agents_per_proc - 1) // agents_per_proc
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -232,6 +381,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         n_here = min(agents_per_proc, n_actors - w * agents_per_proc)
         result_path = os.path.join(scratch, f"worker_{w}.json")
         result_paths.append(result_path)
+        w_addrs = (relay_infos[w % relays]["worker_addrs"] if relays
+                   else worker_addrs)
         cfg = {
             "worker_id": w, "agents_per_proc": n_here,
             "duration_s": duration_s, "episode_len": episode_len,
@@ -248,7 +399,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             "result_path": result_path, "vector": vector,
             "anakin": anakin, "unroll_length": unroll_length,
             "jax_env": jax_env, "columnar_wire": columnar_wire,
-            **worker_addrs,
+            "emit_coalesce_frames": emit_coalesce_frames,
+            **w_addrs,
         }
         procs.append(subprocess.Popen(
             [sys.executable,
@@ -290,6 +442,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     agents = []
     for path, out, p in zip(result_paths, outs, procs):
         if p.returncode != 0 or not os.path.exists(path):
+            for rp in relay_procs:  # don't leak the tree on a bad row
+                rp.kill()
             raise RuntimeError(f"soak worker failed (rc={p.returncode}):\n{out}")
         with open(path) as f:
             agents.extend(json.load(f)["agents"])
@@ -335,11 +489,15 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             else "vector" if vector else "process")
     result = {
         "bench": (f"soak_multi_actor_{transport}"
-                  + ("" if mode == "process" else f"_{mode}")),
+                  + ("" if mode == "process" else f"_{mode}")
+                  + ("_relay" if relays else "")),
         "config": {"actors": n_actors, "algorithm": algorithm,
                    "duration_s": duration_s,
                    "episode_len": episode_len, "traj_per_epoch": traj_per_epoch,
                    "mode": mode,
+                   **({"relays": relays} if relays else {}),
+                   **({"emit_coalesce_frames": emit_coalesce_frames}
+                      if emit_coalesce_frames else {}),
                    **({"max_batch": max_batch,
                        "batch_timeout_ms": batch_timeout_ms}
                       if serving else {}),
@@ -397,6 +555,39 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     if serving:
         result["serving"] = _serving_row_block(server, agents,
                                                result["telemetry"])
+    if relays:
+        # The acceptance evidence (ISSUE 11): the ROOT's live stream
+        # count (relayrl_transport_subscribers, read while the tree is
+        # still up) must equal the RELAY count — the whole actor fleet
+        # rides the relays' fan-out planes — and bytes-per-publish at
+        # the root then tracks relay count, not actor count.
+        snap = result["telemetry"]
+        pub_total = _snapshot_metric(
+            snap, "relayrl_transport_publish_total",
+            {"backend": "zmq"}) or 0
+        pub_bytes = _snapshot_metric(
+            snap, "relayrl_transport_publish_bytes_total",
+            {"backend": "zmq"}) or 0
+        relay_rows = _stop_relay_tree(relay_procs, relay_infos, relay_stop)
+        result["relay_topology"] = {
+            "relays": relays,
+            "workers": n_procs,
+            "logical_actors": n_actors,
+            "root_subscribers": _snapshot_metric(
+                snap, "relayrl_transport_subscribers",
+                {"backend": "zmq"}),
+            "root_publishes": pub_total,
+            "root_publish_bytes_total": pub_bytes,
+            "root_bytes_per_publish": (round(pub_bytes / pub_total, 1)
+                                       if pub_total else None),
+            "relays_detail": [
+                {"name": row["relay"], "stats": row["stats"],
+                 "downstream_subscribers": _snapshot_metric(
+                     row["telemetry"], "relayrl_transport_subscribers",
+                     {"backend": "zmq"}),
+                 "telemetry": row["telemetry"]}
+                for row in relay_rows],
+        }
     server.disable_server()
     return result
 
@@ -1185,6 +1376,210 @@ def run_chaos(transport: str = "zmq", n_actors: int = 8,
     return result
 
 
+def run_relay_chaos(n_relays: int = 2, agents_per_proc: int = 4,
+                    duration_s: float = 24.0, episode_len: int = 10,
+                    obs_dim: int = 6, act_dim: int = 3,
+                    traj_per_epoch: int = 8,
+                    outage_s: float = 2.0) -> dict:
+    """Relay-SIGKILL chaos drill (ISSUE 11 acceptance): a live zmq fleet
+    behind a relay tree loses a MID-TREE relay to SIGKILL a third of the
+    way into the window; a replacement binds the same fan-out addresses
+    with the same spool directory. Asserts the PR 6 invariants one level
+    up — after the workers' final spool flush and the replacement
+    relay's spool restore/replay, every leaf sequence is accepted
+    exactly once at the root (``accepted == max_seq == sent`` per lane,
+    replay surplus visible as duplicates) — and reports MTTR: kill →
+    first orphaned-subtree trajectory accepted at the root again."""
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    _fresh_bench_registry(f"relay-chaos-{n_relays}")
+    scratch = tempfile.mkdtemp(prefix="relayrl_relaychaos_")
+    addrs, worker_addrs = _transport_addrs("zmq")
+    hp = {"traj_per_epoch": traj_per_epoch, "hidden_sizes": [32, 32]}
+    server = TrainingServer("REINFORCE", obs_dim=obs_dim, act_dim=act_dim,
+                            env_dir=scratch, hyperparams=hp, **addrs)
+    server.wait_warmup(timeout=120)
+    arrivals: list[tuple[float, str]] = []  # (wall, clean LEAF agent id)
+    orig_on_traj = server.transport.on_trajectory
+
+    def counting_on_traj(agent_id, payload):
+        # MTTR attribution needs LEAF ids — same unwrap as run_soak's.
+        now = time.time()
+        for leaf in _leaf_arrival_ids(agent_id, payload):
+            if len(arrivals) < 500_000:
+                arrivals.append((now, leaf))
+        orig_on_traj(agent_id, payload)
+
+    server.transport.on_trajectory = counting_on_traj
+
+    relay_procs, relay_infos, relay_stop = _spawn_relay_tree(
+        scratch, worker_addrs, n_relays)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(_HERE)
+    n_procs = n_relays  # one worker process per subtree
+    procs, result_paths = [], []
+    for w in range(n_procs):
+        result_path = os.path.join(scratch, f"worker_{w}.json")
+        result_paths.append(result_path)
+        cfg = {
+            "worker_id": w, "agents_per_proc": agents_per_proc,
+            "duration_s": duration_s, "episode_len": episode_len,
+            "obs_dim": obs_dim, "scratch": scratch,
+            "handshake_timeout_s": 180.0,
+            "start_barrier": True, "go_timeout_s": 360.0,
+            "receipt_grace_s": 4.0,
+            "chaos_telemetry": True, "final_replay": True,
+            "flush_deadline_s": 60.0,
+            "result_path": result_path,
+            **relay_infos[w % n_relays]["worker_addrs"],
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "_soak_worker.py"),
+             json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+
+    ready_deadline = time.time() + 300
+    while time.time() < ready_deadline:
+        if sum(os.path.exists(os.path.join(scratch, f"ready_{w}"))
+               for w in range(n_procs)) == n_procs:
+            break
+        time.sleep(0.1)
+    with open(os.path.join(scratch, "go"), "w") as f:
+        f.write(str(time.time()))
+
+    # The drill: SIGKILL relay 0 a third of the way in; its subtree
+    # (worker 0's agents) goes dark at the root until the replacement
+    # binds the same fan-out addresses and restores the same spool.
+    time.sleep(duration_s / 3.0)
+    kill_wall = time.time()
+    relay_procs[0].kill()
+    relay_procs[0].wait(timeout=30)
+    time.sleep(outage_s)
+    repl_info = dict(relay_infos[0])
+    repl_info["name"] = relay_infos[0]["name"] + "-replacement"
+    repl_info["ready_file"] = os.path.join(scratch, "repl_ready")
+    repl_info["result_path"] = os.path.join(scratch, "repl_result.json")
+    repl_cfg = {
+        "name": repl_info["name"],
+        "upstream_type": "zmq",
+        "upstream": {**worker_addrs, "probe": False},
+        "downstream_type": "zmq",
+        "downstream": relay_infos[0]["downstream"],
+        "spool_dir": relay_infos[0]["spool_dir"],  # the crash handoff
+        "batch_max": 8,
+    }
+    repl_proc = subprocess.Popen(
+        [sys.executable, "-m", "relayrl_tpu.relay",
+         "--json", json.dumps(repl_cfg),
+         "--ready-file", repl_info["ready_file"],
+         "--stop-file", relay_stop,
+         "--result-path", repl_info["result_path"]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    restart_wall = time.time()
+
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=duration_s + 720)
+        outs.append(out)
+
+    agents = []
+    worker_snapshots = []
+    orphan_ids: set[str] = set()  # worker 0's wire identities (relay 0)
+    for w, (path, out, p) in enumerate(zip(result_paths, outs, procs)):
+        if p.returncode != 0 or not os.path.exists(path):
+            for rp in relay_procs[1:] + [repl_proc]:
+                rp.kill()
+            raise RuntimeError(
+                f"relay-chaos worker failed (rc={p.returncode}):"
+                f"\n{out[-3000:]}")
+        with open(path) as f:
+            data = json.load(f)
+        agents.extend(data["agents"])
+        if w == 0:
+            for a in data["agents"]:
+                orphan_ids.update((a.get("sent_counts") or {}))
+        if data.get("telemetry"):
+            worker_snapshots.append(data["telemetry"])
+
+    # Tree down (flushes each relay's spool upstream), then reconcile.
+    relay_rows = _stop_relay_tree(
+        relay_procs[1:] + [repl_proc],
+        relay_infos[1:] + [repl_info], relay_stop)
+    server.drain(timeout=120)
+
+    sent_counts: dict[str, int] = {}
+    for a in agents:
+        for ident, n in (a.get("sent_counts") or {}).items():
+            sent_counts[ident] = max(sent_counts.get(ident, 0), int(n))
+    acct_deadline = time.time() + 90
+    while time.time() < acct_deadline:
+        rows = server.ingest_accounting()["agents"]
+        if all(ident in rows and rows[ident]["max_seq"] == n
+               and rows[ident]["contiguous"]
+               for ident, n in sent_counts.items()):
+            break
+        time.sleep(0.5)
+        server.drain(timeout=30)
+    acct = server.ingest_accounting()
+    rows = acct["agents"]
+    zero_loss = all(ident in rows and rows[ident]["max_seq"] == n
+                    and rows[ident]["contiguous"]
+                    for ident, n in sent_counts.items())
+
+    # MTTR: first orphaned-subtree (worker 0, behind the killed relay)
+    # trajectory accepted at the root after the kill. The other subtree
+    # keeps flowing throughout — the tree's blast-radius property,
+    # reported alongside.
+    post_kill = [t for t, ident in arrivals
+                 if t >= kill_wall and ident in orphan_ids]
+    mttr_s = round(min(post_kill) - kill_wall, 1) if post_kill else None
+    other_flow = sum(1 for t, ident in arrivals
+                     if kill_wall <= t < restart_wall
+                     and ident not in orphan_ids)
+
+    from relayrl_tpu import telemetry
+
+    telemetry_snapshot = telemetry.get_registry().snapshot()
+    result = {
+        "bench": "relay_chaos_zmq",
+        "config": {"relays": n_relays, "agents_per_proc": agents_per_proc,
+                   "actors": n_procs * agents_per_proc,
+                   "duration_s": duration_s, "episode_len": episode_len,
+                   "traj_per_epoch": traj_per_epoch,
+                   "outage_s": round(restart_wall - kill_wall, 1),
+                   "host_cores": os.cpu_count()},
+        "agents_completed": len(agents),
+        "agents_crashed": sum(1 for a in agents if a.get("crashed")),
+        "spool_flushed_all": all(a.get("spool_flushed", True)
+                                 for a in agents),
+        "env_steps_total": sum(a["steps"] for a in agents),
+        "mttr_s": mttr_s,
+        "surviving_subtree_arrivals_during_outage": other_flow,
+        "accounting": {
+            "agents": rows,
+            "duplicates_deduped": acct["duplicates"],
+            "sent_totals": sent_counts,
+            "zero_loss": zero_loss,
+            "zero_double_train": zero_loss,
+        },
+        "server_stats": dict(server.stats),
+        "relays_detail": [
+            {"name": row["relay"], "stats": row["stats"]}
+            for row in relay_rows],
+        "telemetry": telemetry_snapshot,
+        "worker_fault_counters": _sum_counters(
+            worker_snapshots,
+            ("relayrl_spool_", "relayrl_breaker_", "relayrl_retry_",
+             "relayrl_transport_reconnects")),
+    }
+    server.disable_server()
+    return result
+
+
 def run_guardrail_drill(transport: str = "zmq", n_lanes: int = 4,
                         duration_s: float = 60.0,
                         reward_target: float | None = 125.0,
@@ -1522,6 +1917,51 @@ def main():
             print("native .so unavailable; build with make -C native",
                   file=sys.stderr)
             return
+    relays = 0
+    if "--relays" in sys.argv:
+        relays = int(sys.argv[sys.argv.index("--relays") + 1])
+    if "--relay-chaos" in sys.argv:
+        # Relay-SIGKILL drill (ISSUE 11): kill a mid-tree relay live,
+        # replacement restores the same spool + fan-out addresses; zero
+        # loss / zero double-train asserted, MTTR reported. Appended to
+        # the relay curve file by --relay-curve; standalone here.
+        result = run_relay_chaos(
+            n_relays=2, duration_s=18.0 if quick else 30.0)
+        print(json.dumps(result))
+        assert result["accounting"]["zero_loss"], "relay drill lost data"
+        assert result["accounting"]["zero_double_train"]
+        assert result["agents_crashed"] == 0
+        return
+    if "--relay-curve" in sys.argv:
+        # The committed relay scaling curve (ISSUE 11 acceptance): a
+        # relay tree in front of anakin hosts, actors growing 8x at a
+        # FIXED relay count — the root's stream count must equal the
+        # relay count and bytes-per-publish at the root must stay flat
+        # while the fleet grows; plus the relay-SIGKILL chaos row.
+        rows = []
+        grid = ([(64, 2, 32), (128, 2, 64)] if quick
+                else [(64, 2, 32), (256, 4, 64), (1024, 4, 256)])
+        for n, n_relays, lanes in grid:
+            r = run_soak(n_actors=n, agents_per_proc=lanes,
+                         duration_s=10.0 if quick else 20.0,
+                         transport="zmq", anakin=True, relays=n_relays)
+            print(json.dumps(r))
+            assert r["server_stats"]["dropped"] == 0
+            assert r["agents_crashed"] == 0
+            assert r["agents_completed"] == n, "fleet silently shrank"
+            topo = r["relay_topology"]
+            assert topo["root_subscribers"] == n_relays, \
+                f"root fan-out is not O(relays): {topo['root_subscribers']}"
+            rows.append(r)
+        chaos = run_relay_chaos(n_relays=2,
+                                duration_s=18.0 if quick else 30.0)
+        print(json.dumps(chaos))
+        assert chaos["accounting"]["zero_loss"]
+        assert chaos["accounting"]["zero_double_train"]
+        rows.append(chaos)
+        if "--write" in sys.argv:
+            _write_results("soak_scaling_zmq_relay.json", rows)
+        return
     if "--poison" in sys.argv:
         # Guardrail chaos drill (ISSUE 8 acceptance row): NaN-poison
         # stream on a live transport → quarantine + auto-rollback +
@@ -1627,8 +2067,9 @@ def main():
                           agents_per_proc=4 if quick else 16,
                           duration_s=8.0 if quick else 30.0,
                           transport=transport, anakin=True,
-                          columnar_wire=columnar_wire)
-        _finish(result, f"soak64_{transport}_anakin.json")
+                          columnar_wire=columnar_wire, relays=relays)
+        _finish(result, None if relays else
+                f"soak64_{transport}_anakin.json")
         return
     if vector:
         # The north-star row as a configuration: 64 logical agents in 4
@@ -1642,7 +2083,7 @@ def main():
         return
     result = run_soak(n_actors=16 if quick else 64,
                       duration_s=8.0 if quick else 30.0,
-                      transport=transport)
+                      transport=transport, relays=relays)
     if transport != "zmq":
         _finish(result, f"soak64_{transport}.json")
         return

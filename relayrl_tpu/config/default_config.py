@@ -195,6 +195,14 @@ DEFAULT_CONFIG: dict = {
         # Worth it when host_share_of_wall is high and a spare core
         # exists; single-core hosts should leave it off.
         "async_emit": False,
+        # Coalesce up to this many completed columnar segments (per
+        # logical lane, per rollout window) into ONE transport send —
+        # the ROADMAP item 5 host-emit shave: short-episode envs can
+        # complete many segments per window, and each send pays the
+        # envelope + spool + socket path. 1 keeps the one-frame-per-send
+        # behavior; relays batch-forward the same container upstream
+        # (relay.batch_max), so the framing helper is shared.
+        "emit_coalesce_frames": 1,
         # Trajectory wire form. "auto" (the default) picks per tier:
         # anakin hosts ship whole rollout segments as contiguous columnar
         # frames (types/columnar.py — decoded server-side straight into
@@ -246,6 +254,13 @@ DEFAULT_CONFIG: dict = {
         # native plane passes them through opaquely and Python listeners
         # reassemble). 0 disables chunking.
         "chunk_bytes": 0,
+        # Broadcast-plane resync requests (CMD_RESYNC): a diverged
+        # subscriber asks the publisher to make its NEXT publish a
+        # keyframe (blackout <= 1 publish instead of <= the interval).
+        # Requests inside this window of an already-granted force
+        # coalesce away — one subtree-wide divergence storm costs one
+        # keyframe.
+        "resync_min_interval_s": 0.25,
         # -- unified retry/backoff (transport/retry.py) --
         # One policy drives every bounded retry loop on the agent side
         # (handshake, connect, spooled sends): jittered exponential
@@ -369,6 +384,57 @@ DEFAULT_CONFIG: dict = {
         # env loop gives up).
         "request_timeout_s": 2.0,
         "infer_deadline_s": 60.0,
+    },
+    # -- hierarchical relay tree (relayrl_tpu/relay/,
+    #    docs/architecture.md "relay tree") --
+    "relay": {
+        # false = this process is not a relay. A relay stands between
+        # the training server (or a parent relay) and an actor subtree:
+        # it subscribes ONCE upstream and re-broadcasts verbatim model
+        # frames to its own fan-out plane (publisher cost becomes
+        # O(relays), not O(actors)), and batch-forwards the subtree's
+        # trajectory envelopes upstream over one connection with its
+        # own spool (a relay crash is the PR 6 drill one level up).
+        # Start one with `python -m relayrl_tpu.relay`.
+        "enabled": False,
+        # Operator-visible relay name (telemetry run id, logs); null
+        # derives one from pid.
+        "name": None,
+        # Upstream (parent) endpoint: the transport kind plus the same
+        # agent-side address overrides an actor would use to reach the
+        # parent (zmq: agent_listener_addr/trajectory_addr/
+        # model_sub_addr; grpc/native: server_addr). Empty = the
+        # config's server.* endpoints — i.e. the root training server.
+        "upstream_type": "zmq",
+        "upstream": {},
+        # Downstream (fan-out) plane this relay BINDS for its subtree.
+        # Actors point their normal transport config at these addresses
+        # — a relay is indistinguishable from a training server on the
+        # wire. fanout_port > 0 binds the zmq triple at three
+        # consecutive ports (listener, trajectory, model pub); the
+        # "downstream" dict overrides individual addresses instead.
+        "downstream_type": "zmq",
+        "fanout_port": 0,
+        "downstream": {},
+        # Serve subtree resyncs and late joiners from the relay's cached
+        # keyframe (false = forward every resync upstream — only useful
+        # for measuring what the cache saves).
+        "keyframe_cache": True,
+        # Batch-forward: coalesce up to batch_max subtree envelopes
+        # (waiting at most batch_linger_ms for siblings) into one
+        # upstream send. 1 forwards each envelope individually.
+        "batch_max": 8,
+        "batch_linger_ms": 5.0,
+        # The relay's own trajectory spool (runtime/spool.py), retained
+        # at BATCH granularity with leaf seq tags carried verbatim:
+        # size it >= the subtree's in-flight window (docs/operations.md
+        # sizing rule). spool_dir makes it survive a relay crash.
+        "spool_entries": 2048,
+        "spool_bytes": 134217728,  # 128 MiB
+        "spool_dir": None,
+        # Rate limit for serving cached-keyframe resyncs downstream
+        # (one re-broadcast per window, shared by the whole subtree).
+        "resync_min_interval_s": 0.25,
     },
     # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
     "telemetry": {

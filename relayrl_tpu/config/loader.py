@@ -156,8 +156,8 @@ class ConfigLoader:
         # Sections whose key set IS the contract (algorithms excluded:
         # hyperparam overrides are open-ended by design).
         for section in ("actor", "transport", "learner", "telemetry",
-                        "guardrails", "serving", "model_paths", "server",
-                        "training_tensorboard"):
+                        "guardrails", "serving", "relay", "model_paths",
+                        "server", "training_tensorboard"):
             defaults = DEFAULT_CONFIG.get(section)
             loaded = self._section(section)
             if not isinstance(defaults, Mapping) or not loaded:
@@ -278,6 +278,11 @@ class ConfigLoader:
         params["jax_env"] = (str(jax_env) if jax_env
                              else DEFAULT_CONFIG["actor"]["jax_env"])
         params["async_emit"] = bool(params.get("async_emit", False))
+        try:
+            params["emit_coalesce_frames"] = max(1, int(
+                params.get("emit_coalesce_frames", 1)))
+        except (TypeError, ValueError):
+            params["emit_coalesce_frames"] = 1
         # columnar_wire: "auto" resolves per tier (anakin -> columnar
         # frames, host-bound tiers -> per-record); booleans force it.
         cw = params.get("columnar_wire", "auto")
@@ -328,6 +333,11 @@ class ConfigLoader:
             params["chunk_bytes"] = max(0, int(params.get("chunk_bytes", 0)))
         except (TypeError, ValueError):
             params["chunk_bytes"] = 0
+        try:
+            params["resync_min_interval_s"] = max(0.0, float(
+                params.get("resync_min_interval_s", 0.25)))
+        except (TypeError, ValueError):
+            params["resync_min_interval_s"] = 0.25
         # retry: keep the raw (merged) dict — RetryPolicy.from_dict and
         # retry.breaker_from_config own per-knob validation, so a
         # malformed knob degrades at the consumer with the same
@@ -434,6 +444,57 @@ class ConfigLoader:
                 params["buckets"] = None
         else:
             params["buckets"] = None
+        return params
+
+    def get_relay_params(self) -> dict[str, Any]:
+        """Relay-node knobs (``relay.*`` — see docs/architecture.md
+        "relay tree" and docs/operations.md "Relay runbook"), defaults
+        merged under user overrides; malformed values degrade to the
+        built-ins (a relay must come up on a hand-edited config)."""
+        params = dict(DEFAULT_CONFIG["relay"])
+        params.update(self._section("relay"))
+        params["enabled"] = bool(params.get("enabled", False))
+        name = params.get("name")
+        params["name"] = str(name) if name else None
+        if params.get("upstream_type") not in ("zmq", "grpc", "native",
+                                               "auto"):
+            params["upstream_type"] = "zmq"
+        if params.get("downstream_type") not in ("zmq", "grpc"):
+            params["downstream_type"] = "zmq"
+        for key in ("upstream", "downstream"):
+            value = params.get(key)
+            params[key] = dict(value) if isinstance(value, Mapping) else {}
+        try:
+            params["fanout_port"] = max(0, int(params.get("fanout_port", 0)))
+        except (TypeError, ValueError):
+            params["fanout_port"] = 0
+        params["keyframe_cache"] = bool(params.get("keyframe_cache", True))
+        try:
+            params["batch_max"] = max(1, int(params.get("batch_max", 8)))
+        except (TypeError, ValueError):
+            params["batch_max"] = 8
+        try:
+            params["batch_linger_ms"] = max(0.0, float(
+                params.get("batch_linger_ms", 5.0)))
+        except (TypeError, ValueError):
+            params["batch_linger_ms"] = 5.0
+        try:
+            params["spool_entries"] = max(0, int(
+                params.get("spool_entries", 2048)))
+        except (TypeError, ValueError):
+            params["spool_entries"] = 2048
+        try:
+            params["spool_bytes"] = max(1 << 16, int(
+                params.get("spool_bytes", 128 << 20)))
+        except (TypeError, ValueError):
+            params["spool_bytes"] = 128 << 20
+        spool_dir = params.get("spool_dir")
+        params["spool_dir"] = str(spool_dir) if spool_dir else None
+        try:
+            params["resync_min_interval_s"] = max(0.0, float(
+                params.get("resync_min_interval_s", 0.25)))
+        except (TypeError, ValueError):
+            params["resync_min_interval_s"] = 0.25
         return params
 
     def get_telemetry_params(self) -> dict[str, Any]:

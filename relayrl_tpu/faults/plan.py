@@ -58,8 +58,16 @@ FAULT_OPS = ("drop", "delay", "duplicate", "reorder", "corrupt",
 #: (runtime/inference.RemoteActorClient): drop surfaces as a timeout →
 #: retry, corrupt dies in the service's decode guard → error reply →
 #: retry, delay stalls the attempt — the thin-client chaos drill.
+#: The ``relay.*`` trio is the relay node's plane (relayrl_tpu/relay/):
+#: ``relay.model`` injects between the upstream subscription and the
+#: downstream re-broadcast (corrupt dies in the per-hop CRC check, drop
+#: exercises subtree resync-from-cache), ``relay.forward`` between
+#: subtree ingest and the upstream batch-forward (spool replay + root
+#: dedup must make the loop whole), and ``relay.step`` is where the
+#: relay's run loop polls ``kill_process`` — the relay crash drill.
 KNOWN_SITES = ("agent.send", "agent.model", "agent.infer",
-               "server.publish", "server.ingest", "actor.step")
+               "server.publish", "server.ingest", "actor.step",
+               "relay.model", "relay.forward", "relay.step")
 
 
 def _u01(seed: int, site: str, op_index: int, rule_index: int,

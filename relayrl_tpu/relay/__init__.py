@@ -1,0 +1,14 @@
+"""Hierarchical relay tree (ROADMAP item 2 / ISSUE 11).
+
+:class:`RelayNode` is one hop: it subscribes ONCE upstream (training
+server or parent relay), re-broadcasts verbatim model frames to its own
+fan-out plane, and batch-forwards + spools the subtree's trajectory
+envelopes upstream — turning both distribution planes into a tree so
+the root's publish cost is O(relays), not O(actors).
+
+``python -m relayrl_tpu.relay`` runs one as a process.
+"""
+
+from relayrl_tpu.relay.node import RelayNode  # noqa: F401
+
+__all__ = ["RelayNode"]

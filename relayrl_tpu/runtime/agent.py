@@ -48,7 +48,10 @@ def _deliver_model(actor_host, transport, client_model_path: str, tag: str,
 
         telemetry.emit("model_resync", agent_id=transport.identity,
                        base=e.base, held=e.held, side="agent")
-        transport.request_resync()
+        # The held version rides the request: a relay serves a late
+        # joiner from cache but must ESCALATE a subscriber newer than
+        # its cached keyframe (stale keyframes are dropped by decoders).
+        transport.request_resync(e.held)
         return
     except Exception as e:
         print(f"[{tag}] rejected model update: {e!r}", flush=True)
@@ -317,6 +320,7 @@ class VectorAgent:
         unroll_length: int | None = None,
         columnar_wire: bool | None = None,
         async_emit: bool | None = None,
+        emit_coalesce_frames: int | None = None,
         **addr_overrides,
     ):
         self.config = ConfigLoader(None, config_path)
@@ -351,6 +355,11 @@ class VectorAgent:
         # (the ROADMAP item 1 host shave); inert on the vector tier.
         self.async_emit = bool(actor_params.get("async_emit", False)
                                if async_emit is None else async_emit)
+        # actor.emit_coalesce_frames: pack several completed columnar
+        # segments per lane into one send (inert on the vector tier).
+        self.emit_coalesce_frames = max(1, int(
+            actor_params.get("emit_coalesce_frames", 1)
+            if emit_coalesce_frames is None else emit_coalesce_frames))
         self.server_type = server_type
         self._addr_overrides = addr_overrides
         self._identity = identity
@@ -410,6 +419,7 @@ class VectorAgent:
                     seed=self._seed,
                     columnar_wire=self.columnar_wire,
                     async_emit=self.async_emit,
+                    emit_coalesce_frames=self.emit_coalesce_frames,
                 )
             else:
                 self.host = VectorActorHost(

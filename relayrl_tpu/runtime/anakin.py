@@ -127,6 +127,7 @@ class AnakinActorHost:
         rng_keys=None,
         columnar_wire: bool = True,
         async_emit: bool = False,
+        emit_coalesce_frames: int = 1,
         **env_kwargs,
     ):
         if num_envs < 1:
@@ -190,6 +191,17 @@ class AnakinActorHost:
         self.columnar_wire = bool(columnar_wire)
         self.max_traj_length = int(max_traj_length)
         self._on_send = on_send
+        # actor.emit_coalesce_frames (ROADMAP item 5 host shave): pack
+        # up to N completed columnar segments of one lane into a single
+        # batch-container send (transport/base.pack_batch) — short
+        # episodes complete several segments per window, and each send
+        # pays the envelope + spool + socket path. Flushed at window
+        # end, so a frame never waits past its own rollout dispatch.
+        # Only meaningful on the columnar wire (per-record payloads are
+        # already per-episode msgpack).
+        self.emit_coalesce = max(1, int(emit_coalesce_frames))
+        self._coalesce_buf: list[list[bytes]] = [
+            [] for _ in range(self.num_envs)]
         self.trajectories = [
             Trajectory(
                 max_length=max_traj_length,
@@ -253,6 +265,10 @@ class AnakinActorHost:
         self._m_frame_bytes = reg.counter(
             "relayrl_actor_columnar_bytes_total",
             "columnar trajectory frame bytes encoded")
+        self._m_sends = reg.counter(
+            "relayrl_actor_emit_sends_total",
+            "transport sends of encoded segments (emit_coalesce_frames "
+            "folds several frames into one send)")
         reg.gauge("relayrl_actor_lanes",
                   "env lanes per batched dispatch on this host").set(
                       self.num_envs)
@@ -422,7 +438,35 @@ class AnakinActorHost:
                 start = b + 1
             if start < self.unroll_length:
                 self._append_segment(lane, w, start, self.unroll_length)
+        if self.emit_coalesce > 1:
+            # Window-end flush: coalescing trades sends for latency
+            # bounded by ONE window, never more.
+            for lane in range(self.num_envs):
+                self._flush_coalesced(lane)
         return episodes
+
+    def _flush_coalesced(self, lane: int) -> None:
+        """Ship the lane's pending frames as one send: a single frame
+        goes verbatim (the server's columnar sniff path), several pack
+        into a BATCH_KIND_FRAMES container (split + decoded per frame
+        by a staging worker). Either way it is ONE spool entry — one
+        seq, one envelope — so replay/dedup act on the whole group."""
+        buf = self._coalesce_buf[lane]
+        if not buf:
+            return
+        if len(buf) == 1:
+            payload = buf[0]
+        else:
+            from relayrl_tpu.transport.base import (
+                BATCH_KIND_FRAMES,
+                pack_batch,
+            )
+
+            payload = pack_batch(BATCH_KIND_FRAMES, buf)
+        buf.clear()
+        if self._on_send is not None:
+            self._m_sends.inc()
+            self._on_send(lane, payload)
 
     def _append_segment(self, lane: int, w: dict, a: int, b: int) -> None:
         """Stash window slice ``[a, b)`` on the lane's pending columns,
@@ -474,7 +518,13 @@ class AnakinActorHost:
         frame = encode_columnar_frame(dt)
         self._m_frames.inc()
         self._m_frame_bytes.inc(len(frame))
-        if self._on_send is not None:
+        if self.emit_coalesce > 1:
+            buf = self._coalesce_buf[lane]
+            buf.append(frame)
+            if len(buf) >= self.emit_coalesce:
+                self._flush_coalesced(lane)
+        elif self._on_send is not None:
+            self._m_sends.inc()
             self._on_send(lane, frame)
         if ended:
             self.episode_returns[lane].append(float(self._ep_ret[lane]))

@@ -34,6 +34,13 @@ from typing import Any, Mapping
 from relayrl_tpu.algorithms import build_algorithm, registered_algorithms
 from relayrl_tpu.config import ConfigLoader
 from relayrl_tpu.transport import make_server_transport
+from relayrl_tpu.transport.base import (
+    BATCH_KIND_ENVELOPES,
+    batch_kind,
+    split_batch,
+    swallow_decode_error,
+    unpack_trajectory_envelope,
+)
 from relayrl_tpu.types.columnar import DecodedTrajectory
 from relayrl_tpu.types.trajectory import deserialize_actions
 
@@ -355,6 +362,23 @@ class TrainingServer:
             self._wire_encoder = ModelWireEncoder(
                 keyframe_interval=transport_cfg["keyframe_interval"],
                 compress=transport_cfg["compress"])
+        # Broadcast-plane resync requests (CMD_RESYNC — ISSUE 11): a
+        # diverged subscriber asks for a keyframe instead of waiting out
+        # the interval. Coalesced by nature (force_keyframe is a flag
+        # the next publish consumes) and rate-limited so a subtree-wide
+        # divergence storm grants ONE forced keyframe per window.
+        self._resync_lock = threading.Lock()
+        self._last_resync_grant = -1e9
+        self._resync_min_interval_s = float(
+            transport_cfg.get("resync_min_interval_s", 0.25))
+        self._m_resync_requests = reg.counter(
+            "relayrl_server_resync_requests_total",
+            "CMD_RESYNC keyframe requests received from the broadcast "
+            "plane (actors or relays with a diverged delta base)")
+        self._m_resync_granted = reg.counter(
+            "relayrl_server_resync_keyframes_total",
+            "resync requests that forced the next publish to keyframe "
+            "(the rest coalesced into an already-granted window)")
 
         # Non-coordinator processes run learner steps only — the actor
         # plane (sockets) binds on the coordinator host alone.
@@ -369,6 +393,7 @@ class TrainingServer:
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
             self.transport.on_unregister = self._on_unregister
+            self.transport.on_resync = self._on_resync_request
             if self.guardrails is not None:
                 # Ack-capable transports (gRPC) answer a refused send
                 # with a typed nack (quarantine / overload) instead of a
@@ -687,7 +712,32 @@ class TrainingServer:
                 return (NACK_OVERLOADED, reason, adm.retry_after_s)
         return None
 
-    def _ingest_one(self, agent_id: str, payload: bytes) -> None:
+    def _ingest_one(self, agent_id: str, payload: bytes,
+                    depth: int = 0) -> None:
+        if batch_kind(payload) == BATCH_KIND_ENVELOPES and depth < 8:
+            # Relay upstream forward (ISSUE 11): one wire send carrying N
+            # whole subtree envelopes, each with its leaf agent's id +
+            # seq tag verbatim — split and run every inner envelope
+            # through the normal per-agent funnel, so dedup/guardrails
+            # see exactly what a flat fleet would have sent. Recursion
+            # covers relay-behind-relay nesting; the depth cap is the
+            # hostile-frame guard.
+            try:
+                parts = split_batch(payload)
+            except ValueError as e:
+                swallow_decode_error(self.server_type, "envelope_batch", e)
+                self._count_dropped()
+                return
+            for part in parts:
+                try:
+                    inner_id, inner_payload = unpack_trajectory_envelope(part)
+                except Exception as e:
+                    swallow_decode_error(self.server_type,
+                                         "envelope_batch", e)
+                    self._count_dropped()
+                    continue
+                self._ingest_one(inner_id, inner_payload, depth=depth + 1)
+            return
         agent_id, seq, admit = self._admit_seq(agent_id)
         if not admit:
             return
@@ -823,6 +873,33 @@ class TrainingServer:
                 return got
         return self._get_model()
 
+    def _on_resync_request(self, held_version: int = -1) -> None:
+        """CMD_RESYNC from the broadcast plane (zmq ROUTER thread): a
+        subscriber's delta base diverged mid-stream — force the next
+        publish to keyframe so it heals in <= 1 publish instead of <=
+        keyframe_interval. ``held_version`` (the requester's, -1 when
+        unknown) is only consulted by RELAYS; the root's forced keyframe
+        heals any held version. Coalesced (force_keyframe is one flag
+        per publish) and rate-limited
+        (``transport.resync_min_interval_s``) so a storm of diverged
+        subscribers grants one keyframe per window. A v1 server ignores
+        it: every publish is already a full model."""
+        self._m_resync_requests.inc()
+        enc = self._wire_encoder
+        if enc is None:
+            return
+        now = time.monotonic()
+        with self._resync_lock:
+            if now - self._last_resync_grant < self._resync_min_interval_s:
+                return
+            self._last_resync_grant = now
+        enc.force_keyframe()
+        self._m_resync_granted.inc()
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("resync_keyframe_forced",
+                       version=self.latest_model_version)
+
     @property
     def latest_model_version(self) -> int:
         """Version of the most recently published model — what an
@@ -864,6 +941,7 @@ class TrainingServer:
 
     # -- staging: raw payload -> decoded trajectory (overlaps learner) --
     def _staging_loop(self) -> None:
+        from relayrl_tpu.transport.base import BATCH_KIND_FRAMES
         from relayrl_tpu.types.columnar import (
             RawTrajectory,
             is_columnar_frame,
@@ -898,6 +976,18 @@ class TrainingServer:
                     item = parse_frame(payload, agent_id=agent_id)
                     self._m_columnar_frames.inc()
                     self._m_columnar_bytes.inc(len(payload))
+                elif batch_kind(payload) == BATCH_KIND_FRAMES:
+                    # Coalesced columnar segments (actor.emit_coalesce_
+                    # frames / relay batch-forward): one spooled send —
+                    # one seq, one envelope — carrying N frames of ONE
+                    # logical lane; decode each and hand the learner the
+                    # list (the native drain's batch shape).
+                    columnar = True
+                    parts = split_batch(payload)
+                    item = [parse_frame(p, agent_id=agent_id)
+                            for p in parts]
+                    self._m_columnar_frames.inc(len(parts))
+                    self._m_columnar_bytes.inc(len(payload))
                 elif decoder is not None:
                     # off-GIL msgpack -> columns; falls back to the Python
                     # decoder only for payloads the columnar schema can't
@@ -928,7 +1018,16 @@ class TrainingServer:
                 # semantic trust boundary, BEFORE the decoded item can
                 # reach the staging slabs. None = rejected (counted,
                 # struck; the poison never reaches the learner plane).
-                item = guard.validate(agent_id, item)
+                # Coalesced batches validate per contained trajectory —
+                # one poisoned segment must not veto its clean siblings.
+                if (isinstance(item, list) and item
+                        and isinstance(item[0], DecodedTrajectory)):
+                    item = [one for one in item
+                            if guard.validate(agent_id, one) is not None]
+                    if not item:
+                        item = None
+                else:
+                    item = guard.validate(agent_id, item)
             dt = time.monotonic() - t0
             self._m_decode.observe(dt)  # per-thread shard: no lock needed
             with self._timings_lock:  # N decode workers share the ledger
@@ -1907,6 +2006,7 @@ class TrainingServer:
             self.transport.get_model = self._get_model
             self.transport.on_register = self._on_register
             self.transport.on_unregister = self._on_unregister
+            self.transport.on_resync = self._on_resync_request
             if self.guardrails is not None:
                 self.transport.check_ingest = self._check_ingest
             if self.inference is not None:

@@ -75,7 +75,9 @@ class TrajectorySpool:
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             f"spool:{name}", failure_threshold=3, reset_timeout_s=2.0)
         self._lock = threading.Lock()
-        self._entries: list[tuple[str, int, bytes]] = []  # (agent_id, seq, payload)
+        # (agent_id, seq, payload); seq None = verbatim entry (the id
+        # ships as-is, no tag — relay forwards, see send_verbatim)
+        self._entries: list[tuple[str, int | None, bytes]] = []
         # Overload-nack backoff: entries nacked NACK_OVERLOADED stay
         # retained, and the next fresh send at/after this monotonic
         # deadline triggers a replay (honoring the server's
@@ -143,6 +145,23 @@ class TrajectorySpool:
         self._attempt(agent_id, seq, payload)
         return seq
 
+    def send_verbatim(self, payload: bytes, wire_id: str) -> None:
+        """Retain + attempt with ``wire_id`` shipped VERBATIM — no seq
+        assignment, no ``#s`` tag. The relay plane's forward surface
+        (ISSUE 11): a relay retains subtree envelopes/batches whose
+        inner ids already carry the LEAF actors' seq tags, so replay
+        after a relay crash re-ships them untouched and the root
+        ledger's per-leaf dedup keeps the replay exactly-once. A fresh
+        relay process must therefore never mint its own seq space (a
+        restarted relay restarting at seq 1 would be deduplicated into
+        silence). Verbatim entries are excluded from :meth:`sent_counts`
+        and persist to disk with a seq-0 sentinel."""
+        with self._lock:
+            self._retain_locked(wire_id, None, payload)
+        self._m_spooled.inc()
+        self._m_depth.set(len(self._entries))
+        self._attempt(wire_id, None, payload)
+
     def replay(self) -> int:
         """Re-send the whole retained window in order (reconnect path —
         at-least-once; the server ledger dedups). Returns entries
@@ -208,7 +227,8 @@ class TrajectorySpool:
             return False
         from relayrl_tpu.transport.base import IngestNack, tag_agent_seq
 
-        tagged = tag_agent_seq(agent_id, seq)
+        # seq None = verbatim entry (send_verbatim): the id ships as-is.
+        tagged = agent_id if seq is None else tag_agent_seq(agent_id, seq)
 
         def attempt_once():
             try:
@@ -288,11 +308,13 @@ class TrajectorySpool:
             self._append_disk(agent_id, seq, payload)
 
     # -- disk backing --
-    def _append_disk(self, agent_id: str, seq: int, payload: bytes) -> None:
-        # lock held
+    def _append_disk(self, agent_id: str, seq: int | None,
+                     payload: bytes) -> None:
+        # lock held. seq 0 is the verbatim-entry sentinel on disk (live
+        # seqs start at 1), mapped back to None on load.
         try:
             ident = agent_id.encode()
-            rec = _REC_HDR.pack(len(ident) + len(payload), seq,
+            rec = _REC_HDR.pack(len(ident) + len(payload), seq or 0,
                                 len(ident)) + ident + payload
             self._fh.write(rec)
             self._fh.flush()
@@ -315,7 +337,7 @@ class TrajectorySpool:
             f.write(_MAGIC)
             for agent_id, seq, payload in self._entries:
                 ident = agent_id.encode()
-                f.write(_REC_HDR.pack(len(ident) + len(payload), seq,
+                f.write(_REC_HDR.pack(len(ident) + len(payload), seq or 0,
                                       len(ident)) + ident + payload)
         self._fh.close()
         os.replace(tmp, self._path)
@@ -385,13 +407,13 @@ class TrajectorySpool:
 
     def _retain_from_load(self, agent_id: str, seq: int,
                           payload: bytes) -> None:
-        self._entries.append((agent_id, seq, payload))
+        self._entries.append((agent_id, seq or None, payload))
         self._bytes += len(payload)
         while (len(self._entries) > self.max_entries
                or self._bytes > self.max_bytes):
             _, _, old = self._entries.pop(0)
             self._bytes -= len(old)
-        if seq > self._next_seq.get(agent_id, 0):
+        if seq and seq > self._next_seq.get(agent_id, 0):
             self._next_seq[agent_id] = seq
 
 

@@ -30,6 +30,7 @@ _SECTIONS = (
     ("server", "relayrl_server_"),
     ("learner", "relayrl_learner_"),
     ("transport", "relayrl_transport_"),
+    ("relay", "relayrl_relay_"),
     ("actor", "relayrl_actor_"),
     ("epoch", "relayrl_epoch_"),
 )

@@ -35,6 +35,13 @@ import msgpack
 #    training_zmq.rs:747-829) --
 CMD_GET_MODEL = b"GET_MODEL"
 CMD_MODEL_SET = b"MODEL_SET"
+# Broadcast-plane resync request (relay plane, ISSUE 11): a subscriber
+# whose delta base diverged asks the publisher for a keyframe instead of
+# passively waiting out ``keyframe_interval`` publishes. Fire-and-forget
+# (no reply frame): the heal IS the next broadcast. The root server
+# answers with a coalesced, rate-limited ``force_keyframe``; a relay
+# answers from its keyframe cache without touching the root.
+CMD_RESYNC = b"RESYNC"
 REPLY_MODEL = b"MODEL"
 REPLY_ID_LOGGED = b"ID_LOGGED"
 REPLY_ERROR = b"ERROR"
@@ -54,6 +61,73 @@ def pack_trajectory_envelope(agent_id: str, payload: bytes) -> bytes:
 def unpack_trajectory_envelope(buf: bytes) -> tuple[str, bytes]:
     env = msgpack.unpackb(buf, raw=False)
     return str(env.get("id", "?")), env["traj"]
+
+
+# -- batch containers (shared framing helper, ISSUE 11) --
+#
+# One length-prefixed container serves BOTH coalescing paths:
+#
+# * ``BATCH_KIND_ENVELOPES`` — a relay's upstream forward: N whole
+#   trajectory envelopes (each still carrying its own agent id + ``#s``
+#   seq tag verbatim) ship as ONE wire send; the server's ingest funnel
+#   splits the container and runs every inner envelope through the
+#   normal per-agent dedup/guardrail path, so relay batching is
+#   invisible to the exactly-once accounting.
+# * ``BATCH_KIND_FRAMES`` — an anakin host's emit coalesce
+#   (``actor.emit_coalesce_frames``): N completed columnar segments of
+#   ONE logical lane ship as a single spooled send (one seq, one
+#   envelope); a staging worker splits the container and decodes each
+#   contained RLD1 frame.
+#
+# Layout: ``RLB1 | kind u8 | count u32le | (len u32le | part)*`` —
+# self-delimiting, transport-opaque (every backend's envelope treats the
+# payload as bytes; the native C++ core's raw fallback carries it to the
+# Python funnel untouched).
+BATCH_MAGIC = b"RLB1"
+BATCH_KIND_ENVELOPES = 1
+BATCH_KIND_FRAMES = 2
+_BATCH_HDR = 4 + 1 + 4
+
+
+def pack_batch(kind: int, parts: list[bytes]) -> bytes:
+    out = bytearray(BATCH_MAGIC)
+    out.append(kind)
+    out += len(parts).to_bytes(4, "little")
+    for part in parts:
+        out += len(part).to_bytes(4, "little")
+        out += part
+    return bytes(out)
+
+
+def batch_kind(buf) -> int | None:
+    """The container kind, or None when ``buf`` is not a batch frame."""
+    if len(buf) < _BATCH_HDR or bytes(buf[:4]) != BATCH_MAGIC:
+        return None
+    return buf[4]
+
+
+def split_batch(buf) -> list[bytes]:
+    """Container -> parts. Raises ``ValueError`` on a truncated or
+    miscounted container (a data-shaped error the receive loops'
+    decode-error narrowing already classifies as droppable)."""
+    if batch_kind(buf) is None:
+        raise ValueError("not a batch container")
+    mv = memoryview(buf)
+    count = int.from_bytes(mv[5:9], "little")
+    off = _BATCH_HDR
+    parts: list[bytes] = []
+    for _ in range(count):
+        if off + 4 > len(mv):
+            raise ValueError("truncated batch container")
+        n = int.from_bytes(mv[off:off + 4], "little")
+        off += 4
+        if off + n > len(mv):
+            raise ValueError("truncated batch part")
+        parts.append(bytes(mv[off:off + n]))
+        off += n
+    if off != len(mv):
+        raise ValueError("batch container carries trailing bytes")
+    return parts
 
 
 # -- delivery sequence tags (crash-recovery plane, runtime/spool.py) --
@@ -226,6 +300,29 @@ class ReceiptLedger:
             return out
 
 
+def register_subscriber_gauge(backend: str, fn, bind: str = "") -> None:
+    """Install the ``relayrl_transport_subscribers`` pull-gauge for one
+    server transport (ISSUE 11 satellite: the fan-out observability
+    gap). ``fn`` reads the backend's live registry/connection table at
+    snapshot time — zmq counts PUB-socket peers via its socket monitor,
+    grpc counts fresh long-poll connections, native counts its
+    registered-connection table. A relay tree is then verifiable live:
+    the root publisher's gauge equals the RELAY count, not the actor
+    count. ``bind`` (the publisher's bind address) distinguishes
+    instances — a process hosting two same-backend server transports
+    (an in-process relay next to a root) must not clobber one gauge
+    with the other's table."""
+    from relayrl_tpu import telemetry
+
+    labels = {"backend": backend}
+    if bind:
+        labels["bind"] = bind
+    telemetry.get_registry().gauge_fn(
+        "relayrl_transport_subscribers", fn,
+        "current model-plane subscribers (streams) on this publisher",
+        labels)
+
+
 def server_wire_metrics(backend: str,
                         include_publish_bytes: bool = True) -> dict:
     """The server-side transport instrument set (one per backend,
@@ -354,6 +451,17 @@ class ServerTransport(abc.ABC):
         # would serialize a bundle nobody ships. None -> get_model()[0].
         self.get_model_version = None
         self.on_register: Callable[[str], None] = lambda *_: None
+        # Broadcast-plane resync requests (CMD_RESYNC, relay plane): a
+        # subscriber's delta base diverged and it wants a keyframe
+        # sooner than the interval. Called as ``on_resync(held_version)``
+        # — the requester's held model version, or -1 when unknown. The
+        # training server binds a coalesced rate-limited force_keyframe
+        # (version-blind); a relay compares against its keyframe cache:
+        # a late joiner below the cache is served locally, a mid-stream
+        # divergence ABOVE it escalates upstream (the cache cannot heal
+        # a subscriber newer than itself — decoders drop stale
+        # versions). Default no-op — pull transports never need it.
+        self.on_resync: Callable[..., None] = lambda *_: None
         # Elastic fleets: fired when a registered agent's connection dies
         # (native transport's crash/idle detection; other backends may
         # never call it).
@@ -443,11 +551,14 @@ class AgentTransport(abc.ABC):
     def start_model_listener(self) -> None:
         """Begin delivering model updates to ``on_model`` asynchronously."""
 
-    def request_resync(self) -> None:
+    def request_resync(self, held_version: int = -1) -> None:
         """Model-wire v2 resync hook: ask the server for a full model on
-        the next delivery. Pull transports (gRPC) re-poll with
-        ``ver=-1``; broadcast transports have no back-channel and rely
-        on the publisher's periodic keyframes — the default no-op."""
+        the next delivery. ``held_version`` is the caller's decoder
+        version when known (WireBaseMismatch carries it) — it rides the
+        zmq CMD_RESYNC so a RELAY can decide cache-serve vs escalate;
+        the root publisher ignores it. Pull transports (gRPC) re-poll
+        with ``ver=-1``; transports without a back-channel rely on the
+        publisher's periodic keyframes — the default no-op."""
 
     @abc.abstractmethod
     def close(self) -> None: ...

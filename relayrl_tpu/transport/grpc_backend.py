@@ -138,6 +138,7 @@ class _Servicer:
         agent_id = str(req.get("id", "?"))
         known_version = int(req.get("ver", -1))
         first_time = bool(req.get("first", False))
+        self._owner._note_subscriber(agent_id)
         if first_time:
             self._owner.on_register(agent_id)
         # Version probe only on entry: get_model() would force the
@@ -207,8 +208,34 @@ class GrpcServerTransport(ServerTransport):
         # publish here is a long-poll wakeup, not a broadcast: there are
         # no broadcast bytes to count.
         self._m = server_wire_metrics("grpc", include_publish_bytes=False)
+        # Subscriber table for the relayrl_transport_subscribers
+        # pull-gauge: on this pull plane a "stream" is a poll loop, so
+        # count distinct poller ids seen within the last poll window
+        # (idle timeout + grace). One-shot lane registrations age out.
+        self._poll_table: dict[str, float] = {}
+        self._poll_table_lock = threading.Lock()
+
+    def _note_subscriber(self, agent_id: str) -> None:
+        with self._poll_table_lock:
+            self._poll_table[agent_id] = time.monotonic()
+            if len(self._poll_table) > 65536:  # runaway-id guard
+                self._prune_poll_table_locked()
+
+    def _prune_poll_table_locked(self) -> None:
+        horizon = time.monotonic() - (self.idle_timeout_s + 15.0)
+        for aid in [a for a, t in self._poll_table.items() if t < horizon]:
+            del self._poll_table[aid]
+
+    def _subscriber_count(self) -> int:
+        with self._poll_table_lock:
+            self._prune_poll_table_locked()
+            return len(self._poll_table)
 
     def start(self) -> None:
+        from relayrl_tpu.transport.base import register_subscriber_gauge
+
+        register_subscriber_gauge("grpc", self._subscriber_count,
+                                  bind=self._bind_addr)
         servicer = _Servicer(self)
         handlers = {
             "SendActions": grpc.unary_unary_rpc_method_handler(
@@ -491,10 +518,11 @@ class GrpcAgentTransport(AgentTransport):
         native C++ and zmq ledgers)."""
         return self._ledger.drain(max_n)
 
-    def request_resync(self) -> None:
+    def request_resync(self, held_version: int = -1) -> None:
         """Model-wire v2 resync: forget the held version so the next
         long-poll carries ``ver=-1`` and the server replies with a full
-        bundle instead of an undecodable delta."""
+        bundle instead of an undecodable delta. ``held_version`` is
+        irrelevant on this pull plane — the re-poll is the request."""
         self._known_version = -1
 
     def close(self) -> None:

@@ -284,6 +284,32 @@ def parse_frame(buf) -> tuple[int, dict, memoryview]:
     return kind, header, mv[_HDR_FIXED + hlen:]
 
 
+def verify_frame(buf) -> tuple[int, int, int | None]:
+    """Per-hop integrity check for frame forwarders (the relay plane):
+    parse the header, re-verify the payload CRC, and return ``(kind,
+    version, base_version)`` — ``base_version`` is None for keyframes
+    and chunk frames. Raises :class:`WireFrameError` on a corrupt frame
+    so a relay drops it at THIS hop instead of re-broadcasting rot to
+    its whole subtree. The frame bytes are never modified: a verified
+    frame re-broadcasts verbatim."""
+    kind, hdr, payload = parse_frame(buf)
+    try:
+        if zlib.crc32(payload) != hdr["crc"]:
+            raise WireFrameError(
+                f"frame CRC mismatch at forward hop (ver {hdr.get('ver')})")
+        version = int(hdr["ver"])
+        base = int(hdr["base"]) if kind == KIND_DELTA else None
+    except WireFrameError:
+        raise
+    except (KeyError, ValueError, TypeError, OverflowError) as e:
+        # A mangled msgpack HEADER can decode into missing keys or wrong
+        # value types while the payload CRC still matches — every such
+        # shape must surface as the one exception forwarders catch, or
+        # a hostile frame kills the listener thread that carried it.
+        raise WireFrameError(f"mangled frame header: {e!r}") from e
+    return kind, version, base
+
+
 def manifest_hash(manifest: list) -> int:
     """Stable 32-bit hash of a leaf manifest (paths + dtypes + shapes) —
     deltas carry it so a decoder can detect that its buffer layout no
@@ -753,7 +779,8 @@ __all__ = [
     "MAGIC", "KIND_KEYFRAME", "KIND_DELTA", "KIND_CHUNK",
     "CODEC_RAW", "CODEC_ZSTD", "CODEC_LZ4", "CODEC_ZLIB",
     "WireFrameError", "WireBaseMismatch",
-    "is_wire_frame", "is_chunk_frame", "parse_frame", "manifest_hash",
+    "is_wire_frame", "is_chunk_frame", "parse_frame", "verify_frame",
+    "manifest_hash",
     "split_frame", "ChunkReassembler",
     "ModelWireEncoder", "ModelWireDecoder", "resolve_codec",
 ]

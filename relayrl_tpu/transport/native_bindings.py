@@ -123,6 +123,7 @@ def _buf(data: bytes):
 
 class NativeServerTransportImpl(ServerTransport):
     PREFIX = "rl_server"  # symbol prefix: framed-TCP core (transport.cc)
+    GAUGE_BACKEND = "native"  # relayrl_transport_subscribers label
 
     # The C++ core answers kFrameGetModel itself from set_model bytes, so
     # wire-v2 publishes must ride with a full v1 bundle for handshakes.
@@ -132,6 +133,7 @@ class NativeServerTransportImpl(ServerTransport):
                  idle_timeout_s: float = 0.0, chunk_bytes: int = 0):
         super().__init__()
         self._lib = _load(lib_path)
+        self._bind_addr = bind_addr  # subscriber-gauge instance label
         host, port = _parse_host_port(bind_addr)
         self._handle = self._fn("create")(host.encode(), port)
         if not self._handle:
@@ -148,9 +150,28 @@ class NativeServerTransportImpl(ServerTransport):
         self._poller: threading.Thread | None = None
         self._stop = threading.Event()
         self.drain_parse_failures = 0  # lost decoded batches (observable)
+        # Registered-agent table for the relayrl_transport_subscribers
+        # pull-gauge — the Python mirror of the C++ registry events
+        # (register/unregister), maintained in the poll loops before the
+        # embedder callbacks fire. Counts LOGICAL agents: the C++ core
+        # does not expose its kernel connection table, so vector hosts
+        # read as N lanes here (documented in docs/observability.md).
+        self._subscriber_table: set[str] = set()
+        self._subscriber_lock = threading.Lock()
 
     def _fn(self, name):
         return getattr(self._lib, f"{self.PREFIX}_{name}")
+
+    def _note_subscriber(self, agent_id: str, alive: bool) -> None:
+        with self._subscriber_lock:
+            if alive:
+                self._subscriber_table.add(agent_id)
+            else:
+                self._subscriber_table.discard(agent_id)
+
+    def _subscriber_count(self) -> int:
+        with self._subscriber_lock:
+            return len(self._subscriber_table)
 
     @property
     def port(self) -> int:
@@ -166,6 +187,10 @@ class NativeServerTransportImpl(ServerTransport):
         data = _buf(bundle)
         self._fn("set_model")(self._handle, version, data,
                                       len(bundle))
+        from relayrl_tpu.transport.base import register_subscriber_gauge
+
+        register_subscriber_gauge(self.GAUGE_BACKEND, self._subscriber_count,
+                                  bind=self._bind_addr)
         self._stop.clear()
         self._poller = threading.Thread(target=self._poll_loop,
                                         name="native-server-poll", daemon=True)
@@ -317,8 +342,10 @@ class NativeServerTransportImpl(ServerTransport):
                         continue
                     self.on_trajectory(agent_id, payload)
                 elif isinstance(item, Registration):
+                    self._note_subscriber(item.agent_id, True)
                     self.on_register(item.agent_id)
                 elif isinstance(item, Unregistration):
+                    self._note_subscriber(item.agent_id, False)
                     self.on_unregister(item.agent_id)
             if batch:
                 self.on_trajectory_decoded(batch)
@@ -348,9 +375,13 @@ class NativeServerTransportImpl(ServerTransport):
                     continue
                 self.on_trajectory(agent_id, traj)
             elif ev_type.value == _EV_REGISTER:
-                self.on_register(payload.decode(errors="replace"))
+                agent_id = payload.decode(errors="replace")
+                self._note_subscriber(agent_id, True)
+                self.on_register(agent_id)
             elif ev_type.value == _EV_UNREGISTER:
-                self.on_unregister(payload.decode(errors="replace"))
+                agent_id = payload.decode(errors="replace")
+                self._note_subscriber(agent_id, False)
+                self.on_unregister(agent_id)
 
 
 class NativeAgentTransportImpl(AgentTransport):
@@ -631,6 +662,7 @@ class NativeGrpcServerTransportImpl(NativeServerTransportImpl):
     """
 
     PREFIX = "rl_grpc_server"
+    GAUGE_BACKEND = "grpc"  # relayrl_transport_subscribers label
 
     # The C++ ClientPoll serves the stored model to every subscriber and
     # cannot pick delta-vs-full per known version: wire-v2 frames would

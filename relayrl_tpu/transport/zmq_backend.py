@@ -33,6 +33,7 @@ from relayrl_tpu.transport.base import (
     AgentTransport,
     CMD_GET_MODEL,
     CMD_MODEL_SET,
+    CMD_RESYNC,
     MODEL_TOPIC,
     REPLY_ERROR,
     REPLY_ID_LOGGED,
@@ -41,6 +42,7 @@ from relayrl_tpu.transport.base import (
     ServerTransport,
     agent_wire_metrics,
     pack_model_frame,
+    register_subscriber_gauge,
     server_wire_metrics,
     swallow_decode_error,
     unpack_model_frame,
@@ -83,13 +85,28 @@ class ZmqServerTransport(ServerTransport):
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._m = server_wire_metrics("zmq")
+        # Live subscriber (stream) count for the PUB plane, maintained
+        # from the socket monitor's ACCEPTED/DISCONNECTED events and
+        # read lazily by the relayrl_transport_subscribers pull-gauge —
+        # libzmq has no direct peer-count API, but the bind-side monitor
+        # sees every SUB connect/drop.
+        self._pub_monitor: zmq.Socket | None = None
+        self._sub_count = 0
+        self._sub_count_lock = threading.Lock()
 
     def start(self) -> None:
         self._stop.clear()
         self._ctx = zmq.Context.instance()
         listener_addr, traj_addr, pub_addr = self._addrs
         self._pub = self._ctx.socket(zmq.PUB)
+        try:
+            self._pub_monitor = self._pub.get_monitor_socket(
+                zmq.EVENT_ACCEPTED | zmq.EVENT_DISCONNECTED)
+        except (zmq.ZMQError, AttributeError):
+            self._pub_monitor = None  # monitor unsupported: gauge stays 0
         _bind_with_retry(self._pub, pub_addr)
+        register_subscriber_gauge("zmq", self._subscriber_count,
+                                  bind=pub_addr)
         self._threads = [
             threading.Thread(target=self._listener_loop, args=(listener_addr,),
                              name="zmq-agent-listener", daemon=True),
@@ -104,9 +121,41 @@ class ZmqServerTransport(ServerTransport):
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        with self._sub_count_lock:  # vs a concurrent gauge read
+            if self._pub_monitor is not None:
+                try:
+                    self._pub_monitor.close(linger=0)
+                except zmq.ZMQError:
+                    pass
+                self._pub_monitor = None
+            # The socket (and every peer) dies with this stop; without
+            # the reset a restart_server cycle would stack the old count
+            # under the reconnecting peers' fresh ACCEPTED events.
+            self._sub_count = 0
         if self._pub is not None:
             self._pub.close(linger=0)
             self._pub = None
+
+    def _subscriber_count(self) -> int:
+        """Pull-gauge read: drain queued PUB monitor events, return the
+        live peer count. Runs on the snapshot/export thread only; the
+        lock covers a concurrent stop() closing the monitor."""
+        with self._sub_count_lock:
+            mon = self._pub_monitor
+            if mon is None:
+                return self._sub_count
+            try:
+                from zmq.utils.monitor import recv_monitor_message
+
+                while mon.poll(0):
+                    evt = recv_monitor_message(mon)["event"]
+                    if evt == zmq.EVENT_ACCEPTED:
+                        self._sub_count += 1
+                    elif evt == zmq.EVENT_DISCONNECTED:
+                        self._sub_count = max(0, self._sub_count - 1)
+            except (zmq.ZMQError, KeyError, OSError):
+                pass  # monitor died mid-read: report the last known count
+            return self._sub_count
 
     def publish_model(self, version: int, bundle_bytes: bytes) -> None:
         if self._pub is None:
@@ -161,6 +210,24 @@ class ZmqServerTransport(ServerTransport):
                         errors="replace")
                     self.on_register(agent_id)
                     sock.send_multipart([identity, REPLY_ID_LOGGED])
+                elif cmd == CMD_RESYNC:
+                    # Fire-and-forget keyframe request (no reply — the
+                    # heal is the next broadcast). The optional second
+                    # frame carries the requester's held version so a
+                    # relay can pick cache-serve vs escalate; the
+                    # training server coalesces into one rate-limited
+                    # force_keyframe regardless.
+                    held = -1
+                    if len(rest) > 1:
+                        try:
+                            held = int(rest[1])
+                        except ValueError:
+                            pass
+                    try:
+                        self.on_resync(held)
+                    except Exception as e:
+                        print(f"[zmq] on_resync handler failed: {e!r}",
+                              flush=True)
                 else:
                     sock.send_multipart([identity, REPLY_ERROR, b"unknown command"])
         finally:
@@ -461,6 +528,33 @@ class ZmqAgentTransport(AgentTransport):
         C++ ledger (``rl_sub_receipts``), so soak fan-out accounting is
         backend-uniform."""
         return self._ledger.drain(max_n)
+
+    # Resync-request floor: a decoder stuck awaiting a keyframe raises
+    # WireBaseMismatch once, but repeated divergences (chaos drills,
+    # relay failover) must not turn into a request storm on the ROUTER.
+    _RESYNC_MIN_INTERVAL_S = 1.0
+    _last_resync_req = 0.0
+
+    def request_resync(self, held_version: int = -1) -> None:
+        """Broadcast-plane resync (ISSUE 11 satellite): one CMD_RESYNC
+        on the DEALER asks the publisher to make its next publish a
+        keyframe (root: coalesced force_keyframe; relay: cached-keyframe
+        serve or upstream escalation, decided on ``held_version``) — the
+        blackout bound drops from ``<= keyframe_interval`` publishes to
+        <= 1. Fire-and-forget and client-side rate-limited; runs on the
+        model-listener thread, so the dealer lock hold is a single
+        send."""
+        now = time.monotonic()
+        if now - self._last_resync_req < self._RESYNC_MIN_INTERVAL_S:
+            return
+        self._last_resync_req = now
+        try:
+            with self._dealer_lock:
+                self._dealer.send_multipart(
+                    [CMD_RESYNC, str(int(held_version)).encode()],
+                    zmq.DONTWAIT)
+        except zmq.ZMQError:
+            pass  # full pipe / closing socket: the keyframe cadence heals
 
     def close(self) -> None:
         self._stop.set()

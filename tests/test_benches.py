@@ -246,6 +246,93 @@ def test_bench_soak_serving_quick_smoke(tmp_path):
     assert "relayrl_serving_requests_total" in names
 
 
+@pytest.mark.relay
+def test_bench_soak_relay_quick_smoke(tmp_path):
+    """Fast relay-tree soak smoke (ISSUE 11): 2 relays fronting 2 anakin
+    hosts x 4 lanes. The root's broadcast plane must serve RELAYS
+    streams (subscriber gauge == 2, not 8), every logical agent must
+    land >= 1 trajectory through its relay with zero drops, and each
+    relay's embedded telemetry snapshot must carry nonzero relay
+    counters on both planes."""
+    import os
+
+    sys.path.insert(0, str(BENCH_DIR))
+    monkey_cwd = os.getcwd()
+    try:
+        import bench_soak
+
+        os.chdir(tmp_path)
+        result = bench_soak.run_soak(
+            n_actors=8, agents_per_proc=4, duration_s=4.0,
+            traj_per_epoch=8, anakin=True, unroll_length=16, relays=2)
+    finally:
+        os.chdir(monkey_cwd)
+        sys.path.pop(0)
+    assert result["bench"].endswith("_relay")
+    assert result["agents_completed"] == 8
+    assert result["agents_crashed"] == 0
+    assert result["server_stats"]["dropped"] == 0
+    assert result["min_episodes_per_agent"] >= 1
+    assert result["distinct_traj_agents"] == 8  # attribution through hops
+    topo = result["relay_topology"]
+    assert topo["relays"] == 2
+    # THE O(relays) proof: the root publisher sees 2 streams for an
+    # 8-actor fleet.
+    assert topo["root_subscribers"] == 2
+    assert len(topo["relays_detail"]) == 2
+    for detail in topo["relays_detail"]:
+        stats = detail["stats"]
+        assert stats["model_frames_forwarded"] > 0
+        assert stats["trajectory_frames_forwarded"] > 0
+        snap = detail["telemetry"]
+        assert snap["schema"] == "relayrl-telemetry-v1"
+        fwd = {tuple(sorted((m.get("labels") or {}).items())): m["value"]
+               for m in snap["metrics"]
+               if m["name"] == "relayrl_relay_frames_forwarded_total"}
+        assert fwd[(("plane", "model"),)] > 0
+        assert fwd[(("plane", "trajectory"),)] > 0
+
+
+@pytest.mark.relay
+def test_committed_relay_scaling_curve_invariants():
+    """The committed relay curve (ISSUE 11 acceptance artifact): every
+    scaling row's root stream count equals its relay count while actors
+    grow to 1k+, bytes-per-publish at the root stays flat at fixed
+    relay count, zero drops/crashes everywhere, and the relay-SIGKILL
+    chaos row reports zero loss, zero double-train, and an MTTR."""
+    path = BENCH_DIR / "results" / "soak_scaling_zmq_relay.json"
+    rows = [json.loads(line) for line in path.read_text().splitlines()
+            if line.strip()]
+    scaling = [r for r in rows if r["bench"].startswith("soak_multi")]
+    chaos = [r for r in rows if r["bench"] == "relay_chaos_zmq"]
+    assert scaling and chaos
+    assert max(r["config"]["actors"] for r in scaling) >= 1024
+    by_relays: dict[int, list] = {}
+    for r in scaling:
+        assert r["server_stats"]["dropped"] == 0, r["bench"]
+        assert r["agents_crashed"] == 0
+        assert r["agents_completed"] == r["config"]["actors"]
+        assert r["distinct_traj_agents"] == r["config"]["actors"]
+        topo = r["relay_topology"]
+        assert topo["root_subscribers"] == topo["relays"]
+        assert topo["root_bytes_per_publish"] and topo["root_publishes"]
+        by_relays.setdefault(topo["relays"], []).append(r)
+    # flatness: at a FIXED relay count, root bytes/publish must not grow
+    # with the actor count (allow measurement noise).
+    for rows_at in by_relays.values():
+        if len(rows_at) < 2:
+            continue
+        rows_at.sort(key=lambda r: r["config"]["actors"])
+        lo = rows_at[0]["relay_topology"]["root_bytes_per_publish"]
+        hi = rows_at[-1]["relay_topology"]["root_bytes_per_publish"]
+        assert hi <= 1.25 * lo, (lo, hi)
+    drill = chaos[0]
+    assert drill["accounting"]["zero_loss"] is True
+    assert drill["accounting"]["zero_double_train"] is True
+    assert drill["agents_crashed"] == 0
+    assert drill["mttr_s"] is not None and drill["mttr_s"] >= 0
+
+
 @pytest.mark.anakin
 def test_bench_anakin_quick_emits_json(tmp_path):
     """bench_anakin --quick: baseline + fused rate lines for every grid
