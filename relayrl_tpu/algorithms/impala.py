@@ -39,13 +39,31 @@ class ImpalaState(struct.PyTreeNode):
     step: jax.Array
 
 
-def make_impala_update(policy, lr: float, gamma: float, vf_coef: float,
-                       ent_coef: float, rho_bar: float, c_bar: float,
-                       max_grad_norm: float):
+def make_impala_tx(lr: float, max_grad_norm: float, freeze=(),
+                   params_template=None):
+    """The single owner of IMPALA's optimizer chain (ctor opt-state init
+    and the jitted update must agree or the state structure silently
+    drifts): global-norm clip → adam, optionally wrapped in the
+    ``learner.freeze`` multi_transform mask (algorithms/freeze.py) —
+    frozen leaves never move, so they are bit-identical across updates
+    and free on the wire-v2 delta plane. ``params_template`` (any tree
+    with the params' structure) is required when ``freeze`` is given."""
+    from relayrl_tpu.algorithms.freeze import masked_optimizer
+
     tx = optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
         optax.adam(lr),
     )
+    if freeze and params_template is None:
+        raise ValueError("freeze patterns need a params_template")
+    return masked_optimizer(tx, params_template, freeze)
+
+
+def make_impala_update(policy, lr: float, gamma: float, vf_coef: float,
+                       ent_coef: float, rho_bar: float, c_bar: float,
+                       max_grad_norm: float, freeze=(),
+                       params_template=None):
+    tx = make_impala_tx(lr, max_grad_norm, freeze, params_template)
 
     def update(state: ImpalaState, batch: Mapping[str, jax.Array]):
         obs, act, act_mask = batch["obs"], batch["act"], batch["act_mask"]
@@ -122,10 +140,9 @@ class IMPALA(OnPolicyAlgorithm):
         init_rng, state_rng = jax.random.split(rng)
         net_params = self.policy.init_params(init_rng)
         lr = float(params.get("lr", 3e-4))
-        tx = optax.chain(
-            optax.clip_by_global_norm(float(params.get("max_grad_norm", 40.0))),
-            optax.adam(lr),
-        )
+        max_grad_norm = float(params.get("max_grad_norm", 40.0))
+        freeze = self._resolve_freeze(params, learner, net_params)
+        tx = make_impala_tx(lr, max_grad_norm, freeze, net_params)
         self.state = ImpalaState(
             params=net_params,
             opt_state=tx.init(net_params),
@@ -138,7 +155,8 @@ class IMPALA(OnPolicyAlgorithm):
             ent_coef=float(params.get("ent_coef", 0.01)),
             rho_bar=float(params.get("rho_bar", 1.0)),
             c_bar=float(params.get("c_bar", 1.0)),
-            max_grad_norm=float(params.get("max_grad_norm", 40.0)))
+            max_grad_norm=max_grad_norm, freeze=freeze,
+            params_template=net_params)
         self._update = jax.jit(update, donate_argnums=0)
 
     def _log_keys(self):

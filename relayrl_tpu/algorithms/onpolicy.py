@@ -108,6 +108,39 @@ class OnPolicyAlgorithm(AlgorithmBase):
     def _setup(self, params: dict, learner: dict, rng: jax.Array) -> None:
         raise NotImplementedError
 
+    def _resolve_freeze(self, params: dict, learner: dict,
+                        net_params) -> tuple[str, ...]:
+        """The ``learner.freeze`` knob (per-algorithm ``freeze`` override
+        wins): validated regex patterns over param leaf paths →
+        optax.multi_transform masks (algorithms/freeze.py). Records
+        ``self.freeze_info`` — which rides every checkpoint's JSON
+        extras and is what the wire-v2 frozen-leaf savings claim is
+        audited against. Shared by the whole family so the mask
+        semantics cannot drift between REINFORCE/PPO/IMPALA."""
+        from relayrl_tpu.algorithms.freeze import (
+            freeze_info,
+            normalize_freeze_spec,
+        )
+
+        patterns = normalize_freeze_spec(
+            params.get("freeze", learner.get("freeze")))
+        if not patterns:
+            return ()
+        self.freeze_info = freeze_info(net_params, patterns)
+        if self.freeze_info["frozen_leaves"] == 0:
+            import warnings
+
+            warnings.warn(
+                f"learner.freeze patterns {list(patterns)} matched no "
+                f"param leaves — check them against e.g. "
+                f"'params/block_0/qkv/kernel' style paths")
+        print(f"[{self.ALGO_NAME}] learner.freeze: "
+              f"{self.freeze_info['frozen_leaves']}/"
+              f"{self.freeze_info['total_leaves']} leaves frozen "
+              f"({self.freeze_info['frozen_bytes']} bytes) by "
+              f"{list(patterns)}", flush=True)
+        return patterns
+
     def _log_keys(self) -> Sequence[str]:
         return ("LossPi",)
 

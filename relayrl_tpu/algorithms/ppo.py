@@ -55,11 +55,12 @@ def make_ppo_update(
     target_kl: float,
     gamma: float,
     lam: float,
+    freeze=(),
 ):
     """Build the pure ``(state, batch) -> (state, metrics)`` epoch update."""
 
     def update(state: PPOState, batch: Mapping[str, jax.Array]):
-        tx_pi, tx_vf = make_optimizers(state.params, pi_lr, vf_lr)
+        tx_pi, tx_vf = make_optimizers(state.params, pi_lr, vf_lr, freeze)
         obs, act, act_mask = batch["obs"], batch["act"], batch["act_mask"]
         rew, val, valid = batch["rew"], batch["val"], batch["valid"]
         old_logp, last_val = batch["logp"], batch["last_val"]
@@ -197,6 +198,7 @@ class PPO(OnPolicyAlgorithm):
 
         init_rng, state_rng = jax.random.split(rng)
         net_params = self.policy.init_params(init_rng)
+        freeze = self._resolve_freeze(params, learner, net_params)
         update = make_ppo_update(
             self.policy,
             pi_lr=float(params.get("pi_lr", 3e-4)),
@@ -209,13 +211,14 @@ class PPO(OnPolicyAlgorithm):
             target_kl=float(params.get("target_kl", 0.015)),
             gamma=self.gamma,
             lam=self.lam,
+            freeze=freeze,
         )
         self.update_fn = update  # undecorated — parallel layer re-jits this
         self._update = jax.jit(update, donate_argnums=0)
 
         tx_pi, tx_vf = make_optimizers(
             net_params, float(params.get("pi_lr", 3e-4)),
-            float(params.get("vf_lr", 1e-3)))
+            float(params.get("vf_lr", 1e-3)), freeze)
         self.state = PPOState(
             params=net_params,
             pi_opt_state=tx_pi.init(net_params),

@@ -57,26 +57,38 @@ def _param_labels(params) -> Any:
     return {"params": labeled}
 
 
-def make_optimizers(params, pi_lr: float, vf_lr: float):
+def make_optimizers(params, pi_lr: float, vf_lr: float, freeze=()):
     """The (tx_pi, tx_vf) pair every actor-critic algorithm here uses: two
     optimizers over ONE shared param tree, partitioned by the pi/vf labels —
     the single source of truth for the partition (ctor and jitted update
-    must agree or opt-state structure silently drifts)."""
+    must agree or opt-state structure silently drifts).
+
+    ``freeze`` (regex strings over leaf paths — the ``learner.freeze``
+    knob, algorithms/freeze.py) adds a third partition whose leaves
+    neither optimizer ever moves: frozen leaves stay bit-identical
+    across updates, which is what makes them free on the wire-v2 delta
+    plane. The label is only added when patterns are present, so
+    freeze-less opt-state trees (and their checkpoints) are unchanged."""
     labels = _param_labels(params)
-    tx_pi = optax.multi_transform(
-        {"pi": optax.adam(pi_lr), "vf": optax.set_to_zero()}, labels)
-    tx_vf = optax.multi_transform(
-        {"pi": optax.set_to_zero(), "vf": optax.adam(vf_lr)}, labels)
-    return tx_pi, tx_vf
+    txs_pi = {"pi": optax.adam(pi_lr), "vf": optax.set_to_zero()}
+    txs_vf = {"pi": optax.set_to_zero(), "vf": optax.adam(vf_lr)}
+    if freeze:
+        from relayrl_tpu.algorithms.freeze import freeze_labels
+
+        labels = freeze_labels(params, freeze, base_labels=labels)
+        txs_pi["freeze"] = optax.set_to_zero()
+        txs_vf["freeze"] = optax.set_to_zero()
+    return (optax.multi_transform(txs_pi, labels),
+            optax.multi_transform(txs_vf, labels))
 
 
 def make_reinforce_update(policy, pi_lr: float, vf_lr: float,
                           train_vf_iters: int, gamma: float, lam: float,
-                          with_baseline: bool):
+                          with_baseline: bool, freeze=()):
     """Build the pure (state, batch) -> (state, metrics) epoch update."""
 
     def update(state: ReinforceState, batch: Mapping[str, jax.Array]):
-        tx_pi, tx_vf = make_optimizers(state.params, pi_lr, vf_lr)
+        tx_pi, tx_vf = make_optimizers(state.params, pi_lr, vf_lr, freeze)
         obs, act, act_mask = batch["obs"], batch["act"], batch["act_mask"]
         rew, val, valid = batch["rew"], batch["val"], batch["valid"]
         last_val = batch["last_val"]
@@ -189,6 +201,7 @@ class REINFORCE(OnPolicyAlgorithm):
 
         init_rng, state_rng = jax.random.split(rng)
         net_params = self.policy.init_params(init_rng)
+        freeze = self._resolve_freeze(params, learner, net_params)
         update = make_reinforce_update(
             self.policy,
             pi_lr=float(params.get("pi_lr", 3e-4)),
@@ -197,12 +210,13 @@ class REINFORCE(OnPolicyAlgorithm):
             gamma=self.gamma,
             lam=self.lam,
             with_baseline=self.with_baseline,
+            freeze=freeze,
         )
         self._update = jax.jit(update, donate_argnums=0)
 
         tx_pi, tx_vf = make_optimizers(
             net_params, float(params.get("pi_lr", 3e-4)),
-            float(params.get("vf_lr", 1e-3)))
+            float(params.get("vf_lr", 1e-3)), freeze)
         self.state = ReinforceState(
             params=net_params,
             pi_opt_state=tx_pi.init(net_params),

@@ -186,6 +186,16 @@ def checkpoint_algorithm(algo, directory: str | None = None,
         "version": int(algo.version),
         "arch": algo.arch,
     }
+    freeze_info = getattr(algo, "freeze_info", None)
+    if freeze_info:
+        # The learner.freeze mask rides every checkpoint (patterns +
+        # frozen-leaf accounting, minus the per-path listing — extras
+        # are JSON, keep them small): a resume can verify it restores
+        # under the same partition (restore_algorithm checks), and an
+        # operator reading the checkpoint knows which leaves were frozen
+        # without re-deriving the regex match.
+        extra["freeze"] = {k: v for k, v in freeze_info.items()
+                          if k != "frozen_paths"}
     if extra_meta:
         # Caller metadata rides the JSON extras (the guardrail plane's
         # healthy-at-save tag); the reserved keys above win on collision.
@@ -236,11 +246,29 @@ def restore_algorithm(algo, directory: str | None = None,
     """Restore a previously checkpointed algorithm in place."""
     directory = directory or osp.join(".", "checkpoints")
     mgr = manager if manager is not None else CheckpointManager(directory)
+    resolved = mgr.latest_step() if step is None else step
+    if resolved is not None:
+        # learner.freeze guard BEFORE the array restore: a mismatched
+        # mask changes the multi_transform opt-state STRUCTURE, so orbax
+        # would otherwise fail with a cryptic tree error — and where the
+        # structures happen to agree (pattern change within one label
+        # set) the resume would silently start training leaves the
+        # checkpointed line held frozen. Extras are JSON: reading them
+        # first is cheap.
+        saved_freeze = (mgr.read_extra(resolved).get("freeze")
+                        or {}).get("patterns", [])
+        live_freeze = list((getattr(algo, "freeze_info", None)
+                            or {}).get("patterns", []))
+        if saved_freeze != live_freeze:
+            raise ValueError(
+                f"checkpoint learner.freeze {saved_freeze} != configured "
+                f"{live_freeze}; align the config with the checkpointed "
+                "mask (or retrain from scratch)")
     # Symmetric with the save-side gate: the replay buffer is a
     # coordinator-only host structure, so a multi-process resume of a
     # single-host checkpoint skips it (the ring refills) instead of
     # loading it onto every rank.
-    state, extra, aux = mgr.restore(jax.device_get(algo.state), step,
+    state, extra, aux = mgr.restore(jax.device_get(algo.state), resolved,
                                     load_aux=jax.process_count() == 1)
     if extra.get("arch") and json.dumps(extra["arch"], sort_keys=True) != \
             json.dumps(algo.arch, sort_keys=True):

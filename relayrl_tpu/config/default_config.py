@@ -249,6 +249,13 @@ DEFAULT_CONFIG: dict = {
         # it, false/"none" ships raw. Incompressible payloads are
         # skipped automatically; the codec id rides the frame header.
         "compress": "auto",
+        # Models whose raw params are smaller than this ship as v1
+        # passthrough instead of delta frames (at two-packet sizes the
+        # encode work only costs publish→swap latency — the measured PR 5
+        # policy). null = the encoder's built-in 256 KiB. Scenarios that
+        # must measure delta-plane accounting (frozen-leaf savings) on a
+        # small model set 0 to force the delta path.
+        "small_model_bytes": None,
         # Split broadcast frames larger than this many bytes into
         # ordered chunk frames (ZMQ HWM-friendly bounded messages; the
         # native plane passes them through opaquely and Python listeners
@@ -436,6 +443,51 @@ DEFAULT_CONFIG: dict = {
         # (one re-broadcast per window, shared by the whole subtree).
         "resync_min_interval_s": 0.25,
     },
+    # -- RLHF workload plane (relayrl_tpu/rlhf/, docs/operations.md
+    #    "RLHF workload plane") --
+    "rlhf": {
+        # Token-level generation env knobs (envs/tokengen.py + the pure-
+        # JAX twin): vocabulary INCLUDING the reserved EOS/pad token 0,
+        # sampled-prompt length, and the generation budget per episode.
+        "vocab_size": 8,
+        "prompt_len": 3,
+        "max_new_tokens": 8,
+        # Terminal-boundary scorer: "programmatic" (all-integer
+        # successor-pattern count — the CI scorer) or "reward_model"
+        # (frozen randomly-initialized transformer critic holding its
+        # OWN params — rlhf/scorers.py; rm_* size it, rm_seed fixes it
+        # so the score stage and any self-contained env agree).
+        "scorer": "programmatic",
+        "rm_d_model": 32,
+        "rm_n_layers": 1,
+        "rm_seed": 7,
+        # Generation lanes per scheduler (the vector host's batched
+        # step_window width for sequence policies).
+        "lanes": 4,
+        # "vector" = local batched generation (sequence policies: the
+        # vmapped step_window path); "remote" = thin clients against the
+        # serving plane (serving.enabled on the training server) — only
+        # where its contracts allow (non-sequence policies; the service
+        # refuses step_window policies with a pointed error).
+        "generation_tier": "vector",
+        # Bounded-staleness pacing: once this many episodes have been
+        # scored under ONE behavior version, generation pauses until a
+        # newer model swap lands (or pace_timeout_s passes — a dead
+        # learner must not wedge the scheduler; the episodes still ship
+        # and V-trace corrects what lag remains). Unthrottled generation
+        # on a fast actor host can outrun the learner by 10-30x, burning
+        # episodes against a stale policy; the clipped-rho correction
+        # tolerates lag, it does not make free throughput of it. 0
+        # disables pacing.
+        "max_episodes_per_version": 64,
+        "pace_timeout_s": 5.0,
+        # Score stage: completed generations per batched scorer dispatch
+        # (padded to this size so the jitted vmap compiles once), and
+        # the bound on episodes parked between generate and score
+        # (backpressure: generation blocks rather than grow unbounded).
+        "score_batch": 8,
+        "score_queue": 256,
+    },
     # -- observability (relayrl_tpu/telemetry/, docs/observability.md) --
     "telemetry": {
         # false = the process-global registry stays a NullRegistry: every
@@ -475,6 +527,16 @@ DEFAULT_CONFIG: dict = {
     "learner": {
         "batch_trajectories": 8,
         "bucket_lengths": [64, 256, 1000],
+        # Frozen-layer optimizer mask (the RLHF fine-tune recipe,
+        # algorithms/freeze.py): a regex — or list of regexes — matched
+        # against "/"-joined param leaf paths (e.g.
+        # "params/(obs_embed|pos_embed|block_[01])/"); matching leaves
+        # go to optax.set_to_zero via multi_transform, so they never
+        # move, stay bit-identical across updates, and cost zero bytes
+        # on the wire-v2 delta plane (counted in publish_bytes_saved).
+        # Validated at config load; recorded in every checkpoint's
+        # extras and enforced equal on resume. null disables.
+        "freeze": None,
         "mesh": {"dp": -1, "fsdp": 1, "ep": 1, "tp": 1, "sp": 1, "pp": 1},
         # compute dtype for policy trunks: float32 on CPU actors/tests;
         # set "bfloat16" on TPU learners to feed the MXU (bench configs do).

@@ -156,8 +156,8 @@ class ConfigLoader:
         # Sections whose key set IS the contract (algorithms excluded:
         # hyperparam overrides are open-ended by design).
         for section in ("actor", "transport", "learner", "telemetry",
-                        "guardrails", "serving", "relay", "model_paths",
-                        "server", "training_tensorboard"):
+                        "guardrails", "serving", "relay", "rlhf",
+                        "model_paths", "server", "training_tensorboard"):
             defaults = DEFAULT_CONFIG.get(section)
             loaded = self._section(section)
             if not isinstance(defaults, Mapping) or not loaded:
@@ -253,6 +253,22 @@ class ConfigLoader:
         params = {k: (dict(v) if isinstance(v, dict) else v)
                   for k, v in DEFAULT_CONFIG["learner"].items()}
         params.update(self._section("learner"))
+        # learner.freeze validates at LOAD time (the unknown-key warning
+        # convention's validate-early cousin): a typo'd regex must fail
+        # the config read with the offending pattern named, not the Nth
+        # training step — and a malformed value degrades to no freezing
+        # with a warning rather than crashing server construction.
+        freeze = params.get("freeze")
+        if freeze is not None:
+            from relayrl_tpu.algorithms.freeze import normalize_freeze_spec
+
+            try:
+                params["freeze"] = list(normalize_freeze_spec(freeze)) or None
+            except ValueError as e:
+                import warnings
+
+                warnings.warn(f"ignoring invalid learner.freeze: {e}")
+                params["freeze"] = None
         return params
 
     def get_actor_params(self) -> dict[str, Any]:
@@ -333,6 +349,12 @@ class ConfigLoader:
             params["chunk_bytes"] = max(0, int(params.get("chunk_bytes", 0)))
         except (TypeError, ValueError):
             params["chunk_bytes"] = 0
+        try:
+            smb = params.get("small_model_bytes")
+            params["small_model_bytes"] = (None if smb is None
+                                           else max(0, int(smb)))
+        except (TypeError, ValueError):
+            params["small_model_bytes"] = None
         try:
             params["resync_min_interval_s"] = max(0.0, float(
                 params.get("resync_min_interval_s", 0.25)))
@@ -495,6 +517,39 @@ class ConfigLoader:
                 params.get("resync_min_interval_s", 0.25)))
         except (TypeError, ValueError):
             params["resync_min_interval_s"] = 0.25
+        return params
+
+    def get_rlhf_params(self) -> dict[str, Any]:
+        """RLHF workload-plane knobs (``rlhf.*`` — see docs/operations.md
+        "RLHF workload plane"), defaults merged under user overrides;
+        malformed values degrade to the built-ins (the scheduler must
+        come up on a hand-edited config)."""
+        params = dict(DEFAULT_CONFIG["rlhf"])
+        params.update(self._section("rlhf"))
+        for key, default, lo in (("vocab_size", 8, 2),
+                                 ("prompt_len", 3, 1),
+                                 ("max_new_tokens", 8, 1),
+                                 ("rm_d_model", 32, 4),
+                                 ("rm_n_layers", 1, 1),
+                                 ("rm_seed", 7, 0),
+                                 ("lanes", 4, 1),
+                                 ("score_batch", 8, 1),
+                                 ("score_queue", 256, 1),
+                                 ("max_episodes_per_version", 64, 0)):
+            try:
+                params[key] = max(lo, int(params.get(key, default)))
+            except (TypeError, ValueError):
+                params[key] = default
+        try:
+            value = params.get("pace_timeout_s", 5.0)
+            params["pace_timeout_s"] = max(0.1, float(
+                5.0 if value is None else value))
+        except (TypeError, ValueError):
+            params["pace_timeout_s"] = 5.0
+        if params.get("scorer") not in ("programmatic", "reward_model"):
+            params["scorer"] = "programmatic"
+        if params.get("generation_tier") not in ("vector", "remote"):
+            params["generation_tier"] = "vector"
         return params
 
     def get_telemetry_params(self) -> dict[str, Any]:

@@ -14,10 +14,12 @@ from relayrl_tpu.envs.atari import (
     SyntheticPixelEnv,
     make_atari,
 )
+from relayrl_tpu.envs.bandit import BanditEnv
 from relayrl_tpu.envs.classic import CartPoleEnv, PendulumEnv
 from relayrl_tpu.envs.gridworld import GridWorldEnv
 from relayrl_tpu.envs.memory import RecallEnv
 from relayrl_tpu.envs.spaces import Box, Discrete
+from relayrl_tpu.envs.tokengen import TokenGenEnv
 from relayrl_tpu.envs.vector import SyncVectorEnv, make_vector
 
 _BUILTIN = {
@@ -28,6 +30,12 @@ _BUILTIN = {
     # Integer-observation navigation (no Gymnasium counterpart):
     # exercises the columnar wire's int32 obs column end to end.
     "GridWorld-v0": GridWorldEnv,
+    # One-step contextual bandit battery: the fastest regression signal
+    # for learner/scheduler plumbing (all-integer dynamics).
+    "Bandit-v0": BanditEnv,
+    # Token-level autoregressive generation (the RLHF workload plane):
+    # one episode = one generation, scored at the terminal boundary.
+    "TokenGen-v0": TokenGenEnv,
 }
 
 
@@ -96,4 +104,5 @@ def make_jax(env_id: str, **kwargs):
 __all__ = ["make", "make_jax", "list_envs", "make_atari",
            "AtariPreprocessing", "SyntheticPixelEnv",
            "CartPoleEnv", "PendulumEnv", "RecallEnv", "GridWorldEnv",
+           "BanditEnv", "TokenGenEnv",
            "Box", "Discrete", "SyncVectorEnv", "make_vector"]

@@ -9,17 +9,21 @@ config can flip ``actor.host_mode`` between ``"vector"`` and ``"anakin"``
 without renaming the task.
 """
 
+from relayrl_tpu.envs.jax.bandit import BanditState, JaxBandit
 from relayrl_tpu.envs.jax.base import JaxEnv, step_autoreset, tree_where
 from relayrl_tpu.envs.jax.cartpole import CartPoleState, JaxCartPole
 from relayrl_tpu.envs.jax.gridworld import GridWorldState, JaxGridWorld
 from relayrl_tpu.envs.jax.pendulum import JaxPendulum, PendulumState
 from relayrl_tpu.envs.jax.recall import JaxRecall, RecallState
+from relayrl_tpu.envs.jax.tokengen import JaxTokenGen, TokenGenState
 
 JAX_ENVS = {
     "CartPole-v1": JaxCartPole,
     "Pendulum-v1": JaxPendulum,
     "Recall-v0": JaxRecall,
     "GridWorld-v0": JaxGridWorld,
+    "Bandit-v0": JaxBandit,
+    "TokenGen-v0": JaxTokenGen,
 }
 
 
@@ -36,4 +40,5 @@ def make_jax(env_id: str, **kwargs) -> JaxEnv:
 
 __all__ = ["JaxEnv", "JAX_ENVS", "make_jax", "step_autoreset", "tree_where",
            "JaxCartPole", "CartPoleState", "JaxPendulum", "PendulumState",
-           "JaxRecall", "RecallState", "JaxGridWorld", "GridWorldState"]
+           "JaxRecall", "RecallState", "JaxGridWorld", "GridWorldState",
+           "JaxBandit", "BanditState", "JaxTokenGen", "TokenGenState"]

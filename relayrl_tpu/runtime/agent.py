@@ -321,8 +321,22 @@ class VectorAgent:
         columnar_wire: bool | None = None,
         async_emit: bool | None = None,
         emit_coalesce_frames: int | None = None,
+        send_interceptor=None,
+        rng_keys=None,
         **addr_overrides,
     ):
+        # Dataflow-stage hook (the RLHF scheduler's seam,
+        # rlhf/scheduler.py): when set, every completed lane episode is
+        # offered to ``send_interceptor(lane, payload)`` BEFORE the
+        # spool/transport path. A non-None return ships immediately
+        # (possibly rewritten); None means the stage took ownership and
+        # will re-inject via :meth:`emit_lane` once its own work (reward
+        # scoring) is done — generate and downstream stages decouple
+        # without forking the send path.
+        self._send_interceptor = send_interceptor
+        # Per-lane PRNG override (vector tier only): the bit-identity
+        # locks hand lane 0 the exact key a single PolicyActor carries.
+        self._rng_keys = rng_keys
         self.config = ConfigLoader(None, config_path)
         from relayrl_tpu import faults, telemetry
 
@@ -428,6 +442,7 @@ class VectorAgent:
                     max_traj_length=self.config.get_max_traj_length(),
                     on_send=self._send_lane,
                     seed=self._seed,
+                    rng_keys=self._rng_keys,
                 )
         else:
             self.host.maybe_swap(bundle)
@@ -463,6 +478,20 @@ class VectorAgent:
         self.active = False
 
     def _send_lane(self, lane: int, payload: bytes) -> None:
+        if self._send_interceptor is not None:
+            payload = self._send_interceptor(lane, payload)
+            if payload is None:
+                return  # the stage owns it now; emit_lane re-injects
+        self.emit_lane(lane, payload)
+
+    def emit_lane(self, lane: int, payload: bytes) -> None:
+        """Ship one lane's serialized episode through the normal
+        spool/seq/transport path — the re-injection surface for a
+        ``send_interceptor`` stage (the RLHF score stage emits here
+        after assigning the terminal reward). Spool sequence numbers are
+        assigned HERE, so withheld episodes only enter the at-least-once
+        window once they are final — a replay after a crash redelivers
+        the scored bytes, never the unscored ones."""
         if self.spool is not None:
             self.spool.send(payload, self.agent_ids[lane])
         else:

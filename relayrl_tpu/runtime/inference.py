@@ -141,9 +141,13 @@ class InferenceService:
         if self.policy.step_window is not None:
             raise ValueError(
                 "sequence policies are not servable yet: the per-client "
-                "rolling window would have to live server-side; use a "
+                "rolling window would have to live server-side. Use a "
                 "local actor tier (process/vector) for transformer "
-                "policies")
+                "policies — for token-level RLHF generation specifically, "
+                "the RLHF scheduler's vector generation tier "
+                "(relayrl_tpu/rlhf/scheduler.py, rlhf.generation_tier: "
+                "\"vector\") serves them through the batched step_window "
+                "path; see docs/operations.md \"RLHF workload plane\"")
         if validate:
             validate_policy(self.policy, bundle.params)
         self.params = bundle.params

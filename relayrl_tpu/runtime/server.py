@@ -141,6 +141,18 @@ class TrainingServer:
             "relayrl_server_duplicate_trajectories_total",
             "sequence-tagged trajectories dropped by idempotent ingest "
             "(replays, retry storms, duplicate-injection faults)")
+        # Same bucket grid as the scheduler's emit-side lag histogram —
+        # bench_rlhf compares the two distributions side by side, so the
+        # grids must never drift apart.
+        from relayrl_tpu.rlhf.scheduler import LAG_BUCKETS
+
+        self._m_rlhf_train_lag = reg.histogram(
+            "relayrl_rlhf_train_version_lag",
+            "behavior version (data['bver'], stamped at generation) vs "
+            "the learner's dispatched version when the trajectory "
+            "trains — the off-policy distance V-trace corrects; "
+            "observed only for trajectories that carry bver",
+            buckets=LAG_BUCKETS)
         self._m_ckpt_failures = reg.counter(
             "relayrl_server_checkpoint_failures_total",
             "periodic/final checkpoint saves that raised")
@@ -361,7 +373,8 @@ class TrainingServer:
 
             self._wire_encoder = ModelWireEncoder(
                 keyframe_interval=transport_cfg["keyframe_interval"],
-                compress=transport_cfg["compress"])
+                compress=transport_cfg["compress"],
+                small_model_bytes=transport_cfg.get("small_model_bytes"))
         # Broadcast-plane resync requests (CMD_RESYNC — ISSUE 11): a
         # diverged subscriber asks for a keyframe instead of waiting out
         # the interval. Coalesced by nature (force_keyframe is a flag
@@ -1253,6 +1266,30 @@ class TrainingServer:
         self._pipeline_quiesce()
         self._guard_poll()
 
+    def _observe_behavior_lag(self, item, algo) -> None:
+        """RLHF-plane off-policy evidence: trajectories whose records
+        carry ``bver`` (the params version the generation sampled
+        under — rlhf/scheduler.py stamps it per token) observe
+        ``dispatched_version - bver`` into the train-lag histogram, one
+        sample per trajectory. Non-RLHF traffic pays one dict lookup."""
+        try:
+            if isinstance(item, DecodedTrajectory):
+                arr = (item.aux or {}).get("bver")
+                if arr is None or len(arr) == 0:
+                    return
+                bver = int(arr.reshape(-1)[0])
+            else:
+                data = item[0].data if item else None
+                if not data or "bver" not in data:
+                    return
+                bver = int(data["bver"])
+            self._m_rlhf_train_lag.observe(
+                max(0, algo.dispatched_version - bver))
+        except Exception:
+            # Lag evidence is diagnostics; malformed aux must never
+            # touch the ingest path's health.
+            pass
+
     def _sync_drop_stats(self) -> None:
         """Mirror the algorithm's finite-guard counter into stats — the
         single owner, so every ingest path (single-host, multi-host, any
@@ -1277,6 +1314,7 @@ class TrainingServer:
             return
         self.stats["trajectories"] += 1
         self._m_trajectories.inc()
+        self._observe_behavior_lag(item, algo)
         t0 = time.monotonic()
         try:
             got = algo.accumulate(item)

@@ -31,6 +31,7 @@ _SECTIONS = (
     ("learner", "relayrl_learner_"),
     ("transport", "relayrl_transport_"),
     ("relay", "relayrl_relay_"),
+    ("rlhf", "relayrl_rlhf_"),
     ("actor", "relayrl_actor_"),
     ("epoch", "relayrl_epoch_"),
 )

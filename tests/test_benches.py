@@ -404,6 +404,63 @@ def test_bench_model_wire_quick_smoke(tmp_path):
     assert "relayrl_wire_publish_bytes_total" in names
 
 
+@pytest.mark.rlhf
+@pytest.mark.slow
+def test_bench_rlhf_quick_smoke(tmp_path):
+    """RLHF e2e scenario bench (--quick): schema + the reward-improved
+    assert (the satellite contract), the per-stage split, the train-lag
+    distribution, zero-loss accounting, and in-scenario frozen-leaf
+    wire savings."""
+    lines = _run_bench("bench_rlhf.py", tmp_path, timeout=560)
+    rows = [r for r in lines if r["bench"] == "rlhf_e2e"]
+    assert rows, "no rlhf_e2e row emitted"
+    row = rows[0]
+    assert row["config"]["scorer"] == "reward_model"
+    # reward improved: the run ends above where it started, against the
+    # stated threshold's baseline anchors.
+    assert row["reward_final_mean"] > row["reward_baseline_mean"]
+    assert row["threshold_met"] is True
+    # the four-way stage split is present and non-trivial
+    stages = row["stage_seconds"]
+    for key in ("generate", "score", "emit", "update_dispatch", "publish"):
+        assert key in stages and stages[key]["count"] > 0, key
+    # behavior-vs-learner lag distribution observed at train time
+    lag = row["version_lag"]["train"]
+    assert lag["observations"] > 0 and lag["mean"] >= 0
+    # dataflow correctness + the frozen-leaf wire claim
+    assert row["zero_loss_accounting"] is True
+    assert row["wire"]["frozen_leaves"] > 0
+    assert row["wire"]["publish_bytes_saved_total"] > 0
+    assert row["updates"] > 0 and row["tokens_generated"] > 0
+
+
+@pytest.mark.rlhf
+def test_committed_rlhf_e2e_invariants():
+    """The committed benches/results/rlhf_e2e.json artifact keeps the
+    acceptance claims: threshold met on the reward-model row, per-stage
+    split + lag distribution present, frozen-leaf savings per row."""
+    sys.path.insert(0, str(BENCH_DIR))
+    try:
+        from common import load_results
+    finally:
+        sys.path.pop(0)
+    rows = [r for r in load_results(BENCH_DIR / "results" / "rlhf_e2e.json")
+            if r.get("bench") == "rlhf_e2e"]
+    assert rows, "committed artifact has no rlhf_e2e rows"
+    rm_rows = [r for r in rows if r["config"]["scorer"] == "reward_model"]
+    assert rm_rows
+    assert any(r["threshold_met"] for r in rm_rows)
+    for r in rows:
+        assert r["reward_final_mean"] > r["reward_baseline_mean"]
+        assert {"generate", "score", "update_dispatch",
+                "publish"} <= set(r["stage_seconds"])
+        assert r["version_lag"]["train"]["observations"] > 0
+        assert r["zero_loss_accounting"] is True
+        if r["config"]["freeze"]:
+            assert r["wire"]["publish_bytes_saved_total"] > 0
+        assert r["telemetry"]["schema"] == "relayrl-telemetry-v1"
+
+
 def test_committed_results_all_parse_with_shared_loader():
     """Satellite (ISSUE 5): every committed benches/results/*.json file
     parses through common.load_results — the one reader for both the

@@ -321,10 +321,134 @@ class TestGridWorldParity:
         np.testing.assert_array_equal(np.asarray(obs), [0, 0])
 
 
+class TestBanditParity:
+    def test_full_bitwise_parity(self):
+        """All-integer dynamics (context one-hot, target-arm residue,
+        0/1 reward): obs, reward, and BOTH flags are bit-equal to the
+        numpy twin across injected contexts. Every step is an episode
+        (one-step bandit), so this is also the densest autoreset
+        exercise in the battery."""
+        from relayrl_tpu.envs import BanditEnv
+
+        jenv = make_jax("Bandit-v0", n_contexts=5, n_arms=3)
+        nenv = BanditEnv(n_contexts=5, n_arms=3)
+        nenv.reset(seed=0)
+        step = jax.jit(jenv.step)
+        rng = np.random.default_rng(2)
+        key = jax.random.PRNGKey(2)
+        hits = 0
+        for i in range(64):
+            key, sub = jax.random.split(key)
+            state, jobs = jenv.reset(sub)
+            nenv._ctx = int(state.ctx)
+            np.testing.assert_array_equal(np.asarray(jobs), nenv._obs())
+            assert np.asarray(jobs).dtype == np.int32
+            action = int(rng.integers(3))
+            _state, jobs, jrew, jterm, jtrunc = step(state,
+                                                     jnp.int32(action))
+            nobs, nrew, nterm, ntrunc, _ = nenv.step(action)
+            np.testing.assert_array_equal(np.asarray(jobs), nobs)
+            assert float(jrew) == nrew
+            assert bool(jterm) == nterm is True
+            assert bool(jtrunc) == ntrunc is False
+            hits += int(nrew)
+        assert 0 < hits < 64, "need both rewarded and unrewarded pulls"
+
+    def test_target_arm_is_learnable_mapping(self):
+        """The contract the fast-regression signal rests on: the correct
+        arm is a deterministic function of the context, identical in
+        both planes."""
+        from relayrl_tpu.envs import BanditEnv
+        from relayrl_tpu.envs.jax.bandit import BanditState
+
+        jenv = make_jax("Bandit-v0", n_contexts=6, n_arms=4,
+                        mult=3, shift=1)
+        nenv = BanditEnv(n_contexts=6, n_arms=4, mult=3, shift=1)
+        step = jax.jit(jenv.step)
+        for ctx in range(6):
+            target = nenv.target_arm(ctx)
+            state = BanditState(ctx=jnp.int32(ctx))
+            _s, _o, rew, _t, _x = step(state, jnp.int32(target))
+            assert float(rew) == 1.0, (ctx, target)
+            wrong = (target + 1) % 4
+            _s, _o, rew, _t, _x = step(state, jnp.int32(wrong))
+            assert float(rew) == 0.0
+
+
+class TestTokenGenParity:
+    def test_full_bitwise_parity_programmatic(self):
+        """TokenGen with the all-integer programmatic scorer: obs
+        (the token context window), reward (a count, integral in
+        float32), and flags bit-equal to the numpy twin from injected
+        states, across EOS endings and max_new_tokens endings."""
+        from relayrl_tpu.envs import TokenGenEnv
+        from relayrl_tpu.rlhf.scorers import ProgrammaticScorer
+
+        scorer = ProgrammaticScorer(vocab_size=6)
+        kwargs = dict(vocab_size=6, prompt_len=2, max_new_tokens=5,
+                      scorer=scorer)
+        jenv = make_jax("TokenGen-v0", **kwargs)
+        nenv = TokenGenEnv(**kwargs)
+        nenv.reset(seed=0)
+        step = jax.jit(jenv.step)
+        rng = np.random.default_rng(4)
+        key = jax.random.PRNGKey(4)
+        key, sub = jax.random.split(key)
+        state, jobs = jenv.reset(sub)
+        assert np.asarray(jobs).dtype == np.int32
+        eos_ends = budget_ends = 0
+        scored = 0.0
+        for _ in range(300):
+            nenv._tokens = np.asarray(state.tokens, np.int32).copy()
+            nenv._t = int(state.t)
+            action = int(rng.integers(6))
+            state, jobs, jrew, jterm, jtrunc = step(state,
+                                                    jnp.int32(action))
+            nobs, nrew, nterm, ntrunc, _ = nenv.step(action)
+            np.testing.assert_array_equal(np.asarray(jobs), nobs)
+            assert float(jrew) == nrew
+            assert bool(jterm) == nterm and bool(jtrunc) == ntrunc is False
+            if bool(jterm):
+                scored += float(jrew)
+                # A terminal whose final action is NOT EOS can only be
+                # the max_new_tokens budget ending — the second
+                # termination type the parity must cover.
+                eos_ends += int(action == 0)
+                budget_ends += int(action != 0)
+                key, sub = jax.random.split(key)
+                state, jobs = jenv.reset(sub)
+        assert eos_ends >= 3, "never saw an EOS ending"
+        assert budget_ends >= 3, "never saw a max_new_tokens ending"
+        assert scored > 0, "random play never hit a successor token"
+
+    def test_prompt_excludes_eos_and_reset_reproducible(self):
+        jenv = make_jax("TokenGen-v0", vocab_size=8, prompt_len=3,
+                        max_new_tokens=4)
+        for i in range(16):
+            state, obs = jenv.reset(jax.random.PRNGKey(i))
+            prompt = np.asarray(state.tokens)[:3]
+            assert np.all(prompt >= 1) and np.all(prompt < 8)
+            assert np.all(np.asarray(state.tokens)[3:] == 0)
+        a = np.asarray(jenv.reset(jax.random.PRNGKey(0))[1])
+        b = np.asarray(jenv.reset(jax.random.PRNGKey(0))[1])
+        np.testing.assert_array_equal(a, b)
+
+    def test_scorerless_mode_pays_zero(self):
+        """The decoupled-dataflow contract: scorer=None means the env
+        NEVER pays reward — the score stage owns it."""
+        jenv = make_jax("TokenGen-v0", vocab_size=6, prompt_len=2,
+                        max_new_tokens=3)
+        state, _ = jenv.reset(jax.random.PRNGKey(0))
+        step = jax.jit(jenv.step)
+        for tok in (3, 4, 0):  # incl. an EOS terminal
+            state, _obs, rew, _term, _tr = step(state, jnp.int32(tok))
+            assert float(rew) == 0.0
+
+
 class TestRegistry:
     def test_jax_registry_covers_builtins(self):
         assert set(JAX_ENVS) == {"CartPole-v1", "Pendulum-v1", "Recall-v0",
-                                 "GridWorld-v0"}
+                                 "GridWorld-v0", "Bandit-v0", "TokenGen-v0"}
 
     def test_list_envs_has_both_planes(self):
         known = list_envs()

@@ -1,0 +1,203 @@
+"""Direct unit suite for ``ops/vtrace.py`` (ISSUE 13 satellite).
+
+V-trace is about to become the off-policy spine of the RLHF path (the
+scheduler's decoupled generation runs tokens sampled N publishes behind
+the learner), and until now it was covered only transitively through
+the IMPALA e2e tests. This suite pins it directly:
+
+* a GOLDEN-VALUE test against a hand-unrolled reference recursion
+  (plain Python floats, written from the IMPALA paper's definition:
+  ``vs_t = v_t + sum_k gamma^(k-t) (prod c) rho_k delta_k`` computed by
+  the backward form ``a_t = delta_t + gamma c_t a_{t+1}``) — including
+  the clipped-rho edge cases where the behavior policy was much more /
+  much less confident than the target;
+* the ON-POLICY IDENTITY: with behavior == target and
+  ``rho_bar, c_bar >= 1`` the recursion telescopes to the n-step
+  return, and ``pg_adv`` reduces to the 1-step TD advantage against
+  those returns;
+* masking/padding and bootstrap-injection behavior on the padded
+  ``[B, T]`` batches every learner feeds it.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from relayrl_tpu.ops.vtrace import vtrace
+
+pytestmark = pytest.mark.rlhf
+
+
+def reference_vtrace(behavior_logp, target_logp, rew, val, gamma,
+                     last_val, rho_bar, c_bar):
+    """Hand-unrolled single-trajectory V-trace in plain Python floats —
+    the independent implementation the golden test compares against.
+    Follows Espeholt et al. (2018) eq. 1 exactly, via the backward
+    recursion a_t = delta_t + gamma c_t a_{t+1}, vs_t = v_t + a_t."""
+    T = len(rew)
+    rho = [min(rho_bar, float(np.exp(t - b)))
+           for b, t in zip(behavior_logp, target_logp)]
+    c = [min(c_bar, float(np.exp(t - b)))
+         for b, t in zip(behavior_logp, target_logp)]
+    v_next = [val[t + 1] if t + 1 < T else last_val for t in range(T)]
+    delta = [rho[t] * (rew[t] + gamma * v_next[t] - val[t])
+             for t in range(T)]
+    a = [0.0] * (T + 1)
+    for t in reversed(range(T)):
+        a[t] = delta[t] + gamma * c[t] * a[t + 1]
+    vs = [val[t] + a[t] for t in range(T)]
+    vs_next = [vs[t + 1] if t + 1 < T else last_val for t in range(T)]
+    pg_adv = [rho[t] * (rew[t] + gamma * vs_next[t] - val[t])
+              for t in range(T)]
+    return vs, pg_adv, rho
+
+
+def run_vtrace(behavior_logp, target_logp, rew, val, gamma, last_val,
+               rho_bar=1.0, c_bar=1.0, pad_to=None):
+    """Single trajectory through the real op (as a [1, T] batch), with
+    optional right-padding to exercise the mask path."""
+    T = len(rew)
+    width = pad_to or T
+
+    def row(xs):
+        out = np.zeros(width, np.float32)
+        out[:T] = xs
+        return jnp.asarray(out)[None]
+
+    valid = np.zeros(width, np.float32)
+    valid[:T] = 1.0
+    res = vtrace(row(behavior_logp), row(target_logp), row(rew), row(val),
+                 jnp.asarray(valid)[None], gamma,
+                 last_val=jnp.asarray([np.float32(last_val)]),
+                 rho_bar=rho_bar, c_bar=c_bar)
+    return (np.asarray(res.vs)[0], np.asarray(res.pg_adv)[0],
+            np.asarray(res.rho)[0])
+
+
+class TestGoldenValues:
+    # One fixed 4-step trajectory, moderately off-policy.
+    B_LOGP = [-0.5, -1.2, -0.3, -2.0]
+    T_LOGP = [-0.7, -0.4, -1.1, -0.9]
+    REW = [1.0, 0.0, -0.5, 2.0]
+    VAL = [0.3, -0.2, 0.8, 0.1]
+
+    @pytest.mark.parametrize("rho_bar,c_bar", [
+        (1.0, 1.0),     # standard clipping
+        (0.5, 0.5),     # aggressive clipping — every ratio > 0.5 clips
+        (10.0, 10.0),   # effectively unclipped (ratios here are < e^1.7)
+        (1.0, 0.7),     # asymmetric rho/c bars
+    ])
+    def test_against_hand_recursion(self, rho_bar, c_bar):
+        vs, pg, rho = run_vtrace(self.B_LOGP, self.T_LOGP, self.REW,
+                                 self.VAL, 0.9, last_val=0.4,
+                                 rho_bar=rho_bar, c_bar=c_bar)
+        ref_vs, ref_pg, ref_rho = reference_vtrace(
+            self.B_LOGP, self.T_LOGP, self.REW, self.VAL, 0.9, 0.4,
+            rho_bar, c_bar)
+        np.testing.assert_allclose(rho, ref_rho, rtol=1e-5)
+        np.testing.assert_allclose(vs, ref_vs, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(pg, ref_pg, rtol=1e-5, atol=1e-6)
+
+    def test_clipped_rho_edge_exact_values(self):
+        """Fully hand-computed 2-step case where BOTH ratios clip:
+        behavior far less confident than target → raw ratio e^2 ≈ 7.39,
+        clipped to rho_bar = 1. With val=0 everywhere the recursion is
+        pure reward accumulation: delta = [1*1, 1*2] (clipped rhos),
+        a_1 = 2, a_0 = 1 + 0.5*1*2 = 2, vs = [2, 2]; pg_adv_0 =
+        1*(1 + 0.5*vs_1 - 0) = 2, pg_adv_1 = 2."""
+        vs, pg, rho = run_vtrace(
+            behavior_logp=[-3.0, -3.0], target_logp=[-1.0, -1.0],
+            rew=[1.0, 2.0], val=[0.0, 0.0], gamma=0.5, last_val=0.0,
+            rho_bar=1.0, c_bar=1.0)
+        np.testing.assert_allclose(rho, [1.0, 1.0], rtol=1e-6)
+        np.testing.assert_allclose(vs, [2.0, 2.0], rtol=1e-6)
+        np.testing.assert_allclose(pg, [2.0, 2.0], rtol=1e-6)
+
+    def test_downweighted_rho_edge(self):
+        """The opposite tail: behavior MORE confident than target → raw
+        ratio e^-2 ≈ 0.135 passes the min() unclipped and scales both
+        the targets and the advantage — stale confident tokens get tiny
+        weight, the property the RLHF path leans on."""
+        ratio = float(np.exp(-2.0))
+        vs, pg, rho = run_vtrace(
+            behavior_logp=[-1.0], target_logp=[-3.0],
+            rew=[1.0], val=[0.0], gamma=0.9, last_val=0.0)
+        np.testing.assert_allclose(rho, [ratio], rtol=1e-5)
+        np.testing.assert_allclose(vs, [ratio], rtol=1e-5)
+        np.testing.assert_allclose(pg, [ratio], rtol=1e-5)
+
+
+class TestOnPolicyIdentity:
+    def test_equals_nstep_return_when_on_policy(self):
+        """behavior == target (every ratio exactly 1) with rho_bar,
+        c_bar >= 1 must telescope to the discounted n-step return with
+        bootstrap — i.e. NO correction, the identity that makes V-trace
+        safe to leave always-on in a learner that is sometimes fed
+        on-policy data."""
+        rng = np.random.default_rng(0)
+        T, gamma = 6, 0.97
+        logp = rng.uniform(-2, -0.1, T).astype(np.float32)
+        rew = rng.standard_normal(T).astype(np.float32)
+        val = rng.standard_normal(T).astype(np.float32)
+        last_val = float(rng.standard_normal())
+        vs, pg, rho = run_vtrace(logp, logp, rew, val, gamma, last_val,
+                                 rho_bar=1.0, c_bar=1.0)
+        # n-step return: G_t = r_t + gamma G_{t+1}, G_T = last_val
+        G = np.zeros(T + 1, np.float64)
+        G[T] = last_val
+        for t in reversed(range(T)):
+            G[t] = rew[t] + gamma * G[t + 1]
+        np.testing.assert_allclose(rho, np.ones(T), rtol=1e-6)
+        np.testing.assert_allclose(vs, G[:T], rtol=1e-4, atol=1e-5)
+        # pg advantage reduces to the TD form against those returns
+        expected_pg = rew + gamma * G[1:] - val
+        np.testing.assert_allclose(pg, expected_pg, rtol=1e-4, atol=1e-5)
+
+    def test_on_policy_terminal_episode_is_reward_to_go(self):
+        """Terminated episode (last_val=0), on-policy, values zero: vs
+        IS the discounted reward-to-go — the degenerate case every
+        from-scratch run starts in."""
+        rew = [0.0, 0.0, 1.0]
+        vs, pg, _ = run_vtrace([-1.0] * 3, [-1.0] * 3, rew, [0.0] * 3,
+                               0.5, last_val=0.0)
+        np.testing.assert_allclose(vs, [0.25, 0.5, 1.0], rtol=1e-6)
+        np.testing.assert_allclose(pg, [0.25, 0.5, 1.0], rtol=1e-6)
+
+
+class TestPaddedBatches:
+    def test_padding_stays_zero_and_values_match_unpadded(self):
+        """The [B, T] mask discipline: right-padding must neither leak
+        into the valid prefix (bootstrap injects at the last VALID step,
+        not the last column) nor produce nonzero outputs in the tail."""
+        args = ([-0.5, -1.0, -0.8], [-0.6, -0.9, -1.1],
+                [1.0, -0.3, 0.7], [0.2, 0.4, -0.1])
+        vs_a, pg_a, rho_a = run_vtrace(*args, 0.9, last_val=0.33)
+        vs_b, pg_b, rho_b = run_vtrace(*args, 0.9, last_val=0.33,
+                                       pad_to=8)
+        np.testing.assert_allclose(vs_b[:3], vs_a, rtol=1e-6)
+        np.testing.assert_allclose(pg_b[:3], pg_a, rtol=1e-6)
+        assert np.all(vs_b[3:] == 0) and np.all(pg_b[3:] == 0)
+        assert np.all(rho_b[3:] == 0)
+
+    def test_batch_rows_independent(self):
+        """Rows of a [B, T] batch must not mix: computing two
+        trajectories together equals computing them alone."""
+        rng = np.random.default_rng(3)
+        T = 5
+        rows = []
+        for _ in range(2):
+            rows.append(tuple(rng.standard_normal(T).astype(np.float32)
+                              for _ in range(4)))
+        single = [run_vtrace(*r, 0.95, last_val=0.1) for r in rows]
+        stacked = vtrace(
+            jnp.asarray(np.stack([rows[0][0], rows[1][0]])),
+            jnp.asarray(np.stack([rows[0][1], rows[1][1]])),
+            jnp.asarray(np.stack([rows[0][2], rows[1][2]])),
+            jnp.asarray(np.stack([rows[0][3], rows[1][3]])),
+            jnp.ones((2, T), jnp.float32), 0.95,
+            last_val=jnp.asarray([0.1, 0.1], jnp.float32))
+        for b in range(2):
+            np.testing.assert_allclose(np.asarray(stacked.vs)[b],
+                                       single[b][0], rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(stacked.pg_adv)[b],
+                                       single[b][1], rtol=1e-5)
