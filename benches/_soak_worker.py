@@ -150,6 +150,35 @@ def batched_lane_rows(agent, *, steps: int, episodes_per_lane: list,
     } for lane in range(len(agent.agent_ids))]
 
 
+def trace_setup(cfg: dict) -> None:
+    """Distributed-tracing worker plumbing (bench_soak ``trace_rate``):
+    a live tracer so this worker's actors mint trajectory trace
+    contexts (riding the envelope ids to the server, where data age is
+    observed) and record actor-side model-age/receipt evidence — plus a
+    real registry to hold it, unless chaos mode already installed one."""
+    rate = float(cfg.get("trace_rate") or 0.0)
+    if rate <= 0:
+        return
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.telemetry import trace
+
+    if not telemetry.get_registry().enabled:
+        telemetry.set_registry(telemetry.Registry(
+            run_id=f"soak-worker-{cfg['worker_id']}"))
+    trace.configure(rate, journal=False)
+
+
+def worker_result(cfg: dict, agents: list) -> dict:
+    """The worker's result document; embeds this process's telemetry
+    snapshot whenever chaos accounting or tracing needs it row-side."""
+    result = {"worker_id": cfg["worker_id"], "agents": agents}
+    if cfg.get("chaos_telemetry") or float(cfg.get("trace_rate") or 0.0) > 0:
+        from relayrl_tpu import telemetry
+
+        result["telemetry"] = telemetry.get_registry().snapshot()
+    return result
+
+
 def chaos_setup(cfg: dict) -> None:
     """Chaos-mode worker plumbing (bench_soak --chaos): install the
     fault plan via the env hook BEFORE any Agent is constructed, and a
@@ -553,6 +582,7 @@ def main():
     cfg = json.loads(sys.argv[1])
     os.environ["JAX_PLATFORMS"] = "cpu"
     chaos_setup(cfg)
+    trace_setup(cfg)
 
     if cfg.get("serving"):
         out: dict = {}
@@ -569,29 +599,15 @@ def main():
         for t in threads:
             t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"]
                    + barrier_s + 120)
-        result = {"worker_id": cfg["worker_id"],
-                  "agents": list(out.values())}
-        if cfg.get("chaos_telemetry"):
-            from relayrl_tpu import telemetry
-
-            result["telemetry"] = telemetry.get_registry().snapshot()
         with open(cfg["result_path"], "w") as f:
-            json.dump(result, f)
+            json.dump(worker_result(cfg, list(out.values())), f)
         return
 
     if cfg.get("anakin") or cfg.get("vector"):
         rows = (anakin_host_loop(cfg) if cfg.get("anakin")
                 else vector_host_loop(cfg))
-        result = {"worker_id": cfg["worker_id"], "agents": rows}
-        if cfg.get("chaos_telemetry"):
-            from relayrl_tpu import telemetry
-
-            # same worker-side chaos evidence as process mode below:
-            # without this snapshot the coordinator's fault/retry/spool
-            # accounting reads zero for batched-host chaos rows.
-            result["telemetry"] = telemetry.get_registry().snapshot()
         with open(cfg["result_path"], "w") as f:
-            json.dump(result, f)
+            json.dump(worker_result(cfg, rows), f)
         return
 
     out: dict = {}
@@ -611,15 +627,8 @@ def main():
     for t in threads:
         t.join(timeout=cfg["duration_s"] + cfg["handshake_timeout_s"]
                + barrier_s + 120)
-    result = {"worker_id": cfg["worker_id"], "agents": list(out.values())}
-    if cfg.get("chaos_telemetry"):
-        from relayrl_tpu import telemetry
-
-        # the worker-side half of the chaos evidence: injected-fault,
-        # retry, breaker, and spool counters live in THIS process
-        result["telemetry"] = telemetry.get_registry().snapshot()
     with open(cfg["result_path"], "w") as f:
-        json.dump(result, f)
+        json.dump(worker_result(cfg, list(out.values())), f)
 
 
 if __name__ == "__main__":

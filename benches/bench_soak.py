@@ -52,16 +52,22 @@ from common import bench_cwd, free_port, setup_platform  # noqa: E402
 setup_platform()
 
 
-def _fresh_bench_registry(run_id: str):
+def _fresh_bench_registry(run_id: str, trace_rate: float = 0.0):
     """One fresh telemetry registry per bench row, installed in THIS
     (server-hosting) process: every row then embeds a snapshot whose
     schema is exactly the production ``/snapshot`` endpoint's — bench
     artifacts and live scrapes are read by the same tooling. Fresh per
-    row so curve rows don't accumulate each other's counters."""
+    row so curve rows don't accumulate each other's counters.
+    ``trace_rate`` > 0 also installs a fresh tracer (journal off) so
+    rows can embed the data-age/model-age attribution block."""
     from relayrl_tpu import telemetry
 
     registry = telemetry.Registry(run_id=run_id)
     telemetry.set_registry(registry)
+    if trace_rate > 0:
+        from relayrl_tpu.telemetry import trace
+
+        trace.configure(trace_rate, journal=False)
     return registry
 
 
@@ -118,19 +124,25 @@ def _leaf_arrival_ids(agent_id: str, payload: bytes) -> list[str]:
         BATCH_KIND_ENVELOPES,
         batch_kind,
         split_agent_seq,
+        split_agent_trace,
         split_batch,
         unpack_trajectory_envelope,
     )
 
+    def clean(tagged: str) -> str:
+        # Wire ids carry the seq tag and (tracing on) the trace-context
+        # tag; attribution strips both, like the server's ingest funnel.
+        return split_agent_trace(split_agent_seq(tagged)[0])[0]
+
     if batch_kind(payload) != BATCH_KIND_ENVELOPES:
-        return [split_agent_seq(agent_id)[0]]
+        return [clean(agent_id)]
     out = []
     for part in split_batch(payload):
         try:
             inner_id, _ = unpack_trajectory_envelope(part)
         except Exception:
             continue
-        out.append(split_agent_seq(inner_id)[0])
+        out.append(clean(inner_id))
     return out
 
 
@@ -233,7 +245,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              columnar_wire: bool | None = None,
              serving: bool = False, max_batch: int | None = None,
              batch_timeout_ms: float = 5.0, relays: int = 0,
-             emit_coalesce_frames: int | None = None) -> dict:
+             emit_coalesce_frames: int | None = None,
+             trace_rate: float = 1.0) -> dict:
     """``vector=True`` runs the fleet as vector actor hosts: each worker
     process is ONE VectorAgent stepping ``agents_per_proc`` logical
     agents through a single batched jitted policy dispatch (the
@@ -259,7 +272,8 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         obs_dim = env_probe.obs_dim
         act_dim = int(getattr(env_probe.action_space, "n", 0)
                       or env_probe.action_space.shape[0])
-    _fresh_bench_registry(f"soak-{transport}-{n_actors}")
+    _fresh_bench_registry(f"soak-{transport}-{n_actors}",
+                          trace_rate=trace_rate)
 
     scratch = tempfile.mkdtemp(prefix="relayrl_soak_")
     addrs, worker_addrs = _transport_addrs(transport)
@@ -333,7 +347,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     # streams (the vector-soak smoke asserts it == actors). Envelope ids
     # carry the spool's sequence tag on the wire (crash-recovery plane);
     # strip it the same way the server's ingest funnel does.
-    from relayrl_tpu.transport.base import split_agent_seq
+    from relayrl_tpu.transport.base import split_agent_seq, split_agent_trace
 
     seen_traj_agents: set[str] = set()
     orig_on_traj = server.transport.on_trajectory
@@ -349,8 +363,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         orig_decoded = server.transport.on_trajectory_decoded
 
         def counting_decoded(batch):
-            seen_traj_agents.update(split_agent_seq(t.agent_id)[0]
-                                    for t in batch)
+            seen_traj_agents.update(
+                split_agent_trace(split_agent_seq(t.agent_id)[0])[0]
+                for t in batch)
             orig_decoded(batch)
 
         server.transport.on_trajectory_decoded = counting_decoded
@@ -400,6 +415,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             "anakin": anakin, "unroll_length": unroll_length,
             "jax_env": jax_env, "columnar_wire": columnar_wire,
             "emit_coalesce_frames": emit_coalesce_frames,
+            "trace_rate": trace_rate,
             **w_addrs,
         }
         procs.append(subprocess.Popen(
@@ -440,13 +456,17 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     queue_backlog = server._ingest.qsize()
 
     agents = []
+    worker_snaps = []
     for path, out, p in zip(result_paths, outs, procs):
         if p.returncode != 0 or not os.path.exists(path):
             for rp in relay_procs:  # don't leak the tree on a bad row
                 rp.kill()
             raise RuntimeError(f"soak worker failed (rc={p.returncode}):\n{out}")
         with open(path) as f:
-            agents.extend(json.load(f)["agents"])
+            data = json.load(f)
+        agents.extend(data["agents"])
+        if data.get("telemetry"):
+            worker_snaps.append(data["telemetry"])
 
     total_steps = sum(a["steps"] for a in agents)
     total_episodes = sum(a["episodes"] for a in agents)
@@ -552,6 +572,14 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     from relayrl_tpu import telemetry
 
     result["telemetry"] = telemetry.get_registry().snapshot()
+    # Data-age / model-age attribution block (ISSUE 14): pooled from the
+    # server-plane histograms (data age is observed server-side at the
+    # consuming dispatch) and the worker snapshots (model age is an
+    # actor-side observation off the publish stamp).
+    from common import age_attribution
+
+    result["age_attribution"] = age_attribution(
+        [result["telemetry"]] + worker_snaps)
     if serving:
         result["serving"] = _serving_row_block(server, agents,
                                                result["telemetry"])

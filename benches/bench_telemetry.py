@@ -28,6 +28,13 @@ setup_platform()
 # registry lookup to the hot path" (~10x regressions).
 MAX_DISABLED_NS = 1500.0
 MAX_ENABLED_COUNTER_NS = 3000.0
+# Tracing plane (ISSUE 14): disabled span sites pay one attribute check
+# on the shared null tracer; a live span record is a dict build + ring
+# append + counter inc (journal off in-bench). Sampling draw is the
+# per-trajectory stride decision.
+MAX_TRACE_DISABLED_NS = 1500.0
+MAX_TRACE_SPAN_NS = 30000.0
+MAX_TRACE_DRAW_NS = 5000.0
 
 
 def _best_ns_per_op(fn, n_ops: int, trials: int) -> float:
@@ -111,6 +118,42 @@ def run() -> list[dict]:
     print(json.dumps(entry))
     rows.append(entry)
 
+    # -- tracing plane: disabled no-op vs live span record (ISSUE 14) --
+    from relayrl_tpu import telemetry as telemetry_mod
+    from relayrl_tpu.telemetry.trace import NULL_TRACER, Tracer
+
+    # The tracer's own counters must be REAL metrics, or the span row
+    # would measure a null-counter inc and flatter the result.
+    telemetry_mod.set_registry(reg)
+    tracer = Tracer(1.0, ring=4096, proc="bench", journal=False)
+
+    def span_disabled(n):
+        t = NULL_TRACER
+        for _ in range(n):
+            if t.enabled:
+                t.span("traj", "x", "env", 0, 1)
+
+    def span_enabled(n):
+        span = tracer.span
+        for _ in range(n):
+            span("traj", "bench-1", "env", 1000, 2000, agent="a")
+
+    def draw_enabled(n):
+        sample = tracer.sample_traj
+        for _ in range(n):
+            sample(1000, 1)
+
+    n_span = max(10_000, n_ops // 10)
+    span_off_ns = _best_ns_per_op(span_disabled, n_ops, trials) - base_ns
+    span_on_ns = _best_ns_per_op(span_enabled, n_span, trials) - base_ns
+    draw_ns = _best_ns_per_op(draw_enabled, n_span, trials) - base_ns
+    row("trace_span_disabled", span_off_ns,
+        {"ceiling_ns": MAX_TRACE_DISABLED_NS})
+    row("trace_span_record_enabled", span_on_ns,
+        {"ceiling_ns": MAX_TRACE_SPAN_NS})
+    row("trace_sample_draw_enabled", draw_ns,
+        {"ceiling_ns": MAX_TRACE_DRAW_NS})
+
     # The contract asserts (the CI teeth): disabled must stay an
     # attribute-call away from free, and the enabled increment must stay
     # lock-free cheap.
@@ -122,6 +165,14 @@ def run() -> list[dict]:
         f"enabled inc {enabled_ns:.0f}ns/op exceeds "
         f"{MAX_ENABLED_COUNTER_NS}ns — the shard hot path gained a "
         f"lock/lookup")
+    assert span_off_ns < MAX_TRACE_DISABLED_NS, (
+        f"trace-off span site {span_off_ns:.0f}ns/op exceeds "
+        f"{MAX_TRACE_DISABLED_NS}ns — the null tracer gained real work")
+    assert span_on_ns < MAX_TRACE_SPAN_NS, (
+        f"span record {span_on_ns:.0f}ns/op exceeds "
+        f"{MAX_TRACE_SPAN_NS}ns — the flight-recorder path regressed")
+    assert draw_ns < MAX_TRACE_DRAW_NS, (
+        f"sampling draw {draw_ns:.0f}ns/op exceeds {MAX_TRACE_DRAW_NS}ns")
     return rows
 
 

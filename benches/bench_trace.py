@@ -1,0 +1,278 @@
+"""Distributed-tracing drill: a sampled end-to-end trace over LIVE zmq
+(ISSUE 14 acceptance).
+
+One process hosts the whole topology (CLOCK_MONOTONIC shared, so every
+cross-plane join is exact): a REINFORCE TrainingServer on live zmq
+sockets, a RelayNode re-broadcasting its model plane and batch-
+forwarding its trajectory plane, one actor connected DIRECT to the
+server and one actor connected THROUGH the relay — sample rate 1.0, so
+every trajectory and every version draws a trace.
+
+The committed row asserts (and records the evidence for):
+
+* one trajectory showing every upstream hop
+  env→encode→send→ingest→dedup→staging→update with monotonic hop starts
+  and non-overlapping spans within each plane (the send→ingest boundary
+  may overlap: delivery is concurrent with the sender's return path —
+  docs/observability.md "Distributed tracing");
+* a relayed trajectory additionally carrying the relay forward hop;
+* one model version showing dispatch→publish→swap applied by BOTH
+  actors AND re-broadcast through the relay hop;
+* the analyzer's data-age / model-age distributions, with the
+  version-lag distribution matching the server-side
+  ``relayrl_rlhf_train_version_lag`` evidence (same samples, two
+  pipelines) within sampling error;
+* the journal→analyzer path: spans are re-read from the NDJSON journal
+  and must reproduce the ring's trace set.
+
+Prints one JSON row; ``--write`` commits benches/results/trace_drill_zmq.json.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+from common import bench_cwd, free_port, quick, setup_platform  # noqa: E402
+
+setup_platform()
+
+TRAJ_ORDER = ("env", "encode", "send", "ingest", "dedup", "staging",
+              "update")
+# Spans recorded by the actor-side plane vs the server-side plane: the
+# non-overlap contract holds WITHIN each (they run on one causal chain);
+# across the wire boundary delivery is concurrent with the sender's
+# return path.
+ACTOR_HOPS = ("env", "encode", "send")
+SERVER_HOPS = ("ingest", "dedup", "staging", "update")
+
+
+def _hop_map(spans: list[dict]) -> dict:
+    return {s["hop"]: s for s in spans}
+
+
+def _trace_contract(spans: list[dict]) -> dict | None:
+    """Check one trajectory trace against the drill contract; returns
+    the evidence row (or None when the trace is incomplete)."""
+    hops = _hop_map(spans)
+    if not set(TRAJ_ORDER) <= set(hops):
+        return None
+    starts_monotonic = all(
+        hops[a]["t0_ns"] <= hops[b]["t0_ns"]
+        for a, b in zip(TRAJ_ORDER, TRAJ_ORDER[1:]))
+    actor_ok = all(hops[a]["t1_ns"] <= hops[b]["t0_ns"]
+                   for a, b in zip(ACTOR_HOPS, ACTOR_HOPS[1:]))
+    server_ok = all(hops[a]["t1_ns"] <= hops[b]["t0_ns"]
+                    for a, b in zip(SERVER_HOPS, SERVER_HOPS[1:]))
+    return {
+        "trace": spans[0]["trace"],
+        "agent": hops["env"].get("agent"),
+        "hops": [{"hop": h, "t0_ns": hops[h]["t0_ns"],
+                  "t1_ns": hops[h]["t1_ns"]} for h in TRAJ_ORDER],
+        "relayed": "relay" in hops,
+        "starts_monotonic": starts_monotonic,
+        "actor_plane_non_overlapping": actor_ok,
+        "server_plane_non_overlapping": server_ok,
+        "born_version": hops["env"].get("version"),
+        "consumed_version": hops["update"].get("version"),
+        "data_age_ms": round((hops["update"]["t1_ns"]
+                              - hops["env"]["t0_ns"]) / 1e6, 3),
+    }
+
+
+def run() -> dict:
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.envs import make
+    from relayrl_tpu.relay.node import RelayNode
+    from relayrl_tpu.runtime.agent import Agent, run_gym_loop
+    from relayrl_tpu.runtime.server import TrainingServer
+    from relayrl_tpu.telemetry import trace
+    from relayrl_tpu.telemetry.events import EventJournal
+
+    scratch = tempfile.mkdtemp(prefix="trace_drill_")
+    journal_path = os.path.join(scratch, "events.ndjson")
+    telemetry.set_registry(telemetry.Registry(run_id="trace-drill"))
+    telemetry.set_journal(EventJournal(journal_path, run_id="trace-drill",
+                                       max_bytes=8 << 20))
+    trace.configure(1.0, ring=16384)
+
+    ports = [free_port() for _ in range(3)]
+    server_addrs = {
+        "agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
+        "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
+        "model_pub_addr": f"tcp://127.0.0.1:{ports[2]}",
+    }
+    relay_base = free_port()
+    t0 = time.time()
+    server = TrainingServer(
+        "REINFORCE", obs_dim=4, act_dim=2,
+        hyperparams={"traj_per_epoch": 2, "seed_salt": 0},
+        server_type="zmq", **server_addrs)
+    server.wait_warmup(60)
+    relay = RelayNode(
+        name="drill-relay", upstream_type="zmq",
+        upstream={
+            "agent_listener_addr": server_addrs["agent_listener_addr"],
+            "trajectory_addr": server_addrs["trajectory_addr"],
+            "model_sub_addr": server_addrs["model_pub_addr"],
+        },
+        downstream_type="zmq", fanout_port=relay_base,
+        batch_linger_ms=5.0)
+    direct = Agent(
+        server_type="zmq", seed=11,
+        model_path=os.path.join(scratch, "direct.rlx"),
+        identity="drill-direct",
+        agent_listener_addr=server_addrs["agent_listener_addr"],
+        trajectory_addr=server_addrs["trajectory_addr"],
+        model_sub_addr=server_addrs["model_pub_addr"])
+    relayed = Agent(
+        server_type="zmq", seed=12,
+        model_path=os.path.join(scratch, "relayed.rlx"),
+        identity="drill-relayed",
+        agent_listener_addr=f"tcp://127.0.0.1:{relay_base}",
+        trajectory_addr=f"tcp://127.0.0.1:{relay_base + 1}",
+        model_sub_addr=f"tcp://127.0.0.1:{relay_base + 2}")
+
+    env_a, env_b = make("CartPole-v1"), make("CartPole-v1")
+    rounds = 4 if quick() else 8
+    deadline = time.time() + (90 if quick() else 180)
+    sink = io.StringIO()
+    with contextlib.redirect_stdout(sink):
+        for _ in range(rounds):
+            run_gym_loop(direct, env_a, episodes=2, max_steps=60)
+            run_gym_loop(relayed, env_b, episodes=2, max_steps=60)
+            time.sleep(0.05)
+        # Keep stepping until both actors demonstrably swapped through
+        # their own plane at least twice and several updates landed.
+        while time.time() < deadline and (
+                server.stats["updates"] < 4
+                or direct.model_version < 2 or relayed.model_version < 2):
+            run_gym_loop(direct, env_a, episodes=1, max_steps=60)
+            run_gym_loop(relayed, env_b, episodes=1, max_steps=60)
+            time.sleep(0.05)
+        server.drain(60)
+        time.sleep(1.0)  # let the relay's linger + SUB threads settle
+
+    spans = trace.snapshot_spans()
+    report = trace.analyze(spans)
+
+    # -- trajectory contract --
+    traj_spans: dict[str, list[dict]] = {}
+    for s in spans:
+        if s["kind"] == "traj":
+            traj_spans.setdefault(s["trace"], []).append(s)
+    complete = [row for row in (_trace_contract(ss)
+                                for ss in traj_spans.values())
+                if row is not None]
+    clean = [r for r in complete
+             if r["starts_monotonic"] and r["actor_plane_non_overlapping"]
+             and r["server_plane_non_overlapping"]]
+    relayed_traces = [r for r in complete if r["relayed"]]
+    assert clean, "no complete trajectory trace with ordered hops"
+    assert relayed_traces, "no trajectory trace crossed the relay hop"
+
+    # -- model contract --
+    model_ok = None
+    for tid, entry in report["models"]["traces"].items():
+        if ({"dispatch", "publish", "swap"} <= set(entry["hops"])
+                and len(entry["actors"]) >= 2 and entry["relay_hops"] >= 1):
+            model_ok = {"trace": tid, **entry}
+            break
+    assert model_ok is not None, (
+        f"no model version traced dispatch→publish→swap across >=2 actors "
+        f"through the relay: {report['models']['traces']}")
+
+    # -- age distributions vs the server-side lag evidence --
+    data_age = report["trajectories"]["data_age_s"]
+    model_age = report["models"]["model_age_s"]
+    lag = report["trajectories"]["data_age_versions"]
+    assert data_age["count"] > 0 and model_age["count"] > 0
+    snap = telemetry.get_registry().snapshot()
+    lag_hist = next(m for m in snap["metrics"]
+                    if m["name"] == "relayrl_rlhf_train_version_lag")
+    hist_mean = (lag_hist["sum"] / lag_hist["count"]
+                 if lag_hist["count"] else None)
+    # Same samples, two pipelines (trace spans vs the live histogram):
+    # the ring is bounded, so allow eviction-induced drift of one
+    # version; counts must overlap substantially.
+    assert hist_mean is not None and lag["count"] > 0
+    assert abs(lag["mean"] - hist_mean) <= 0.5, (
+        f"trace version-lag mean {lag['mean']:.2f} vs train_version_lag "
+        f"histogram mean {hist_mean:.2f}")
+
+    # -- journal → analyzer path reproduces the ring --
+    telemetry.get_journal().close()
+    journal_spans = trace.load_spans([journal_path])
+    journal_report = trace.analyze(journal_spans)
+    assert journal_report["trajectories"]["complete"] >= len(clean), (
+        "NDJSON journal lost trace spans the ring retained")
+
+    # -- chrome export sanity --
+    chrome = trace.to_chrome_trace(spans)
+    assert chrome["traceEvents"], "chrome export produced no events"
+
+    for agent in (direct, relayed):
+        agent.disable_agent()
+    relay.close()
+    server.disable_server()
+    telemetry.reset_for_tests()
+
+    row = {
+        "bench": "trace_drill",
+        "config": {
+            "transport": "zmq", "relays": 1, "actors": 2,
+            "algorithm": "REINFORCE", "sample_rate": 1.0,
+            "quick": quick(),
+        },
+        "spans": len(spans),
+        "per_hop": report["per_hop"],
+        "trajectories": {
+            "traced": report["trajectories"]["traced"],
+            "complete": len(complete),
+            "clean_ordered": len(clean),
+            "relayed": len(relayed_traces),
+            "data_age_s": data_age,
+            "inter_hop_gap_s": report["trajectories"]["inter_hop_gap_s"],
+        },
+        "models": {
+            "traced": report["models"]["traced"],
+            "model_age_s": model_age,
+        },
+        "example_trajectory_trace": clean[0],
+        "example_relayed_trace": relayed_traces[0],
+        "example_model_trace": model_ok,
+        "version_lag": {
+            "trace_mean": round(lag["mean"], 3),
+            "trace_p95": lag["p95"],
+            "train_version_lag_hist_mean": round(hist_mean, 3),
+            "train_version_lag_count": lag_hist["count"],
+        },
+        "journal": {
+            "path_spans": len(journal_spans),
+            "complete_traces": journal_report["trajectories"]["complete"],
+        },
+        "updates": server.stats["updates"],
+        "wall_s": round(time.time() - t0, 1),
+        "telemetry": snap,
+    }
+    print(json.dumps(row))
+    return row
+
+
+def main():
+    bench_cwd()
+    row = run()
+    if "--write" in sys.argv:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "trace_drill_zmq.json")
+        with open(out, "w") as f:
+            f.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -177,3 +177,56 @@ def time_chained(step_fn, state, iters: int, warmup: int = 3,
         state, obs = step_fn(state)
     _fence(obs)
     return (time.perf_counter() - t0) / iters
+
+
+def age_attribution(snapshots: list[dict]) -> dict:
+    """Data-age / model-age attribution block for bench rows (ISSUE 14):
+    pool the ``relayrl_trace_*`` histograms across process snapshots
+    (data age lives server-side, model age actor-side) into one
+    ``{count, mean, p50, p95}`` summary per distribution. Histograms
+    with zero samples report ``{"count": 0}`` — the schema is stable
+    either way, which is what the soak smoke asserts."""
+    from relayrl_tpu.telemetry.top import histogram_quantile
+
+    wanted = {
+        "relayrl_trace_data_age_seconds": "data_age_s",
+        "relayrl_trace_model_age_seconds": "model_age_s",
+        "relayrl_trace_data_age_versions": "data_age_versions",
+    }
+    pooled: dict[str, dict] = {}
+    sampled = spans = 0.0
+    for snap in snapshots:
+        for m in (snap or {}).get("metrics", []):
+            name = m.get("name")
+            if name == "relayrl_trace_sampled_total":
+                sampled += m.get("value") or 0
+            elif name == "relayrl_trace_spans_total":
+                spans += m.get("value") or 0
+            if name not in wanted or m.get("kind") != "histogram":
+                continue
+            agg = pooled.get(name)
+            if agg is None:
+                pooled[name] = {"buckets": list(m["buckets"]),
+                                "counts": list(m["counts"]),
+                                "sum": m.get("sum") or 0.0,
+                                "count": m.get("count") or 0,
+                                "kind": "histogram"}
+            else:
+                # Same metric family ⇒ same registered grid everywhere.
+                for i, c in enumerate(m["counts"]):
+                    agg["counts"][i] += c
+                agg["sum"] += m.get("sum") or 0.0
+                agg["count"] += m.get("count") or 0
+    out = {"trace_sampled": int(sampled), "trace_spans": int(spans)}
+    for name, key in wanted.items():
+        agg = pooled.get(name)
+        if not agg or not agg["count"]:
+            out[key] = {"count": 0}
+            continue
+        out[key] = {
+            "count": int(agg["count"]),
+            "mean": round(agg["sum"] / agg["count"], 6),
+            "p50": round(histogram_quantile(agg, 0.5), 6),
+            "p95": round(histogram_quantile(agg, 0.95), 6),
+        }
+    return out

@@ -237,7 +237,8 @@ class OffPolicyAlgorithm(AlgorithmBase):
             # cover the whole fused dispatch (the k-th update's params).
             self._last_metrics = LazyMetrics(self._guard_merge_probes(
                 {key: v[-1] for key, v in ms.items()}, probe_base))
-            self.inflight.push((ms, self._last_metrics.device))
+            self.inflight.push((ms, self._last_metrics.device),
+                               version=self.dispatched_version)
             i += k
         for b in host_batches[i:]:
             self.train_on_batch(b)
@@ -259,7 +260,7 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._dispatched_updates += 1
         metrics = self._guard_merge_probes(metrics, probe_base)
         self._last_metrics = LazyMetrics(metrics)
-        self.inflight.push(metrics)
+        self.inflight.push(metrics, version=self.dispatched_version)
         # No logger.store here (the old per-update rows were never
         # consumed: log_epoch passes explicit values to log_tabular, so
         # the stored lists only grew for the life of the process — and as

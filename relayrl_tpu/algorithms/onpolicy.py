@@ -202,7 +202,7 @@ class OnPolicyAlgorithm(AlgorithmBase):
         self._dispatched_updates += 1
         metrics = self._guard_merge_probes(metrics, probe_base)
         self._last_metrics = LazyMetrics(metrics)
-        self.inflight.push(metrics)
+        self.inflight.push(metrics, version=self.dispatched_version)
         return self._last_metrics
 
     def train_model(self) -> Mapping[str, float]:

@@ -502,9 +502,25 @@ DEFAULT_CONFIG: dict = {
         # NDJSON run-event journal (model publish/swap, agent register/
         # unregister/reconnect, drop, checkpoint, drain). null disables.
         "events_path": None,
+        # Size bound for the journal: past this many bytes the file
+        # rotates once to `<events_path>.1` (torn-tail-tolerant across
+        # the boundary; read_events stitches both generations), so
+        # multi-hour soaks and the trace-span NDJSON export can't grow
+        # it unbounded. 0 = no rotation.
+        "events_max_bytes": 0,
         # Run identity stamped on every snapshot and journal line; null
         # derives one from pid + start time.
         "run_id": None,
+        # Distributed tracing (telemetry/trace.py): the fraction of
+        # trajectories/versions that draw a trace context (0 = the null
+        # tracer, every span site a single attribute check; 1 = trace
+        # everything — drills and tests). Sampled trajectory contexts
+        # ride the envelope id beside the #s seq tag; model versions
+        # sample by a deterministic hash so every process agrees.
+        "trace_sample_rate": 0.0,
+        # Flight-recorder capacity (spans, oldest evicted) behind the
+        # /traces endpoint and the Chrome-trace dump.
+        "trace_ring": 4096,
     },
     "model_paths": {
         "client_model": "client_model.rlx",

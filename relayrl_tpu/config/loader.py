@@ -565,6 +565,21 @@ class ConfigLoader:
         except (TypeError, ValueError):
             params["port"] = 9100
         params["host"] = str(params.get("host") or "127.0.0.1")
+        try:
+            params["events_max_bytes"] = max(
+                0, int(params.get("events_max_bytes") or 0))
+        except (TypeError, ValueError):
+            params["events_max_bytes"] = 0
+        try:
+            params["trace_sample_rate"] = min(
+                1.0, max(0.0, float(params.get("trace_sample_rate") or 0.0)))
+        except (TypeError, ValueError):
+            params["trace_sample_rate"] = 0.0
+        try:
+            params["trace_ring"] = max(16, int(params.get("trace_ring")
+                                               or 4096))
+        except (TypeError, ValueError):
+            params["trace_ring"] = 4096
         return params
 
     def raw(self) -> dict:

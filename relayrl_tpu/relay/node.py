@@ -364,6 +364,11 @@ class RelayNode:
         self._rebroadcast(version, blob)
 
     def _rebroadcast(self, version: int, blob: bytes) -> None:
+        from relayrl_tpu.telemetry import trace as trace_mod
+
+        tracer = trace_mod.get_tracer()
+        traced = tracer.enabled and tracer.sample_version(version)
+        t0_ns = time.monotonic_ns() if traced else 0
         parts = (((0.0, blob),) if self._fault_model is None
                  else self._fault_model.inject(blob))
         for delay_s, part in parts:
@@ -377,6 +382,13 @@ class RelayNode:
                 return
             self._m_fwd_model.inc()
             self._m_bytes_model.inc(len(part))
+        if traced:
+            # The re-broadcast hop of a sampled version's downstream
+            # trace: upstream receipt already stamped by the agent
+            # transport; this span is the subtree fan-out itself.
+            tracer.span("model", trace_mod.model_trace_id(version),
+                        "relay", t0_ns, time.monotonic_ns(),
+                        version=int(version), relay=self.name)
 
     def _get_model(self) -> tuple[int, bytes]:
         """Downstream handshake: the cached v1 bundle. When the relay
@@ -479,9 +491,13 @@ class RelayNode:
         """One subtree envelope (downstream transport thread). The id
         arrives with the leaf's seq tag intact and MUST leave with it
         intact — attribution and dedup belong to the leaves."""
-        from relayrl_tpu.transport.base import split_agent_seq
+        from relayrl_tpu.transport.base import (
+            split_agent_seq,
+            split_agent_trace,
+        )
 
         clean_id, _seq = split_agent_seq(tagged_id)
+        clean_id, _trace = split_agent_trace(clean_id)
         with self._subtree_lock:
             if len(self._subtree_agents) < 65536:
                 self._subtree_agents.add(clean_id)
@@ -546,18 +562,40 @@ class RelayNode:
         self._m_batches.inc()
         self._m_fwd_traj.inc(len(group))
         self._m_bytes_traj.inc(len(container))
+        t0_ns = time.monotonic_ns()
         if self.spool is not None:
             self.spool.send_verbatim(container, self.batch_id)
         else:
             self._try_forward(container, self.batch_id)
+        for tid, _payload in group:
+            self._trace_forward_span(tid, t0_ns)
+
+    def _trace_forward_span(self, tagged_id: str, t0_ns: int) -> None:
+        """Upstream-trace relay hop: a sampled trajectory's context
+        rides the forwarded envelope id verbatim — peel it (without
+        touching the wire id) and record this hop's forward time."""
+        from relayrl_tpu.telemetry import trace as trace_mod
+        from relayrl_tpu.transport.base import split_agent_seq
+
+        tracer = trace_mod.get_tracer()
+        if not tracer.enabled:
+            return
+        base, _seq = split_agent_seq(tagged_id)
+        _clean, ctx = trace_mod.split_ctx(base)
+        if ctx is None:
+            return
+        tracer.span("traj", ctx.trace_id, "relay", t0_ns,
+                    time.monotonic_ns(), relay=self.name)
 
     def _forward_one(self, tagged_id: str, payload: bytes) -> None:
         self._m_fwd_traj.inc()
         self._m_bytes_traj.inc(len(payload))
+        t0_ns = time.monotonic_ns()
         if self.spool is not None:
             self.spool.send_verbatim(payload, tagged_id)
         else:
             self._try_forward(payload, tagged_id)
+        self._trace_forward_span(tagged_id, t0_ns)
 
     def _try_forward(self, payload: bytes, wire_id: str) -> None:
         """Spool-less direct forward: drop on failure, never crash the

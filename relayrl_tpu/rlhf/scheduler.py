@@ -196,6 +196,11 @@ class ScoreStage:
                 batch = self._gather()
                 if not batch:
                     continue
+                from relayrl_tpu.telemetry import trace as trace_mod
+
+                tracer = trace_mod.get_tracer()
+                trace_id = tracer.sample_id("rlhf")
+                t0_ns = time.monotonic_ns() if trace_id else 0
                 t0 = time.monotonic()
                 episodes = []
                 for lane, payload in batch:
@@ -205,6 +210,10 @@ class ScoreStage:
                     episodes.append((lane, records, tokens, gen_len, marker))
                 scores = self._score_batch(episodes)
                 self._m_score_s.observe(time.monotonic() - t0)
+                if trace_id:
+                    t1_ns = time.monotonic_ns()
+                    tracer.span("rlhf", trace_id, "score", t0_ns, t1_ns,
+                                episodes=len(episodes))
                 t1 = time.monotonic()
                 held = (int(self.version_fn())
                         if self.version_fn is not None else None)
@@ -226,6 +235,10 @@ class ScoreStage:
                     with self._scored_lock:
                         self.scored.append(float(score))
                 self._m_emit_s.observe(time.monotonic() - t1)
+                if trace_id:
+                    tracer.span("rlhf", trace_id, "emit", t1_ns,
+                                time.monotonic_ns(),
+                                episodes=len(episodes))
         except BaseException as e:  # surfaced on the next submit/close
             self._error = e
             print(f"[rlhf] score stage died: {e!r}", flush=True)
@@ -296,7 +309,24 @@ class GenerationStage:
                 self.host.flag_last_action(lane, 0.0, terminated=True)
                 done += 1
         self._m_tokens.inc(self.venv.num_envs)
-        self._m_gen_s.observe(time.monotonic() - t0)
+        gen_dt = time.monotonic() - t0
+        self._m_gen_s.observe(gen_dt)
+        if done:
+            # Trace draw at EPISODE granularity only (this round closed
+            # at least one generation) — a per-token draw would churn
+            # the sampling lock and, at rate 1.0, flood the flight
+            # recorder with one span per token across all lanes.
+            from relayrl_tpu.telemetry import trace as trace_mod
+
+            tracer = trace_mod.get_tracer()
+            if tracer.enabled:
+                trace_id = tracer.sample_id("rlhf")
+                if trace_id:
+                    now_ns = time.monotonic_ns()
+                    tracer.span("rlhf", trace_id, "generate",
+                                now_ns - int(gen_dt * 1e9), now_ns,
+                                lanes=self.venv.num_envs,
+                                episodes=done)
         self.tokens_generated += self.venv.num_envs
         self.episodes_done += done
         self.episodes_started += done  # autoreset: a new one began

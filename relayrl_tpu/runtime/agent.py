@@ -63,6 +63,45 @@ def _deliver_model(actor_host, transport, client_model_path: str, tag: str,
             pass
 
 
+def _trace_emit(agent_id: str, born_ns: int, enc0_ns: int, enc1_ns: int,
+                version: int):
+    """Distributed-tracing emission hook shared by Agent and VectorAgent
+    (telemetry/trace.py): sample a trajectory trace context and record
+    the actor-side ``env`` (production) and ``encode`` (serialize) hop
+    spans. Returns the context (riding the wire as the ``#t`` id tag)
+    or None — one tracer read per *trajectory*, never per step."""
+    from relayrl_tpu.telemetry import trace as trace_mod
+
+    tracer = trace_mod.get_tracer()
+    if not tracer.enabled or not born_ns:
+        return None
+    ctx = tracer.sample_traj(born_ns, version)
+    if ctx is None:
+        return None
+    import time
+
+    now = time.monotonic_ns()
+    enc0 = enc0_ns if born_ns <= enc0_ns <= now else now
+    enc1 = max(enc0, min(enc1_ns, now)) if enc1_ns else enc0
+    tracer.span("traj", ctx.trace_id, "env", born_ns, enc0,
+                agent=agent_id, version=int(version))
+    if enc1 > enc0:
+        tracer.span("traj", ctx.trace_id, "encode", enc0, enc1,
+                    agent=agent_id)
+    return ctx
+
+
+def _trace_send_span(ctx, agent_id: str, t0_ns: int) -> None:
+    if ctx is None:
+        return
+    import time
+
+    from relayrl_tpu.telemetry import trace as trace_mod
+
+    trace_mod.get_tracer().span("traj", ctx.trace_id, "send", t0_ns,
+                                time.monotonic_ns(), agent=agent_id)
+
+
 def _bind_spool_impl(owner, name: str) -> None:
     """Create (first enable) or re-bind (restart) the owner's trajectory
     spool (runtime/spool.py). Shared by Agent and VectorAgent so the
@@ -196,19 +235,35 @@ class Agent:
                        version=version, side="agent")
 
     def _send_traj(self, payload: bytes) -> None:
+        # Runs inside Trajectory.flush, so the trajectory's born/encode
+        # stamps describe exactly the chunk in `payload`.
+        traj = self.actor.trajectory
+        ctx = _trace_emit(self.transport.identity, traj.born_ns,
+                          traj.encode_t0_ns, traj.encode_t1_ns,
+                          self.actor.version)
+        t0 = 0
+        if ctx is not None:
+            import time
+
+            t0 = time.monotonic_ns()
         if self.spool is not None:
-            self.spool.send(payload, self.transport.identity)
+            self.spool.send(payload, self.transport.identity,
+                            trace=None if ctx is None else ctx.encode())
         else:  # actor.spool_entries == 0: the pre-recovery direct path
-            from relayrl_tpu.transport.base import IngestNack
+            from relayrl_tpu.transport.base import IngestNack, tag_agent_trace
 
             try:
-                self.transport.send_trajectory(payload)
+                self.transport.send_trajectory(
+                    payload,
+                    agent_id=(None if ctx is None else tag_agent_trace(
+                        self.transport.identity, ctx.encode())))
             except IngestNack:
                 # The server answered with a guardrail verdict
                 # (quarantine/overload). Spool-less there is nothing to
                 # retain or replay — drop, never crash the env loop
                 # (the spooled path routes this through spool._attempt).
                 pass
+        _trace_send_span(ctx, self.transport.identity, t0)
 
     def _bind_spool(self) -> None:
         name = self._addr_overrides.get("identity") or "agent"
@@ -478,13 +533,38 @@ class VectorAgent:
         self.active = False
 
     def _send_lane(self, lane: int, payload: bytes) -> None:
+        # Emission stamps read BEFORE the interceptor (it may withhold
+        # and re-inject much later, when the host's stamps describe a
+        # different episode — re-injected payloads trace through the
+        # RLHF plane's own stage spans instead).
+        stamps = self._emit_stamps(lane)
         if self._send_interceptor is not None:
             payload = self._send_interceptor(lane, payload)
             if payload is None:
                 return  # the stage owns it now; emit_lane re-injects
-        self.emit_lane(lane, payload)
+        self.emit_lane(lane, payload, _stamps=stamps)
 
-    def emit_lane(self, lane: int, payload: bytes) -> None:
+    def _emit_stamps(self, lane: int):
+        """(born_ns, encode_t0_ns, encode_t1_ns) for the payload being
+        emitted right now, or None when tracing is off: anakin columnar
+        hosts stamp ``_last_emit_stamps`` per frame; the per-record
+        tiers read the lane trajectory's chunk stamps (we are inside
+        its flush)."""
+        from relayrl_tpu.telemetry import trace as trace_mod
+
+        if not trace_mod.get_tracer().enabled:
+            return None
+        host = self.host
+        stamps = getattr(host, "_last_emit_stamps", None)
+        if stamps is not None:
+            return stamps
+        trajs = getattr(host, "trajectories", None)
+        if trajs is None:
+            return None
+        traj = trajs[lane]
+        return (traj.born_ns, traj.encode_t0_ns, traj.encode_t1_ns)
+
+    def emit_lane(self, lane: int, payload: bytes, _stamps=None) -> None:
         """Ship one lane's serialized episode through the normal
         spool/seq/transport path — the re-injection surface for a
         ``send_interceptor`` stage (the RLHF score stage emits here
@@ -492,16 +572,31 @@ class VectorAgent:
         assigned HERE, so withheld episodes only enter the at-least-once
         window once they are final — a replay after a crash redelivers
         the scored bytes, never the unscored ones."""
+        ctx = None
+        t0 = 0
+        if _stamps is not None:
+            born_ns, enc0, enc1 = _stamps
+            ctx = _trace_emit(self.agent_ids[lane], born_ns, enc0, enc1,
+                              self.host.version)
+            if ctx is not None:
+                import time
+
+                t0 = time.monotonic_ns()
         if self.spool is not None:
-            self.spool.send(payload, self.agent_ids[lane])
+            self.spool.send(payload, self.agent_ids[lane],
+                            trace=None if ctx is None else ctx.encode())
         else:
-            from relayrl_tpu.transport.base import IngestNack
+            from relayrl_tpu.transport.base import IngestNack, tag_agent_trace
 
             try:
-                self.transport.send_trajectory(payload,
-                                               agent_id=self.agent_ids[lane])
+                self.transport.send_trajectory(
+                    payload,
+                    agent_id=(self.agent_ids[lane] if ctx is None
+                              else tag_agent_trace(self.agent_ids[lane],
+                                                   ctx.encode())))
             except IngestNack:
                 pass  # guardrail verdict, spool-less: drop (see Agent)
+        _trace_send_span(ctx, self.agent_ids[lane], t0)
 
     def _on_model(self, version: int, bundle_bytes: bytes) -> None:
         # ONE receipt serves all lanes: a single wire-aware swap

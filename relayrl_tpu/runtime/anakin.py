@@ -202,6 +202,12 @@ class AnakinActorHost:
         self.emit_coalesce = max(1, int(emit_coalesce_frames))
         self._coalesce_buf: list[list[bytes]] = [
             [] for _ in range(self.num_envs)]
+        # Tracing stamps (telemetry/trace.py): the window production
+        # stamp (rollout dispatch start) plus the last frame's encode
+        # bracket, read by VectorAgent._emit_stamps when it mints a
+        # trajectory trace context for an emitted columnar segment.
+        self._window_born_ns = 0
+        self._last_emit_stamps: tuple[int, int, int] | None = None
         self.trajectories = [
             Trajectory(
                 max_length=max_traj_length,
@@ -286,6 +292,7 @@ class AnakinActorHost:
         accumulate on :attr:`episode_returns` per lane.
         """
         t0 = time.monotonic()
+        born_ns = time.monotonic_ns()
         with self._lock:
             # ONE params/explore read under the lock for the whole
             # window: every step of this window is computed by a single
@@ -306,13 +313,15 @@ class AnakinActorHost:
                 # waits — backpressure, not unbounded window buffering.
                 while self._emit_pending >= 2 and not self._emit_stop:
                     self._emit_cond.wait(0.5)
-                self._emit_queue.append(host_window)
+                self._emit_queue.append((born_ns, host_window))
                 self._emit_pending += 1
                 self._emit_cond.notify_all()
             episodes = 0  # completed counts surface via episode_returns
         elif self.columnar_wire:
+            self._window_born_ns = born_ns
             episodes = self._emit_columnar(host_window)
         else:
+            self._window_born_ns = born_ns
             episodes = self._unstack(host_window)
         t2 = time.monotonic()
         steps = self.num_envs * self.unroll_length
@@ -350,7 +359,8 @@ class AnakinActorHost:
                     self._emit_cond.wait(0.5)
                 if self._emit_stop and not self._emit_queue:
                     return
-                w = self._emit_queue.pop(0)
+                born_ns, w = self._emit_queue.pop(0)
+            self._window_born_ns = born_ns  # single emitter thread
             t0 = time.monotonic()
             try:
                 if self.columnar_wire:
@@ -515,7 +525,15 @@ class AnakinActorHost:
                      "r": r, "t": t_col, "u": u_col, "x": x_col},
             aux={k: self._cat(chunks) for k, chunks in p["aux"].items()},
             final_obs=final if time_limited else None)
-        frame = encode_columnar_frame(dt)
+        from relayrl_tpu.telemetry import trace as trace_mod
+
+        if trace_mod.get_tracer().enabled:
+            enc0 = time.monotonic_ns()
+            frame = encode_columnar_frame(dt)
+            self._last_emit_stamps = (self._window_born_ns or enc0,
+                                      enc0, time.monotonic_ns())
+        else:
+            frame = encode_columnar_frame(dt)
         self._m_frames.inc()
         self._m_frame_bytes.inc(len(frame))
         if self.emit_coalesce > 1:

@@ -76,7 +76,7 @@ class InferRequest:
     """One queued observation request (decoded, transport-agnostic)."""
 
     __slots__ = ("agent_id", "req_id", "key", "obs", "mask", "reply",
-                 "t_enqueue")
+                 "t_enqueue", "trace", "t_enqueue_ns")
 
     def __init__(self, agent_id, req_id, key, obs, mask, reply):
         self.agent_id = agent_id
@@ -86,6 +86,11 @@ class InferRequest:
         self.mask = mask
         self.reply = reply
         self.t_enqueue = time.monotonic()
+        # Distributed tracing (telemetry/trace.py): a sampled request
+        # draws a serve-plane trace id at submit; its queue/dispatch
+        # hops record at batch execution.
+        self.trace = None
+        self.t_enqueue_ns = 0
 
 
 def default_buckets(max_batch: int) -> list[int]:
@@ -217,12 +222,15 @@ class InferenceService:
         self._m_dispatch_s = reg.histogram(
             "relayrl_serving_dispatch_seconds",
             "one batched policy dispatch (device compute + reply encode)")
+        from relayrl_tpu.telemetry.core import LATENCY_BUCKETS_WIDE
+
         self._m_request_s = reg.histogram(
             "relayrl_serving_request_seconds",
             "request enqueue to reply handoff (queue wait + batch close "
             "wait + dispatch share)",
-            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
-                     0.1, 0.25, 1.0, 5.0))
+            # Wide log-spaced grid (ISSUE 14 bucket audit): the old 5 s
+            # top bucket pinned overload-backlogged requests in +Inf.
+            buckets=LATENCY_BUCKETS_WIDE)
         import weakref
 
         wref = weakref.ref(self)
@@ -373,6 +381,15 @@ class InferenceService:
         when the queue is at ``serving.queue_limit`` (False — bounded
         queue = bounded worst-case latency; the client's retry-after
         honor is the backpressure loop)."""
+        from relayrl_tpu.telemetry import trace as trace_mod
+
+        tracer = trace_mod.get_tracer()
+        if tracer.enabled:
+            # Both trace fields must be final BEFORE the request becomes
+            # visible to the batch worker — it reads them at gather time.
+            req.trace = tracer.sample_id("serve")
+            if req.trace is not None:
+                req.t_enqueue_ns = time.monotonic_ns()
         with self._cond:
             if len(self._queue) >= self.queue_limit or self._stop.is_set():
                 overloaded = True
@@ -497,6 +514,21 @@ class InferenceService:
         self._m_dispatch_s.observe(now - t0)
         for req in batch:
             self._m_request_s.observe(now - req.t_enqueue)
+        traced = [req for req in batch if req.trace is not None]
+        if traced:
+            # Serve-plane hop spans for sampled requests: queue (enqueue
+            # → batch gather) and dispatch (gather → reply handoff).
+            from relayrl_tpu.telemetry import trace as trace_mod
+
+            tracer = trace_mod.get_tracer()
+            now_ns = time.monotonic_ns()
+            t0_ns = now_ns - int((now - t0) * 1e9)
+            for req in traced:
+                tracer.span("serve", req.trace, "queue",
+                            req.t_enqueue_ns, t0_ns,
+                            agent=req.agent_id)
+                tracer.span("serve", req.trace, "dispatch", t0_ns,
+                            now_ns, occupancy=len(batch))
 
     def _dispatch_group(self, group: list[InferRequest], params,
                         version: int, explore: dict) -> None:
@@ -619,12 +651,15 @@ class RemoteActorClient:
         self._m_steps = reg.counter(
             "relayrl_actor_env_steps_total",
             "policy steps served (one per env step per lane)")
+        from relayrl_tpu.telemetry.core import LATENCY_BUCKETS_WIDE
+
         self._m_request_s = reg.histogram(
             "relayrl_serving_client_request_seconds",
             "one action round-trip on the client (send to decoded reply, "
             "retries included)",
-            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
-                     0.1, 0.25, 1.0, 5.0))
+            # Wide grid (ISSUE 14 bucket audit): retries through an open
+            # breaker legitimately stack past the old 5 s top bucket.
+            buckets=LATENCY_BUCKETS_WIDE)
         self._m_retries = reg.counter(
             "relayrl_serving_client_retries_total",
             "inference request attempts beyond the first")
@@ -704,13 +739,31 @@ class RemoteActorClient:
         _handle_reconnect_impl(self, [self.transport.identity])
 
     def _send_traj(self, payload: bytes) -> None:
+        # Trajectory tracing parity with Agent._send_traj: the thin
+        # client's episodes draw trace contexts too (env hop = the
+        # round-trip-served production window).
+        from relayrl_tpu.runtime.agent import _trace_emit, _trace_send_span
+
+        traj = self.trajectory
+        ctx = _trace_emit(self.transport.identity, traj.born_ns,
+                          traj.encode_t0_ns, traj.encode_t1_ns,
+                          self.version)
+        t0 = 0
+        if ctx is not None:
+            t0 = time.monotonic_ns()
         if self.spool is not None:
-            self.spool.send(payload, self.transport.identity)
+            self.spool.send(payload, self.transport.identity,
+                            trace=None if ctx is None else ctx.encode())
+            _trace_send_span(ctx, self.transport.identity, t0)
         else:
-            from relayrl_tpu.transport.base import IngestNack
+            from relayrl_tpu.transport.base import IngestNack, tag_agent_trace
 
             try:
-                self.transport.send_trajectory(payload)
+                self.transport.send_trajectory(
+                    payload,
+                    agent_id=(None if ctx is None else tag_agent_trace(
+                        self.transport.identity, ctx.encode())))
+                _trace_send_span(ctx, self.transport.identity, t0)
             except IngestNack:
                 pass  # guardrail verdict, spool-less: drop (see Agent)
 

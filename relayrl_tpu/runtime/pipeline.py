@@ -116,10 +116,13 @@ class InflightWindow:
         """Dispatched-but-unfenced updates (the drain() contract)."""
         return len(self._entries)
 
-    def push(self, fences: Any) -> None:
+    def push(self, fences: Any, version: int | None = None) -> None:
         """Record one dispatched update; blocks only when the window is
-        already full (fencing the oldest)."""
-        self._entries.append(fences)
+        already full (fencing the oldest). ``version`` (the dispatching
+        algorithm's host version mirror) labels the eventual fence span
+        on the distributed-tracing plane — optional, never read
+        otherwise."""
+        self._entries.append((fences, version))
         self.dispatch_count += 1
         while len(self._entries) > self.max_in_flight:
             self._fence_oldest()
@@ -134,10 +137,23 @@ class InflightWindow:
     def _fence_oldest(self) -> None:
         import jax
 
-        fences = self._entries.popleft()
+        fences, version = self._entries.popleft()
         t0 = time.monotonic()
+        t0_ns = 0
+        if version is not None:
+            from relayrl_tpu.telemetry import trace as trace_mod
+
+            tracer = trace_mod.get_tracer()
+            if tracer.enabled and tracer.sample_version(version):
+                t0_ns = time.monotonic_ns()
         jax.block_until_ready(fences)
         dt = time.monotonic() - t0
+        if t0_ns:
+            from relayrl_tpu.telemetry import trace as trace_mod
+
+            trace_mod.get_tracer().span(
+                "model", trace_mod.model_trace_id(version), "fence",
+                t0_ns, time.monotonic_ns(), version=int(version))
         self.device_wait_s += dt
         self.fenced_count += 1
         self._m_device_wait.observe(dt)

@@ -69,6 +69,10 @@ def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
         raise ValueError(
             f"model arch changed {actor.arch} -> {bundle.arch}; "
             "actor refuses hot-swap (param-ABI guard)")
+    from relayrl_tpu.telemetry import trace as trace_mod
+
+    tracer = trace_mod.get_tracer()
+    t0_ns = time.monotonic_ns() if tracer.enabled else 0
     t0 = time.monotonic()
     with actor._lock:
         if dict(bundle.arch) != actor.arch:
@@ -83,6 +87,13 @@ def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
         "relayrl_actor_swap_seconds",
         "model hot-swap: lock wait + params install").observe(
             time.monotonic() - t0)
+    if tracer.enabled and tracer.sample_version(bundle.version):
+        # The downstream trace's terminal hop: this actor host applied
+        # the sampled version (actor field distinguishes hosts sharing
+        # one process — the in-process drill's topology).
+        tracer.span("model", trace_mod.model_trace_id(bundle.version),
+                    "swap", t0_ns, time.monotonic_ns(),
+                    version=int(bundle.version), actor=f"{id(actor):x}")
     telemetry.emit("model_swap", version=bundle.version)
     return True
 

@@ -34,9 +34,11 @@ from typing import Any, Mapping
 from relayrl_tpu.algorithms import build_algorithm, registered_algorithms
 from relayrl_tpu.config import ConfigLoader
 from relayrl_tpu.transport import make_server_transport
+from relayrl_tpu.telemetry.trace import split_ctx as _split_trace_ctx
 from relayrl_tpu.transport.base import (
     BATCH_KIND_ENVELOPES,
     batch_kind,
+    split_agent_seq,
     split_batch,
     swallow_decode_error,
     unpack_trajectory_envelope,
@@ -73,6 +75,29 @@ class _EventCoalescer:
         if due:
             self._last = time.monotonic()
         return due
+
+
+class _TracedRecords(list):
+    """A ``list[ActionRecord]`` that can carry a trace context attribute
+    (plain lists can't) — behaves identically through accumulate."""
+
+    trace_ctx = None
+
+
+def _attach_trace_ctx(item, ctx):
+    """Hang a sampled trajectory's trace context on the decoded item so
+    the learner thread can attribute the consuming update dispatch."""
+    if isinstance(item, DecodedTrajectory):
+        item.trace_ctx = ctx
+        return item
+    if isinstance(item, list):
+        if item and isinstance(item[0], DecodedTrajectory):
+            item[0].trace_ctx = ctx  # coalesced frames: one ctx, one seq
+            return item
+        wrapped = _TracedRecords(item)
+        wrapped.trace_ctx = ctx
+        return wrapped
+    return item
 
 
 class TrainingServer:
@@ -151,7 +176,8 @@ class TrainingServer:
             "behavior version (data['bver'], stamped at generation) vs "
             "the learner's dispatched version when the trajectory "
             "trains — the off-policy distance V-trace corrects; "
-            "observed only for trajectories that carry bver",
+            "observed for trajectories that carry bver, or a sampled "
+            "trace context's born_version (same evidence)",
             buckets=LAG_BUCKETS)
         self._m_ckpt_failures = reg.counter(
             "relayrl_server_checkpoint_failures_total",
@@ -481,6 +507,12 @@ class TrainingServer:
         from collections import deque
 
         self._pending_logs: deque = deque()
+        # Sampled trajectory contexts staged-but-not-yet-consumed: the
+        # next update dispatch closes them out with an "update" span +
+        # the data-age observation (learner thread only). Bounded as a
+        # belt — contexts only enter while the tracer is live, but a
+        # plugin algorithm that never updates must not hoard them.
+        self._trace_pending: deque = deque(maxlen=8192)
         self._timings_lock = threading.Lock()
         # "dropped" counts transport/queue-level losses; the ingest
         # finite-value guard's count is mirrored from the algorithm after
@@ -609,6 +641,12 @@ class TrainingServer:
                 "serving_addr",
                 self.config.get_inference_server().address))
 
+    @staticmethod
+    def _get_tracer():
+        from relayrl_tpu.telemetry import trace as trace_mod
+
+        return trace_mod.get_tracer()
+
     # -- transport callbacks (transport threads!) --
     def _count_dropped(self, n: int = 1) -> None:
         """stats['dropped'] is written from transport threads AND the N
@@ -654,19 +692,21 @@ class TrainingServer:
 
             telemetry.emit("duplicate_drop", n=due)
 
-    def _admit_seq(self, agent_id: str) -> tuple[str, int | None, bool]:
-        """Split a sequence-tagged envelope id and consult the dedup
-        ledger: ``(clean_agent_id, seq, admit)``. Untagged ids (raw
-        transport users, pre-spool fleets) always admit with seq None."""
-        from relayrl_tpu.transport.base import split_agent_seq
-
+    def _admit_seq(self, agent_id: str):
+        """Split the sequence AND trace tags off an envelope id and
+        consult the dedup ledger: ``(clean_agent_id, seq, ctx, admit)``.
+        Both tags strip unconditionally — like the seq tag, a trace
+        context must never leak into attribution/quarantine keys even
+        when this process records no spans. Untagged ids (raw transport
+        users, pre-spool fleets) admit with seq/ctx None."""
         clean_id, seq = split_agent_seq(agent_id)
+        clean_id, ctx = _split_trace_ctx(clean_id)
         if seq is None or self._ingest_ledger is None:
-            return clean_id, None, True
+            return clean_id, seq, ctx, True
         if not self._ingest_ledger.accept(clean_id, seq):
             self._count_duplicate()
-            return clean_id, seq, False
-        return clean_id, seq, True
+            return clean_id, seq, ctx, False
+        return clean_id, seq, ctx, True
 
     def _on_trajectory(self, agent_id: str, payload: bytes) -> None:
         if self._fault_ingest is not None:
@@ -699,7 +739,10 @@ class TrainingServer:
             split_agent_seq,
         )
 
+        from relayrl_tpu.transport.base import split_agent_trace
+
         agent_id, _ = split_agent_seq(tagged_id)
+        agent_id, _ = split_agent_trace(agent_id)
         if self._halted:
             # NOT counted as a halted drop: an overload nack is retained
             # by the sender's spool and replayed — counting each replay
@@ -751,7 +794,24 @@ class TrainingServer:
                     continue
                 self._ingest_one(inner_id, inner_payload, depth=depth + 1)
             return
-        agent_id, seq, admit = self._admit_seq(agent_id)
+        # Trace hops (telemetry/trace.py): clock reads gate on a live
+        # tracer, span recording on the envelope actually carrying a
+        # sampled context — the untraced hot path pays one attribute
+        # check plus (tracer live) one monotonic_ns.
+        tracer = self._get_tracer()
+        t_arr = time.monotonic_ns() if tracer.enabled else 0
+        agent_id, seq, ctx, admit = self._admit_seq(agent_id)
+        if not tracer.enabled:
+            # The tag is stripped regardless; the context only FLOWS when
+            # this process traces (a mixed fleet — traced actors, trace-
+            # off server — must not accumulate contexts it never drains).
+            ctx = None
+        elif ctx is not None:
+            t_ded = time.monotonic_ns()
+            tracer.span("traj", ctx.trace_id, "ingest", t_arr, t_arr,
+                        agent=agent_id, seq=seq)
+            tracer.span("traj", ctx.trace_id, "dedup", t_arr, t_ded,
+                        admitted=bool(admit))
         if not admit:
             return
 
@@ -782,7 +842,7 @@ class TrainingServer:
                 if verdict == "evict":
                     self._evict_oldest_raw()
         try:
-            self._ingest.put_nowait((agent_id, seq, payload))
+            self._ingest.put_nowait((agent_id, seq, ctx, payload))
             if g is not None and g.admission is not None:
                 g.admission.note_enqueued(agent_id)
         except queue.Full:
@@ -795,7 +855,7 @@ class TrainingServer:
         retracted from the dedup ledger so the owning actor's spool can
         redeliver it when pressure clears."""
         try:
-            victim_id, victim_seq, _ = self._ingest.get_nowait()
+            victim_id, victim_seq, _ctx, _ = self._ingest.get_nowait()
         except queue.Empty:
             return
         self._ingest.task_done()
@@ -812,9 +872,22 @@ class TrainingServer:
         core; they are split + deduped here, and the clean id is written
         back so per-agent attribution stays tag-free downstream."""
         g = self.guardrails
+        tracer = self._get_tracer()
+        t_arr = time.monotonic_ns() if tracer.enabled else 0
         admitted = []
         for item in batch:
-            clean_id, seq, admit = self._admit_seq(item.agent_id)
+            clean_id, seq, ctx, admit = self._admit_seq(item.agent_id)
+            if ctx is not None and not tracer.enabled:
+                ctx = None  # see _ingest_one: never flow undrained ctxs
+            if ctx is not None:
+                # The native C++ core already decoded this payload; the
+                # ingest/dedup hops collapse to the drain's arrival.
+                tracer.span("traj", ctx.trace_id, "ingest", t_arr, t_arr,
+                            agent=clean_id, seq=seq)
+                tracer.span("traj", ctx.trace_id, "dedup", t_arr,
+                            time.monotonic_ns(), admitted=bool(admit))
+                if admit:
+                    item.trace_ctx = ctx
             if not admit:
                 continue
             if clean_id != item.agent_id:
@@ -971,13 +1044,14 @@ class TrainingServer:
         guard = self.guardrails
         while not self._stop.is_set():
             try:
-                agent_id, seq, payload = self._ingest.get(timeout=0.1)
+                agent_id, seq, ctx, payload = self._ingest.get(timeout=0.1)
             except queue.Empty:
                 continue
             if guard is not None and guard.admission is not None:
                 guard.admission.note_dequeued(agent_id)
             item = None
             columnar = False
+            t0_ns = time.monotonic_ns() if ctx is not None else 0
             t0 = time.monotonic()
             try:
                 if is_columnar_frame(payload):
@@ -1045,6 +1119,13 @@ class TrainingServer:
             self._m_decode.observe(dt)  # per-thread shard: no lock needed
             with self._timings_lock:  # N decode workers share the ledger
                 self.timings["decode_s"] += dt
+            if ctx is not None and item is not None:
+                # staging hop (decode + validate) + context handoff: the
+                # learner attributes the consuming update at dispatch.
+                self._get_tracer().span(
+                    "traj", ctx.trace_id, "staging", t0_ns,
+                    time.monotonic_ns(), agent=agent_id)
+                item = _attach_trace_ctx(item, ctx)
             if item is not None:
                 try:
                     self._decoded.put_nowait(item)
@@ -1266,21 +1347,34 @@ class TrainingServer:
         self._pipeline_quiesce()
         self._guard_poll()
 
-    def _observe_behavior_lag(self, item, algo) -> None:
+    def _observe_behavior_lag(self, item, algo, ctx=None) -> None:
         """RLHF-plane off-policy evidence: trajectories whose records
         carry ``bver`` (the params version the generation sampled
         under — rlhf/scheduler.py stamps it per token) observe
         ``dispatched_version - bver`` into the train-lag histogram, one
-        sample per trajectory. Non-RLHF traffic pays one dict lookup."""
+        sample per trajectory. A sampled trace context's born_version
+        (stamped at emission, telemetry/trace.py) is the same kind of
+        behavior-version evidence, so bver-less traced trajectories
+        feed the histogram too — the analyzer's version-lag
+        distribution and this histogram then describe the same data.
+        Non-RLHF untraced traffic pays one dict lookup."""
         try:
             if isinstance(item, DecodedTrajectory):
                 arr = (item.aux or {}).get("bver")
                 if arr is None or len(arr) == 0:
+                    if ctx is not None and ctx.born_version >= 0:
+                        self._m_rlhf_train_lag.observe(
+                            max(0, algo.dispatched_version
+                                - ctx.born_version))
                     return
                 bver = int(arr.reshape(-1)[0])
             else:
                 data = item[0].data if item else None
                 if not data or "bver" not in data:
+                    if ctx is not None and ctx.born_version >= 0:
+                        self._m_rlhf_train_lag.observe(
+                            max(0, algo.dispatched_version
+                                - ctx.born_version))
                     return
                 bver = int(data["bver"])
             self._m_rlhf_train_lag.observe(
@@ -1289,6 +1383,34 @@ class TrainingServer:
             # Lag evidence is diagnostics; malformed aux must never
             # touch the ingest path's health.
             pass
+
+    def _trace_dispatch(self, tracer, algo, t0_ns: int,
+                        consume_ver: int) -> None:
+        """Close out the tracing bookkeeping of one update dispatch
+        (learner thread): the downstream ``dispatch`` hop for sampled
+        versions, and for every sampled trajectory context consumed
+        since the previous dispatch, the upstream ``update`` hop plus
+        the end-to-end data-age / version-lag observations (same-host
+        skew-guarded — a cross-host born stamp is dropped, not
+        observed)."""
+        from relayrl_tpu.telemetry.trace import SKEW_GUARD_NS, model_trace_id
+
+        t1_ns = time.monotonic_ns()
+        ver = algo.dispatched_version
+        if tracer.sample_version(ver):
+            tracer.span("model", model_trace_id(ver), "dispatch",
+                        t0_ns, t1_ns, version=int(ver))
+        while self._trace_pending:
+            ctx = self._trace_pending.popleft()
+            # version = the version the batch trained FROM (matching the
+            # train_version_lag convention), not the freshly-minted one.
+            tracer.span("traj", ctx.trace_id, "update", t0_ns, t1_ns,
+                        version=int(consume_ver))
+            age_ns = t1_ns - ctx.born_ns
+            if 0 <= age_ns < SKEW_GUARD_NS:
+                lag = (int(consume_ver) - ctx.born_version
+                       if ctx.born_version >= 0 else None)
+                tracer.observe_data_age(age_ns / 1e9, lag)
 
     def _sync_drop_stats(self) -> None:
         """Mirror the algorithm's finite-guard counter into stats — the
@@ -1314,7 +1436,16 @@ class TrainingServer:
             return
         self.stats["trajectories"] += 1
         self._m_trajectories.inc()
-        self._observe_behavior_lag(item, algo)
+        ctx = getattr(item, "trace_ctx", None)
+        if ctx is not None:
+            self._trace_pending.append(ctx)
+        self._observe_behavior_lag(item, algo, ctx)
+        tracer = self._get_tracer()
+        t0_ns = time.monotonic_ns() if tracer.enabled else 0
+        # The version this batch trains FROM (pre-dispatch) — the
+        # convention _observe_behavior_lag's histogram uses, so the
+        # trace-side version-lag distribution matches it exactly.
+        consume_ver = algo.dispatched_version if tracer.enabled else 0
         t0 = time.monotonic()
         try:
             got = algo.accumulate(item)
@@ -1355,6 +1486,8 @@ class TrainingServer:
         dispatch_dt = time.monotonic() - t0
         self.timings["dispatch_s"] += dispatch_dt
         self._m_dispatch.observe(dispatch_dt)
+        if tracer.enabled and updated:
+            self._trace_dispatch(tracer, algo, t0_ns, consume_ver)
         if updated:
             self.stats["updates"] += 1
             self._m_updates.inc()
@@ -1735,17 +1868,29 @@ class TrainingServer:
         enc = self._wire_encoder
         with self._bundle_lock:
             self._bundle_host = (int(version), dict(arch), host_params)
+        tracer = self._get_tracer()
+        traced = tracer.enabled and tracer.sample_version(version)
         try:
             if enc is not None:
+                t_enc0 = time.monotonic_ns() if traced else 0
                 frame, info = enc.encode(version, arch, host_params)
+                if traced:
+                    from relayrl_tpu.telemetry.trace import model_trace_id
+
+                    t_enc1 = time.monotonic_ns()
+                    tracer.span("model", model_trace_id(version), "encode",
+                                t_enc0, t_enc1, version=int(version),
+                                frame_kind=info["kind"],
+                                bytes=info["frame_bytes"])
                 if getattr(self.transport, "needs_handshake_bytes", False):
                     # The native core answers handshakes from pushed
                     # bytes; a v2 publish rides with the v1 bundle for
                     # set_model.
-                    self._faulted_publish(
-                        version, frame, handshake_bytes=self._get_model()[1])
+                    self._traced_wire_publish(
+                        traced, version, frame,
+                        handshake_bytes=self._get_model()[1])
                 else:
-                    self._faulted_publish(version, frame)
+                    self._traced_wire_publish(traced, version, frame)
                 telemetry.emit("model_publish", version=version,
                                bytes=info["frame_bytes"], kind=info["kind"],
                                raw_bytes=info["raw_bytes"])
@@ -1757,7 +1902,7 @@ class TrainingServer:
                 with self._bundle_lock:
                     self._bundle_bytes = raw
                     self._bundle_version = int(version)
-                self._faulted_publish(version, raw)
+                self._traced_wire_publish(traced, version, raw)
                 telemetry.emit("model_publish", version=version,
                                bytes=len(raw))
         finally:
@@ -1776,6 +1921,24 @@ class TrainingServer:
                 except Exception as e:
                     print(f"[TrainingServer] serving install error: "
                           f"{e!r}", flush=True)
+
+    def _traced_wire_publish(self, traced: bool, version: int,
+                             frame: bytes, **kwargs) -> None:
+        """The ``publish`` hop span (socket broadcast wall time on the
+        publisher thread) around the fault-site-wrapped broadcast."""
+        if not traced:
+            self._faulted_publish(version, frame, **kwargs)
+            return
+        from relayrl_tpu.telemetry.trace import model_trace_id
+
+        tracer = self._get_tracer()
+        t0 = time.monotonic_ns()
+        try:
+            self._faulted_publish(version, frame, **kwargs)
+        finally:
+            tracer.span("model", model_trace_id(version), "publish",
+                        t0, time.monotonic_ns(), version=int(version),
+                        backend=self.server_type)
 
     def _faulted_publish(self, version: int, frame: bytes,
                          **kwargs) -> None:

@@ -131,18 +131,32 @@ class TrajectorySpool:
         with self._lock:
             return len(self._entries)
 
-    def send(self, payload: bytes, agent_id: str) -> int:
+    def send(self, payload: bytes, agent_id: str,
+             trace: str | None = None) -> int:
         """Assign the next seq for ``agent_id``, retain, and attempt
         delivery (unless the breaker is open). Returns the seq. Never
         raises on wire failure — the entry is already retained and the
-        breaker/replay machinery owns recovery."""
+        breaker/replay machinery owns recovery.
+
+        ``trace`` (telemetry/trace.py, a sampled trajectory's encoded
+        context) rides the wire id as a ``#t`` tag BETWEEN the agent id
+        and the ``#s`` seq tag — the seq SPACE stays keyed by the clean
+        agent id (a per-trajectory tag in the key would reset every
+        trajectory to seq 1 and dedup the fleet into silence), while
+        the retained entry keeps the tagged id so a replay re-ships the
+        context verbatim."""
+        wire_id = agent_id
+        if trace is not None:
+            from relayrl_tpu.transport.base import tag_agent_trace
+
+            wire_id = tag_agent_trace(agent_id, trace)
         with self._lock:
             seq = self._next_seq.get(agent_id, 0) + 1
             self._next_seq[agent_id] = seq
-            self._retain_locked(agent_id, seq, payload)
+            self._retain_locked(wire_id, seq, payload)
         self._m_spooled.inc()
         self._m_depth.set(len(self._entries))
-        self._attempt(agent_id, seq, payload)
+        self._attempt(wire_id, seq, payload)
         return seq
 
     def send_verbatim(self, payload: bytes, wire_id: str) -> None:
@@ -413,8 +427,15 @@ class TrajectorySpool:
                or self._bytes > self.max_bytes):
             _, _, old = self._entries.pop(0)
             self._bytes -= len(old)
-        if seq and seq > self._next_seq.get(agent_id, 0):
-            self._next_seq[agent_id] = seq
+        if seq:
+            # Stored wire ids may carry a per-trajectory trace tag; the
+            # seq space is keyed by the CLEAN id (see send), so restore
+            # the counter under the same key.
+            from relayrl_tpu.transport.base import split_agent_trace
+
+            clean_id, _ = split_agent_trace(agent_id)
+            if seq > self._next_seq.get(clean_id, 0):
+                self._next_seq[clean_id] = seq
 
 
 class SequenceLedger:

@@ -119,11 +119,20 @@ def configure_from_config(config) -> object:
         events_path = params.get("events_path")
         if events_path:
             try:
-                _journal = EventJournal(str(events_path),
-                                        run_id=_registry.run_id)
+                _journal = EventJournal(
+                    str(events_path), run_id=_registry.run_id,
+                    max_bytes=params.get("events_max_bytes") or 0)
             except OSError as e:
                 print(f"[telemetry] event journal unavailable "
                       f"({events_path}): {e!r}", flush=True)
+        # Distributed tracing (telemetry/trace.py): sample_rate 0 (the
+        # default) leaves the shared null tracer installed — every span
+        # site then costs one attribute check.
+        rate = params.get("trace_sample_rate") or 0.0
+        if rate > 0:
+            from relayrl_tpu.telemetry import trace as _trace
+
+            _trace.configure(rate, ring=params.get("trace_ring", 4096))
         return _registry
 
 
@@ -181,6 +190,9 @@ def reset_for_tests() -> None:
         _journal = NullJournal()
         _configured = False
         _serve_port = None
+    from relayrl_tpu.telemetry import trace as _trace
+
+    _trace.reset_for_tests()
 
 
 __all__ = [

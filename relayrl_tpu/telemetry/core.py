@@ -41,6 +41,41 @@ DEFAULT_TIME_BUCKETS = (
 )
 
 
+def log_buckets(lo: float, hi: float, per_decade: int = 3) -> tuple[float, ...]:
+    """Log-spaced histogram bounds from ``lo`` to at least ``hi``
+    (seconds), ``per_decade`` buckets per power of ten, rounded to two
+    significant digits so the grid is stable across platforms. The
+    preset builder for sites whose dynamic range outgrows the fixed
+    default grid at relay/pod scale (ISSUE 14 bucket audit)."""
+    if lo <= 0 or hi <= lo:
+        raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+    ratio = 10.0 ** (1.0 / max(1, int(per_decade)))
+    out: list[float] = []
+    v = float(lo)
+    while True:
+        r = float(f"{v:.2g}")
+        if not out or r > out[-1]:
+            out.append(r)
+        if r >= hi:
+            break
+        v *= ratio
+    return tuple(out)
+
+
+# Wide per-op latency grid: 100 µs .. 60 s. The audit preset for sites
+# that saturate the default grid under fleet fan-out — model delivery on
+# a backed-up SUB thread, sends through an open-breaker stall, serving
+# requests queued behind an overload — where the old 10 s top bucket
+# pinned every tail sample in +Inf.
+LATENCY_BUCKETS_WIDE = log_buckets(1e-4, 60.0, per_decade=3)
+
+# End-to-end age grid (distributed tracing): 1 ms .. 600 s. Data age
+# (env-step → consumed-by-update) and model age (publish → applied)
+# legitimately reach minutes under pacing/backpressure; the top finite
+# bucket matches the cross-host skew guard's 300 s bound with headroom.
+AGE_BUCKETS = log_buckets(1e-3, 600.0, per_decade=3)
+
+
 def _canon_labels(labels: Mapping[str, str] | None) -> tuple[tuple[str, str], ...]:
     if not labels:
         return ()
@@ -396,5 +431,6 @@ class NullRegistry:
 
 __all__ = [
     "Counter", "Gauge", "GaugeFn", "Histogram", "Registry", "NullRegistry",
-    "NULL_METRIC", "DEFAULT_TIME_BUCKETS",
+    "NULL_METRIC", "DEFAULT_TIME_BUCKETS", "LATENCY_BUCKETS_WIDE",
+    "AGE_BUCKETS", "log_buckets",
 ]

@@ -50,19 +50,42 @@ EVENT_TYPES = (
     "spool_replay",      # actor re-shipped its retained trajectory window
     "duplicate_drop",    # idempotent ingest dropped replayed sequences
                          # (coalesced: carries n)
+    # -- distributed tracing (ISSUE 14, telemetry/trace.py) --
+    "trace_span",        # one sampled trace span (kind/trace/hop/proc/
+                         # t0_ns/t1_ns + hop fields) — the NDJSON export
+                         # of the flight recorder; volume is bounded by
+                         # telemetry.trace_sample_rate + journal rotation
 )
 
 
 class EventJournal:
-    """Thread-safe NDJSON appender bound to one run."""
+    """Thread-safe NDJSON appender bound to one run.
 
-    def __init__(self, path: str, run_id: str | None = None):
+    ``max_bytes`` (``telemetry.events_max_bytes``) size-bounds the
+    journal with a single-generation rotation: when an append would
+    cross the bound, the current file moves to ``<path>.1`` (replacing
+    any prior generation) and a fresh file opens — so a multi-hour soak
+    (or the trace-span NDJSON export) holds at most ~2x ``max_bytes``
+    on disk and :func:`read_events` still sees the most recent window,
+    torn-tail-tolerant across the rotation boundary. 0/None disables.
+    """
+
+    def __init__(self, path: str, run_id: str | None = None,
+                 max_bytes: int | None = None):
         self.path = str(path)
         self.run_id = run_id
+        self.max_bytes = int(max_bytes) if max_bytes else 0
         self._lock = threading.Lock()
+        self._closed = False
         self._fh: TextIO | None = open(self.path, "a", encoding="utf-8")
+        try:
+            self._size = self._fh.tell()
+        except OSError:
+            self._size = 0
         self.written = 0
+        self.rotations = 0
         self.errors = 0
+        self._rotate_backoff_size = 0
 
     def emit(self, event: str, **fields: Any) -> None:
         record = {"event": str(event), "run_id": self.run_id,
@@ -73,18 +96,72 @@ class EventJournal:
         line = json.dumps(record, separators=(",", ":")) + "\n"
         with self._lock:
             if self._fh is None:
-                return
+                if self._closed:
+                    return
+                # A failed rotation/reopen left the journal down: retry
+                # the reopen per emit (counted, never silent) so a
+                # transient disk condition heals instead of muting the
+                # journal for the rest of the run.
+                try:
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                    self._size = self._fh.tell()
+                except OSError:
+                    self.errors += 1
+                    return
             try:
+                if (self.max_bytes and self._size
+                        and self._size + len(line) > self.max_bytes
+                        and self._size >= self._rotate_backoff_size):
+                    try:
+                        self._rotate_locked()
+                    except OSError:
+                        # Rotation failed (rename target unwritable,
+                        # read-only dir): count it, keep APPENDING to
+                        # the reopened original — the bounding mechanism
+                        # must never mute the journal it bounds — and
+                        # back off a full bound before retrying so a
+                        # permanently-broken rename isn't re-attempted
+                        # per line.
+                        self.errors += 1
+                        self._rotate_backoff_size = (self._size
+                                                     + self.max_bytes)
+                if self._fh is None:
+                    raise OSError("journal file unavailable")
                 self._fh.write(line)
                 self._fh.flush()
+                self._size += len(line)
                 self.written += 1
             except (OSError, ValueError):
                 # A full disk / closed fd must never take down the plane
                 # being observed.
                 self.errors += 1
 
+    def _rotate_locked(self) -> None:
+        """Move the full journal to ``<path>.1`` and start fresh. Lock
+        held; an OSError propagates to emit's guard (one counted error),
+        but the journal must come back up either way — a failed rename
+        (read-only dir, ``.1`` unwritable) reopens the ORIGINAL file in
+        append mode so later events still land, growing past the bound
+        rather than vanishing silently (the plane being observed must
+        never lose its journal to its own bounding mechanism)."""
+        import os
+
+        self._fh.close()
+        self._fh = None
+        try:
+            os.replace(self.path, f"{self.path}.1")
+        finally:
+            self._fh = open(self.path, "a", encoding="utf-8")
+            try:
+                self._size = self._fh.tell()
+            except OSError:
+                self._size = 0
+        self.rotations += 1
+        self._rotate_backoff_size = 0
+
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._fh is not None:
                 try:
                     self._fh.close()
@@ -126,19 +203,33 @@ def _jsonable(value: Any) -> Any:
         return repr(value)
 
 
-def read_events(path: str) -> list[dict]:
+def read_events(path: str, include_rotated: bool = True) -> list[dict]:
     """Parse a journal file, tolerating a torn final line (crash mid-
-    write)."""
+    write). When a rotated generation (``<path>.1``) exists it is read
+    FIRST so the result stays chronological across the rotation
+    boundary; each file is torn-tail-tolerant independently (a crash
+    can tear the live file while the rotated one is already sealed)."""
+    import os
+
+    paths = []
+    if include_rotated and os.path.exists(f"{path}.1"):
+        paths.append(f"{path}.1")
+    paths.append(path)
     out: list[dict] = []
-    with open(path, "r", encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue  # torn tail
+    for p in paths:
+        try:
+            fh = open(p, "r", encoding="utf-8")
+        except FileNotFoundError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail
     return out
 
 

@@ -112,6 +112,16 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(self.registry.snapshot(),
                               allow_nan=False).encode()
             self._reply(200, "application/json", body)
+        elif path == "/traces":
+            # The distributed-tracing flight recorder (telemetry/
+            # trace.py): the process tracer's live span ring. Served
+            # even when tracing is disabled (an empty, enabled=false
+            # document) so fleet pollers need no probe-then-fetch dance.
+            from relayrl_tpu.telemetry import trace as _trace
+
+            body = json.dumps(_trace.traces_document(),
+                              allow_nan=False).encode()
+            self._reply(200, "application/json", body)
         elif path == "/healthz":
             self._reply(200, "text/plain", b"ok\n")
         else:

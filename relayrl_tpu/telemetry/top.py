@@ -32,6 +32,8 @@ _SECTIONS = (
     ("transport", "relayrl_transport_"),
     ("relay", "relayrl_relay_"),
     ("rlhf", "relayrl_rlhf_"),
+    ("trace", "relayrl_trace_"),
+    ("serving", "relayrl_serving_"),
     ("actor", "relayrl_actor_"),
     ("epoch", "relayrl_epoch_"),
 )

@@ -156,6 +156,40 @@ def split_agent_seq(agent_id: str) -> tuple[str, int | None]:
     return agent_id, None
 
 
+# -- trace-context tags (distributed tracing, telemetry/trace.py) --
+#
+# A sampled trajectory's trace context rides the SAME envelope-id channel
+# as the seq tag, immediately before it: ``<agent>#t<ctx>#s<seq>``. The
+# ctx payload is three dot-separated lowercase-hex fields (trace id,
+# born_ns, born_version — telemetry.trace.TrajCtx), validated strictly
+# on split so an agent id that happens to contain ``#t`` cannot be
+# misparsed. Coalescing with the id (instead of a new envelope key)
+# is what makes the context survive the native C++ columnar raw-fallback
+# path verbatim — codec.cc drops unknown envelope KEYS but carries the
+# id untouched, the seq-tag lesson from PR 6 (locked by an explicit
+# passthrough test in tests/test_trace.py).
+_TRACE_TAG = "#t"
+_CTX_HEX = set("0123456789abcdef-")
+
+
+def tag_agent_trace(agent_id: str, ctx_text: str) -> str:
+    return f"{agent_id}{_TRACE_TAG}{ctx_text}"
+
+
+def split_agent_trace(agent_id: str) -> tuple[str, str | None]:
+    """``"a#tdead.beef.2" -> ("a", "dead.beef.2")``; ids without a
+    valid trace tag -> ``(agent_id, None)``. Call AFTER
+    :func:`split_agent_seq` (the seq tag is outermost on the wire)."""
+    base, sep, tail = agent_id.rpartition(_TRACE_TAG)
+    if not sep:
+        return agent_id, None
+    parts = tail.split(".")
+    if len(parts) != 3 or not all(
+            p and all(c in _CTX_HEX for c in p) for p in parts):
+        return agent_id, None
+    return base, tail
+
+
 def pack_model_frame(version: int, bundle_bytes: bytes,
                      pub_ns: int | None = None) -> bytes:
     """``pub_ns`` is the publisher's CLOCK_MONOTONIC stamp (same-host
@@ -353,6 +387,12 @@ def server_wire_metrics(backend: str,
     return metrics
 
 
+def _wide_buckets():
+    from relayrl_tpu.telemetry.core import LATENCY_BUCKETS_WIDE
+
+    return LATENCY_BUCKETS_WIDE
+
+
 def agent_wire_metrics(backend: str) -> dict:
     """The shared agent-side transport instrument set, one registry
     lookup per connection (all metrics are process-aggregated across
@@ -381,9 +421,16 @@ def agent_wire_metrics(backend: str) -> dict:
         "send_bytes": reg.counter(
             "relayrl_transport_send_bytes_total",
             "trajectory wire bytes sent (envelope included)", labels),
+        # Wide log-spaced grids (telemetry.core.LATENCY_BUCKETS_WIDE)
+        # for the two per-op latencies that saturate the default 10 s
+        # grid at relay/pod scale: a send riding out an open-breaker
+        # stall and a model delivery behind a backed-up SUB thread both
+        # legitimately reach tens of seconds, and a grid that pins them
+        # in +Inf cannot localize the tail (ISSUE 14 bucket audit).
         "send_seconds": reg.histogram(
             "relayrl_transport_send_seconds",
-            "one trajectory send on the caller thread", labels),
+            "one trajectory send on the caller thread", labels,
+            buckets=_wide_buckets()),
         "model_recv_total": reg.counter(
             "relayrl_transport_model_recv_total",
             "model frames received on the subscription", labels),
@@ -393,7 +440,7 @@ def agent_wire_metrics(backend: str) -> dict:
         "model_deliver_seconds": reg.histogram(
             "relayrl_transport_model_deliver_seconds",
             "receipt stamp to on_model return (decode+swap+persist)",
-            labels),
+            labels, buckets=_wide_buckets()),
         "receipt_latency_seconds": reg.histogram(
             "relayrl_transport_receipt_latency_seconds",
             "publish stamp to receipt stamp, same-host monotonic pairs",

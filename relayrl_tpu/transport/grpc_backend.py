@@ -512,6 +512,14 @@ class GrpcAgentTransport(AgentTransport):
                     self.on_model(version, bundle)
                 self._m["model_deliver_seconds"].observe(
                     (time.monotonic_ns() - rx_ns) / 1e9)
+                # Downstream trace receipt hop (no publisher stamp on
+                # the pull plane — model age stays a broadcast-side
+                # observation).
+                from relayrl_tpu.telemetry.trace import (
+                    record_model_receipt,
+                )
+
+                record_model_receipt(version, rx_ns, None, "grpc")
 
     def drain_receipts(self, max_n: int = 65536) -> list[tuple[int, int]]:
         """Drain the pre-decode receipt ledger (same surface as the

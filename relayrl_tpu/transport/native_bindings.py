@@ -637,6 +637,11 @@ class NativeAgentTransportImpl(AgentTransport):
                 self.on_model(int(version.value), blob)
             self._m["model_deliver_seconds"].observe(
                 max(0.0, (time.monotonic_ns() - int(rx_ns.value)) / 1e9))
+            # Downstream trace receipt hop off the C++ ledger's stamp.
+            from relayrl_tpu.telemetry.trace import record_model_receipt
+
+            record_model_receipt(int(version.value), int(rx_ns.value),
+                                 None, "native")
 
     def close(self) -> None:
         self._stop.set()

@@ -499,6 +499,12 @@ class ZmqAgentTransport(AgentTransport):
         self.on_model(version, bundle)
         self._m["model_deliver_seconds"].observe(
             (time.monotonic_ns() - rx_ns) / 1e9)
+        # Downstream trace: the receipt hop (receipt stamp → swap
+        # applied) + the actor-side model-age observation off the
+        # publisher's monotonic stamp (same skew guard as above).
+        from relayrl_tpu.telemetry.trace import record_model_receipt
+
+        record_model_receipt(version, rx_ns, pub_ns, "zmq")
 
     def _drain_monitor(self) -> None:
         """Process queued PUSH-socket monitor events (model-listener

@@ -43,6 +43,14 @@ class Trajectory:
         self.max_length = int(max_length)
         self._on_send = on_send
         self._actions: list[ActionRecord] = []
+        # Tracing stamps (telemetry/trace.py): born_ns marks the first
+        # step of the chunk currently buffering, encode_t0/t1_ns bracket
+        # the last flush's serialize. Read by the owning agent's send
+        # hook when it mints a trajectory trace context; one clock read
+        # per chunk/flush, never per step beyond the emptiness check.
+        self.born_ns = 0
+        self.encode_t0_ns = 0
+        self.encode_t1_ns = 0
 
     # -- reference API parity (trajectory.rs:95-203) --
     @property
@@ -74,6 +82,10 @@ class Trajectory:
         is_marker = action.act is None
         if not is_marker and len(self._actions) >= self.max_length:
             self._flush_or_evict_at_capacity(send_if_done)
+        if not self._actions:
+            import time
+
+            self.born_ns = time.monotonic_ns()
         self._actions.append(action)
         if action.done and send_if_done and self._on_send is not None:
             self.flush()
@@ -101,6 +113,10 @@ class Trajectory:
         anakin fallback unstacker's path (runtime/anakin.py). Returns
         the number of transport flushes performed."""
         acts = self._actions
+        if not acts and records:
+            import time
+
+            self.born_ns = time.monotonic_ns()
         flushes = 0
         i, n = 0, len(records)
         while i < n:
@@ -134,7 +150,12 @@ class Trajectory:
         """
         if not self._actions or self._on_send is None:
             return
-        self._on_send(self.to_bytes())
+        import time
+
+        self.encode_t0_ns = time.monotonic_ns()
+        buf = self.to_bytes()
+        self.encode_t1_ns = time.monotonic_ns()
+        self._on_send(buf)
         self._actions.clear()
 
     def clear(self) -> None:

@@ -107,6 +107,16 @@ def test_bench_soak_quick_slos(tmp_path):
     traj = next(m for m in soak["telemetry"]["metrics"]
                 if m["name"] == "relayrl_server_trajectories_total")
     assert traj["value"] == soak["server_stats"]["trajectories"]
+    # Distributed-tracing block (ISSUE 14): every soak row embeds the
+    # pooled data-age / model-age attribution; the soak runs at sample
+    # rate 1.0, so data age must carry real samples, and the schema is
+    # stable even for empty distributions.
+    ages = soak["age_attribution"]
+    for key in ("data_age_s", "model_age_s", "data_age_versions"):
+        assert "count" in ages[key], ages
+    assert ages["trace_sampled"] > 0
+    assert ages["data_age_s"]["count"] > 0
+    assert {"mean", "p50", "p95"} <= set(ages["data_age_s"])
 
 
 def test_bench_soak_chaos_quick_smoke(tmp_path):
