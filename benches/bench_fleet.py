@@ -1,0 +1,405 @@
+"""Fleet telemetry aggregation drill (ISSUE 15 acceptance artifact).
+
+Topology per row: ONE root TrainingServer (zmq, telemetry + fleet plane
+on) ← R relay processes (``python -m relayrl_tpu.relay``) ← W vector
+worker processes per relay × L logical lanes each. Every worker's
+registry ships snapshot frames through its relay; relays fan the
+subtree in as ONE multi-proc frame per interval; the root's fleet table
+merges the lot behind ``/fleet``.
+
+Asserted per row (and committed to ``benches/results/fleet_zmq.json``
+with ``--write``):
+
+* the root ``/fleet`` endpoint (fetched over live HTTP) lists EVERY
+  process with its correct tier label (server / relay / actor);
+* merged ``relayrl_actor_*`` counter totals equal the sum over the
+  per-process registries BIT-exactly (each worker commits the snapshot
+  it froze when its env loop stopped; the final frame shipped at
+  disable carries the same frozen counters);
+* root ingest is O(relays): the fleet-frames arrival rate at the root
+  stays flat as the logical-actor count doubles at fixed relay count;
+* the SLO alert engine works end to end: an induced ingest drop fires
+  ``ingest_drops`` (journal ``alert_fired`` +
+  ``relayrl_alert_active{rule}`` = 1) and resolves on the next clean
+  interval (``alert_resolved``, gauge back to 0).
+
+Run: ``python benches/bench_fleet.py [--quick] [--write]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from common import emit, free_port, quick, setup_platform  # noqa: E402
+
+setup_platform()
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_INTERVAL_S = 0.5
+
+
+def _write_config(scratch: str) -> str:
+    from relayrl_tpu.config import default_config
+
+    cfg = default_config()
+    cfg["learner"]["checkpoint_dir"] = ""
+    cfg["learner"]["checkpoint_every_epochs"] = 1_000_000
+    cfg["telemetry"].update({
+        "enabled": True,
+        "port": 0,  # root binds ephemeral; workers never serve
+        "events_path": os.path.join(scratch, "events.ndjson"),
+        "fleet_interval_s": FLEET_INTERVAL_S,
+        # Nothing may evict mid-drill: the exactness check needs every
+        # proc's final frame still tabled at fetch time.
+        "fleet_stale_s": 120.0,
+    })
+    path = os.path.join(scratch, "relayrl_config.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return path
+
+
+def _spawn(cmd: list[str]) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _wait_file(path: str, proc: subprocess.Popen, what: str,
+               timeout_s: float = 180.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(f"{what} died at bring-up "
+                               f"(rc={proc.returncode}):\n{out[-3000:]}")
+        if time.monotonic() >= deadline:
+            raise RuntimeError(f"{what} never became ready")
+        time.sleep(0.05)
+
+
+def _fetch_json(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _actor_counter_check(merged: dict, worker_results: list[dict]) -> dict:
+    """Bit-exact comparison: for every ``relayrl_actor_*`` counter
+    family, the fleet-merged value must EQUAL the float sum of the
+    per-worker snapshot values in sorted-proc order (the same order the
+    table merges in — identical addition order, identical bits)."""
+    families: dict[tuple, float] = {}
+    for row in sorted(worker_results, key=lambda r: r["identity"]):
+        for m in row["snapshot"].get("metrics", []):
+            if m.get("kind") != "counter" \
+                    or not m["name"].startswith("relayrl_actor_"):
+                continue
+            key = (m["name"],
+                   tuple(sorted((m.get("labels") or {}).items())))
+            families[key] = families.get(key, 0.0) + (m.get("value") or 0.0)
+    by_key = {(m["name"], tuple(sorted((m.get("labels") or {}).items()))):
+              m.get("value")
+              for m in merged.get("metrics", [])
+              if m.get("kind") == "counter"}
+    mismatches = []
+    for key, expect in sorted(families.items()):
+        got = by_key.get(key)
+        if got != expect:
+            mismatches.append({"family": key[0], "labels": dict(key[1]),
+                               "expected": expect, "merged": got})
+    return {"families_checked": len(families),
+            "exact": not mismatches and bool(families),
+            "mismatches": mismatches}
+
+
+def _run_alert_drill(server) -> dict:
+    """Induce root-side ingest drops on the QUIESCENT fleet (workers
+    already stopped — a loaded 2-core window can drop organically, and
+    an alert that fired mid-window would mask the induced transition):
+    wait until ingest_drops is inactive, inject one undecodable payload
+    through the live funnel, and require alert_fired then
+    alert_resolved journal events plus the active gauge at 1 between
+    them."""
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.telemetry.events import read_events
+
+    events_path = telemetry.get_journal().path
+    assert events_path, "alert drill needs telemetry.events_path"
+
+    def _rule_state():
+        for a in server._alerts.describe():
+            if a["name"] == "ingest_drops":
+                return a
+        raise AssertionError("ingest_drops rule not armed")
+
+    deadline = time.monotonic() + 40 * FLEET_INTERVAL_S
+    while _rule_state()["active"] and time.monotonic() < deadline:
+        time.sleep(FLEET_INTERVAL_S / 2)
+    assert not _rule_state()["active"], \
+        "ingest_drops never settled on the quiescent fleet"
+    # One more settle tick so the engine's last_raw baseline includes
+    # any stragglers.
+    time.sleep(2 * FLEET_INTERVAL_S)
+
+    drops0 = server._m_dropped.total()
+    inject_mono = time.monotonic_ns()
+    server._on_trajectory("bench-poison",
+                          b"this is not a decodable payload")
+    fired = resolved = None
+    gauge_seen = False
+    deadline = time.monotonic() + 60 * FLEET_INTERVAL_S
+    while time.monotonic() < deadline and resolved is None:
+        if _rule_state()["active"]:
+            gauge_seen = True
+        for ev in read_events(events_path):
+            # Only transitions from THIS injection (the loaded window
+            # or earlier rows may have journaled their own).
+            if ev.get("rule") != "ingest_drops" \
+                    or (ev.get("mono_ns") or 0) < inject_mono:
+                continue
+            if ev.get("event") == "alert_fired" and fired is None:
+                fired = ev
+                gauge_seen = gauge_seen or _rule_state()["active"]
+            elif ev.get("event") == "alert_resolved" \
+                    and fired is not None:
+                resolved = ev
+        time.sleep(FLEET_INTERVAL_S / 4)
+    assert fired is not None, "induced drop never fired the alert"
+    assert resolved is not None, "alert never resolved"
+    assert gauge_seen, "alert gauge never observed active"
+    return {
+        "dropped_delta": server._m_dropped.total() - drops0,
+        "fired": fired, "resolved": resolved,
+        "active_gauge_seen": True,
+    }
+
+
+def run_row(scratch: str, cfg_path: str, relays: int, workers_per_relay: int,
+            lanes: int, window_s: float, obs_dim: int = 4,
+            alert_drill: bool = False) -> dict:
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    row_tag = f"r{relays}w{workers_per_relay}l{lanes}"
+    root_addrs = {
+        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+    }
+    server = TrainingServer(
+        "REINFORCE", obs_dim=obs_dim, act_dim=2, server_type="zmq",
+        env_dir=scratch, config_path=cfg_path, **root_addrs)
+    assert server._fleet is not None, "fleet plane did not come up"
+    exporter = server._exporter
+    assert exporter is not None, "root exporter did not bind"
+
+    relay_procs = []
+    relay_stop = os.path.join(scratch, f"{row_tag}_relay_stop")
+    worker_stop = os.path.join(scratch, f"{row_tag}_worker_stop")
+    worker_procs = []
+    result_paths = []
+    try:
+        fanouts = []
+        for r in range(relays):
+            fanout = {
+                "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+                "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+                "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+            }
+            fanouts.append(fanout)
+            kwargs = {
+                "name": f"{row_tag}-relay{r}",
+                "config_path": cfg_path,
+                "upstream_type": "zmq",
+                "upstream": {
+                    "agent_listener_addr": root_addrs["agent_listener_addr"],
+                    "trajectory_addr": root_addrs["trajectory_addr"],
+                    "model_sub_addr": root_addrs["model_pub_addr"],
+                    "probe": False,
+                },
+                "downstream": dict(fanout),
+            }
+            ready = os.path.join(scratch, f"{row_tag}_relay{r}_ready")
+            proc = _spawn([sys.executable, "-m", "relayrl_tpu.relay",
+                           "--json", json.dumps(kwargs),
+                           "--ready-file", ready,
+                           "--stop-file", relay_stop])
+            _wait_file(ready, proc, f"relay {r}")
+            relay_procs.append(proc)
+
+        for r in range(relays):
+            for w in range(workers_per_relay):
+                ident = f"fleetw-{row_tag}-{r}-{w}"
+                result_path = os.path.join(scratch, f"{ident}_result.json")
+                result_paths.append(result_path)
+                cfg = {
+                    "identity": ident,
+                    "agents_per_proc": lanes,
+                    "scratch": scratch,
+                    "config_path": cfg_path,
+                    "seed": r * 100 + w,
+                    "obs_dim": obs_dim,
+                    "episode_len": 5,
+                    "duration_s": window_s + 300,
+                    "stop_file": worker_stop,
+                    "result_path": result_path,
+                    "agent_listener_addr":
+                        fanouts[r]["agent_listener_addr"],
+                    "trajectory_addr": fanouts[r]["trajectory_addr"],
+                    "model_sub_addr": fanouts[r]["model_pub_addr"],
+                }
+                worker_procs.append(_spawn(
+                    [sys.executable,
+                     os.path.join(ROOT, "benches", "_fleet_worker.py"),
+                     json.dumps(cfg)]))
+        for r in range(relays):
+            for w in range(workers_per_relay):
+                ident = f"fleetw-{row_tag}-{r}-{w}"
+                _wait_file(os.path.join(scratch, f"ready_{ident}"),
+                           worker_procs[r * workers_per_relay + w],
+                           f"worker {ident}")
+
+        # Measured window: fleet-frame arrival rate at the root (the
+        # O(relays) evidence — relays forward ONE frame per interval no
+        # matter how many actors sit behind them).
+        frames0 = server._fleet._m_frames.total()
+        t0 = time.monotonic()
+        time.sleep(window_s)
+        frames1 = server._fleet._m_frames.total()
+        frames_per_s = (frames1 - frames0) / (time.monotonic() - t0)
+
+        # Teardown fence: workers stop, ship their FINAL frames through
+        # disable_agent, relays forward them on the next tick.
+        with open(worker_stop, "w") as f:
+            f.write("stop")
+        worker_results = []
+        for proc, path in zip(worker_procs, result_paths):
+            try:
+                out, _ = proc.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            if proc.returncode != 0 or not os.path.exists(path):
+                raise RuntimeError(f"fleet worker failed "
+                                   f"(rc={proc.returncode}):\n{out[-3000:]}")
+            with open(path) as f:
+                worker_results.append(json.load(f))
+        time.sleep(FLEET_INTERVAL_S * 4)  # two relay forward ticks
+
+        alert_evidence = None
+        if alert_drill:
+            alert_evidence = _run_alert_drill(server)
+
+        fleet_doc = _fetch_json(exporter.url + "/fleet")
+        import urllib.request
+
+        with urllib.request.urlopen(exporter.url + "/fleet/metrics",
+                                    timeout=10) as resp:
+            prom_text = resp.read().decode()
+
+        tiers = {p["proc"]: p["tier"] for p in fleet_doc["procs"]}
+        expected_actors = {r["identity"] for r in worker_results}
+        missing = expected_actors - set(tiers)
+        assert not missing, f"procs missing from /fleet: {missing}"
+        assert all(tiers[p] == "actor" for p in expected_actors), tiers
+        relay_names = [p for p, t in tiers.items() if t == "relay"]
+        assert len(relay_names) == relays, tiers
+        assert sum(1 for t in tiers.values() if t == "server") == 1, tiers
+        check = _actor_counter_check(fleet_doc["merged"], worker_results)
+        assert check["exact"], f"merged != sum of registries: {check}"
+        # Every actor proc's series appears on the Prometheus surface
+        # with its proc label.
+        for ident in expected_actors:
+            assert f'proc="{ident}"' in prom_text
+
+        row = {
+            "bench": "fleet_zmq",
+            "config": {
+                "transport": "zmq", "relays": relays,
+                "workers_per_relay": workers_per_relay, "lanes": lanes,
+                "logical_actors": relays * workers_per_relay * lanes,
+                "fleet_interval_s": FLEET_INTERVAL_S,
+                "window_s": window_s,
+            },
+            "procs": fleet_doc["procs"],
+            "proc_count": len(fleet_doc["procs"]),
+            "root_fleet_frames_per_s": round(frames_per_s, 3),
+            "root_fleet_sections_total":
+                server._fleet._m_sections.total(),
+            "counter_check": check,
+            "alerts_armed": [a["name"] for a in fleet_doc["alerts"]],
+            "alert_drill": alert_evidence,
+            "env_steps_merged": next(
+                (m["value"] for m in fleet_doc["merged"]["metrics"]
+                 if m["name"] == "relayrl_actor_env_steps_total"), None),
+        }
+        emit("fleet_zmq", row["config"], frames_per_s, "fleet_frames/s")
+        return row
+    finally:
+        with open(relay_stop, "w") as f:
+            f.write("stop")
+        for proc in relay_procs:
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for proc in worker_procs:
+            if proc.poll() is None:
+                proc.kill()
+        server.disable_server()
+
+
+def main() -> None:
+    import tempfile
+
+    scratch = tempfile.mkdtemp(prefix="relayrl_fleet_")
+    os.chdir(scratch)
+    cfg_path = _write_config(scratch)
+    rows = []
+    if quick():
+        rows.append(run_row(scratch, cfg_path, relays=2,
+                            workers_per_relay=1, lanes=4, window_s=6.0,
+                            alert_drill=True))
+    else:
+        # Two points at FIXED relay count with the actor count doubling:
+        # the root's fleet-frame rate must stay flat (O(relays) ingest).
+        rows.append(run_row(scratch, cfg_path, relays=2,
+                            workers_per_relay=2, lanes=8, window_s=12.0))
+        rows.append(run_row(scratch, cfg_path, relays=2,
+                            workers_per_relay=2, lanes=16, window_s=12.0,
+                            alert_drill=True))
+        r32 = rows[0]["root_fleet_frames_per_s"]
+        r64 = rows[1]["root_fleet_frames_per_s"]
+        assert r32 > 0 and r64 > 0
+        ratio = r64 / r32
+        assert 0.5 <= ratio <= 1.5, (
+            f"root fleet-frame rate moved with actor count "
+            f"({r32} -> {r64}/s at fixed 2 relays): ingest is not "
+            f"O(relays)")
+        rows.append({"bench": "fleet_zmq_o_relays",
+                     "frames_per_s_32_actors": r32,
+                     "frames_per_s_64_actors": r64,
+                     "ratio": round(ratio, 3)})
+    doc = {
+        "bench": "fleet_zmq",
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    print(json.dumps({"rows": len(rows),
+                      "ok": True}), flush=True)
+    if "--write" in sys.argv:
+        out = os.path.join(ROOT, "benches", "results", "fleet_zmq.json")
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1082,17 +1082,24 @@ def _read_json(path: str):
 def _sum_counters(snapshots: list[dict], prefixes: tuple[str, ...]) -> dict:
     """Aggregate matching counter rows across process snapshots:
     ``name{labels} -> summed value`` (the cross-process half of the
-    chaos evidence — injected faults and retries live in the workers)."""
+    chaos evidence — injected faults and retries live in the workers).
+    Pooling is ``telemetry.aggregate.merge_snapshots`` — the fleet
+    plane's one merge implementation (ISSUE 15), filtered down to the
+    requested counter families."""
+    from relayrl_tpu.telemetry.aggregate import merge_snapshots
+
     agg: dict[str, float] = {}
-    for snap in snapshots:
-        for m in snap.get("metrics", []):
-            name = m.get("name", "")
-            if not name.startswith(prefixes):
-                continue
-            labels = ",".join(f"{k}={v}" for k, v in
-                              sorted((m.get("labels") or {}).items()))
-            key = f"{name}{{{labels}}}" if labels else name
-            agg[key] = agg.get(key, 0) + (m.get("value") or 0)
+    for m in merge_snapshots(snapshots)["metrics"]:
+        name = m.get("name", "")
+        # Gauges ride too (merged value = fleet sum): the breaker-state
+        # gauge has always been part of the chaos evidence block.
+        if m.get("kind") not in ("counter", "gauge") \
+                or not name.startswith(prefixes):
+            continue
+        labels = ",".join(f"{k}={v}" for k, v in
+                          sorted((m.get("labels") or {}).items()))
+        key = f"{name}{{{labels}}}" if labels else name
+        agg[key] = m.get("value") or 0
     return agg
 
 

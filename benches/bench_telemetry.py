@@ -35,6 +35,14 @@ MAX_ENABLED_COUNTER_NS = 3000.0
 MAX_TRACE_DISABLED_NS = 1500.0
 MAX_TRACE_SPAN_NS = 30000.0
 MAX_TRACE_DRAW_NS = 5000.0
+# Fleet aggregation plane (ISSUE 15): one snapshot-frame encode per
+# process per fleet_interval_s (msgpack of a ~40-family registry), and
+# one merge per proc per interval at the root. Both are off the hot
+# path (emitter/tick threads), so the ceilings guard "per interval"
+# scale, not per-op scale: a root merging 1000 procs at these ceilings
+# spends <1 core-second per interval.
+MAX_FRAME_ENCODE_US = 3000.0
+MAX_MERGE_US_PER_PROC = 1000.0
 
 
 def _best_ns_per_op(fn, n_ops: int, trials: int) -> float:
@@ -173,6 +181,52 @@ def run() -> list[dict]:
         f"{MAX_TRACE_SPAN_NS}ns — the flight-recorder path regressed")
     assert draw_ns < MAX_TRACE_DRAW_NS, (
         f"sampling draw {draw_ns:.0f}ns/op exceeds {MAX_TRACE_DRAW_NS}ns")
+
+    # -- fleet aggregation (ISSUE 15): frame encode + merge per proc --
+    from relayrl_tpu.telemetry.aggregate import (
+        encode_snapshot_frame,
+        merge_snapshots,
+        snapshot_section,
+    )
+
+    snap = reg.snapshot()
+    n_frames = 200 if quick() else 2000
+    t0 = time.perf_counter_ns()
+    for i in range(n_frames):
+        encode_snapshot_frame([snapshot_section(snap, "bench", "actor",
+                                                1.0, i)])
+    enc_us = (time.perf_counter_ns() - t0) / n_frames / 1000.0
+    entry = {"bench": "fleet_aggregation",
+             "config": {"op": "snapshot_frame_encode",
+                        "metric_families": 42, "n_ops": n_frames},
+             "us_per_frame": round(enc_us, 1), "unit": "us/frame",
+             "ceiling_us": MAX_FRAME_ENCODE_US}
+    print(json.dumps(entry))
+    rows.append(entry)
+    assert enc_us < MAX_FRAME_ENCODE_US, (
+        f"snapshot-frame encode {enc_us:.0f}us exceeds "
+        f"{MAX_FRAME_ENCODE_US}us — the fleet emitter got expensive")
+
+    for n_procs in (8, 64):
+        snaps = [snap] * n_procs
+        n_merges = max(5, (50 if quick() else 200) // max(1, n_procs // 8))
+        t0 = time.perf_counter_ns()
+        for _ in range(n_merges):
+            merge_snapshots(snaps)
+        merge_us = (time.perf_counter_ns() - t0) / n_merges / 1000.0
+        per_proc_us = merge_us / n_procs
+        entry = {"bench": "fleet_aggregation",
+                 "config": {"op": "merge_snapshots", "procs": n_procs,
+                            "metric_families": 42, "n_ops": n_merges},
+                 "us_per_merge": round(merge_us, 1),
+                 "us_per_proc": round(per_proc_us, 1), "unit": "us/merge",
+                 "ceiling_us_per_proc": MAX_MERGE_US_PER_PROC}
+        print(json.dumps(entry))
+        rows.append(entry)
+        assert per_proc_us < MAX_MERGE_US_PER_PROC, (
+            f"merge at {n_procs} procs costs {per_proc_us:.0f}us/proc, "
+            f"exceeds {MAX_MERGE_US_PER_PROC}us — root tick cost "
+            f"regressed")
     return rows
 
 

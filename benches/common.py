@@ -185,7 +185,16 @@ def age_attribution(snapshots: list[dict]) -> dict:
     (data age lives server-side, model age actor-side) into one
     ``{count, mean, p50, p95}`` summary per distribution. Histograms
     with zero samples report ``{"count": 0}`` — the schema is stable
-    either way, which is what the soak smoke asserts."""
+    either way, which is what the soak smoke asserts.
+
+    Pooling is :func:`relayrl_tpu.telemetry.aggregate.merge_snapshots`
+    — the fleet plane's ONE merge implementation (ISSUE 15), so bench
+    artifacts and the live ``/fleet`` endpoint can never disagree on
+    merge semantics."""
+    from relayrl_tpu.telemetry.aggregate import (
+        merge_snapshots,
+        snapshot_metric,
+    )
     from relayrl_tpu.telemetry.top import histogram_quantile
 
     wanted = {
@@ -193,33 +202,17 @@ def age_attribution(snapshots: list[dict]) -> dict:
         "relayrl_trace_model_age_seconds": "model_age_s",
         "relayrl_trace_data_age_versions": "data_age_versions",
     }
-    pooled: dict[str, dict] = {}
-    sampled = spans = 0.0
-    for snap in snapshots:
-        for m in (snap or {}).get("metrics", []):
-            name = m.get("name")
-            if name == "relayrl_trace_sampled_total":
-                sampled += m.get("value") or 0
-            elif name == "relayrl_trace_spans_total":
-                spans += m.get("value") or 0
-            if name not in wanted or m.get("kind") != "histogram":
-                continue
-            agg = pooled.get(name)
-            if agg is None:
-                pooled[name] = {"buckets": list(m["buckets"]),
-                                "counts": list(m["counts"]),
-                                "sum": m.get("sum") or 0.0,
-                                "count": m.get("count") or 0,
-                                "kind": "histogram"}
-            else:
-                # Same metric family ⇒ same registered grid everywhere.
-                for i, c in enumerate(m["counts"]):
-                    agg["counts"][i] += c
-                agg["sum"] += m.get("sum") or 0.0
-                agg["count"] += m.get("count") or 0
-    out = {"trace_sampled": int(sampled), "trace_spans": int(spans)}
+    merged = merge_snapshots(snap or {} for snap in snapshots)
+    out = {
+        "trace_sampled": int(snapshot_metric(
+            merged, "relayrl_trace_sampled_total") or 0),
+        "trace_spans": int(snapshot_metric(
+            merged, "relayrl_trace_spans_total") or 0),
+    }
+    by_name = {m["name"]: m for m in merged["metrics"]
+               if m.get("kind") == "histogram"}
     for name, key in wanted.items():
-        agg = pooled.get(name)
+        agg = by_name.get(name)
         if not agg or not agg["count"]:
             out[key] = {"count": 0}
             continue

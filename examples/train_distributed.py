@@ -64,12 +64,17 @@ def actor_proc(idx: int, server_type: str, agent_addrs: dict, env_id: str,
         # telemetry.enabled in the shared config gives every actor
         # process its own registry (the Agent ctor configures it); the
         # server owns telemetry.port, so actors export on an ephemeral
-        # port each — the printed URL is the per-actor scrape target
-        # (docs/observability.md).
+        # port each. With the fleet plane on (telemetry.fleet_interval_s
+        # > 0) these registries ALSO roll up to the root's /fleet pane —
+        # the one URL the driver prints — so the per-process endpoint is
+        # a drill-down, journaled as a telemetry_exporter event rather
+        # than left to scroll away in stdout.
         from relayrl_tpu import telemetry
 
         if telemetry.get_registry().enabled:
             exporter = telemetry.serve(port=0)
+            telemetry.emit("telemetry_exporter", proc=f"actor-{tag}",
+                           url=exporter.url, tier="actor")
             print(f"[actor {tag}] telemetry at {exporter.url}", flush=True)
 
     if host_mode == "remote":
@@ -289,6 +294,27 @@ def main():
         server_type=args.transport, env_dir=".",
         serving=(True if host_mode == "remote" else None),
         tensorboard=args.tensorboard, hyperparams=hp, **server_addrs)
+
+    # ONE pane of glass for the whole run: with telemetry enabled the
+    # root serves /metrics + /snapshot; with the fleet plane on
+    # (telemetry.fleet_interval_s > 0) every actor's registry rolls up
+    # behind /fleet too, and `telemetry.top --fleet --url <root>` is the
+    # merged view — actor exporter URLs are journaled drill-downs, not
+    # the discovery surface.
+    from relayrl_tpu import telemetry as _telemetry
+
+    if server._exporter is not None:
+        _telemetry.emit("telemetry_exporter", proc="server",
+                        url=server._exporter.url, tier="server")
+        if server._fleet is not None:
+            print(f"[driver] fleet telemetry at "
+                  f"{server._exporter.url}/fleet "
+                  f"(python -m relayrl_tpu.telemetry.top --fleet --url "
+                  f"{server._exporter.url})", flush=True)
+        else:
+            print(f"[driver] telemetry at {server._exporter.url} (set "
+                  f"telemetry.fleet_interval_s > 0 for the merged /fleet "
+                  f"pane)", flush=True)
 
     ctx = mp.get_context("spawn")
     queue = ctx.Queue()

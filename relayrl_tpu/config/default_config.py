@@ -521,6 +521,27 @@ DEFAULT_CONFIG: dict = {
         # Flight-recorder capacity (spans, oldest evicted) behind the
         # /traces endpoint and the Chrome-trace dump.
         "trace_ring": 4096,
+        # Fleet aggregation (telemetry/aggregate.py): every process's
+        # registry ships a compact snapshot frame through its agent
+        # transport (beside trajectories, no new socket) at this
+        # cadence; relays merge their subtree's frames so root ingest
+        # is O(relays); the root training server holds the fleet table
+        # behind /fleet + /fleet/metrics and evaluates the SLO alert
+        # rules each interval. 0 (the default) disables the plane —
+        # the trace_sample_rate opt-in convention.
+        "fleet_interval_s": 0.0,
+        # A proc silent this long leaves the fleet table (its counters
+        # leave the merged totals with it — eviction, not restart).
+        "fleet_stale_s": 15.0,
+        # SLO alert rules evaluated at the root over the MERGED fleet
+        # snapshot: a list of {name, metric, agg, op, threshold, for_s,
+        # labels} objects (docs/observability.md "Fleet aggregation"
+        # has the syntax). null = just the default pack below.
+        "alerts": None,
+        # false drops the stock rule pack (drops / breaker open /
+        # guardrail halt / non-finite publish blocked / ingest queue
+        # depth / trace data-age p95) and runs only telemetry.alerts.
+        "alerts_default_pack": True,
     },
     "model_paths": {
         "client_model": "client_model.rlx",

@@ -580,6 +580,45 @@ class ConfigLoader:
                                                or 4096))
         except (TypeError, ValueError):
             params["trace_ring"] = 4096
+        try:
+            params["fleet_interval_s"] = max(0.0, float(
+                params.get("fleet_interval_s") or 0.0))
+        except (TypeError, ValueError):
+            params["fleet_interval_s"] = 0.0
+        try:
+            params["fleet_stale_s"] = max(1.0, float(
+                params.get("fleet_stale_s") or 15.0))
+        except (TypeError, ValueError):
+            params["fleet_stale_s"] = 15.0
+        if params["fleet_interval_s"] > 0:
+            # The stale window must cover at least two emission
+            # intervals, or the root evicts every proc between its own
+            # frames and the table flaps (evict/rejoin per interval).
+            floor = 2.0 * params["fleet_interval_s"]
+            if params["fleet_stale_s"] < floor:
+                import warnings
+
+                warnings.warn(
+                    f"telemetry.fleet_stale_s "
+                    f"({params['fleet_stale_s']}) < 2x fleet_interval_s; "
+                    f"raising to {floor} so procs don't flap out of the "
+                    f"fleet table between their own frames")
+                params["fleet_stale_s"] = floor
+        alerts = params.get("alerts")
+        if isinstance(alerts, Mapping):
+            # A single rule object is a natural way to write one rule —
+            # accept it as a one-element list instead of dropping it.
+            alerts = [dict(alerts)]
+        elif alerts is not None and not isinstance(alerts, (list, tuple)):
+            import warnings
+
+            warnings.warn(
+                f"telemetry.alerts must be a list of rule objects; got "
+                f"{type(alerts).__name__} — ignoring")
+            alerts = None
+        params["alerts"] = list(alerts) if alerts is not None else None
+        params["alerts_default_pack"] = bool(
+            params.get("alerts_default_pack", True))
         return params
 
     def raw(self) -> dict:

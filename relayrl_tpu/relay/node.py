@@ -45,6 +45,7 @@ import threading
 import time
 
 from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.telemetry.aggregate import is_snapshot_frame
 
 
 class RelayNode:
@@ -162,9 +163,35 @@ class RelayNode:
         self._m_batches = reg.counter(
             "relayrl_relay_batches_forwarded_total",
             "multi-envelope containers sent upstream")
+        self._m_fwd_fleet = reg.counter(
+            "relayrl_relay_frames_forwarded_total",
+            "frames re-published/forwarded by this relay",
+            {"plane": "fleet"})
+        self._m_bytes_fleet = reg.counter(
+            "relayrl_relay_bytes_total",
+            "bytes re-published/forwarded by this relay",
+            {"plane": "fleet"})
         reg.gauge_fn("relayrl_relay_subtree_agents",
                      self._subtree_count,
                      "distinct logical agents seen from this subtree")
+
+        # Fleet telemetry fan-in (ISSUE 15, telemetry/aggregate.py):
+        # subtree snapshot frames are sniffed out of the trajectory
+        # ingest, buffered latest-per-proc, and forwarded as ONE
+        # multi-proc frame (plus this relay's own section) per
+        # ``telemetry.fleet_interval_s`` — root ingest stays O(relays)
+        # exactly like the model plane. interval 0 = plane off: frames
+        # fall through the normal forward path verbatim.
+        tel_params = self.config.get_telemetry_params()
+        self._fleet_interval_s = float(tel_params.get("fleet_interval_s")
+                                       or 0.0)
+        self._fleet_buf = None
+        self._fleet_seq = 0
+        self._fleet_thread: threading.Thread | None = None
+        if self._fleet_interval_s > 0:
+            from relayrl_tpu.telemetry.aggregate import FleetRelayBuffer
+
+            self._fleet_buf = FleetRelayBuffer()
 
         self.spool = None
         self.up = upstream_transport
@@ -212,6 +239,10 @@ class RelayNode:
         self.up.on_model = self._on_upstream_model
         self.up.on_reconnect = self._on_upstream_reconnect
         self.up.start_model_listener()
+        if self._fleet_buf is not None:
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_loop, name="relay-fleet", daemon=True)
+            self._fleet_thread.start()
         self.active = True
         from relayrl_tpu import telemetry
 
@@ -297,6 +328,12 @@ class RelayNode:
         if self._fwd_thread is not None:
             self._fwd_thread.join(timeout=5)
             self._fwd_thread = None
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=5)
+            self._fleet_thread = None
+            # Final flush: whatever the subtree reported last (plus this
+            # relay's closing section) still reaches the root.
+            self._fleet_flush()
         self._drain_forward_buffer()
         if self.spool is not None:
             if flush_timeout_s > 0:
@@ -486,11 +523,65 @@ class RelayNode:
             self._m_resync_escalated.inc()
             self.up.request_resync(held_version)
 
+    # -- fleet telemetry plane (subtree frames -> one merged frame) --
+    def _fleet_loop(self) -> None:
+        while not self._stop.wait(self._fleet_interval_s):
+            self._fleet_flush()
+
+    def _fleet_flush(self) -> None:
+        """One fan-in interval: sections the subtree updated since the
+        last flush + this relay's own registry section, forwarded
+        upstream as ONE frame. Sections ride VERBATIM — the root's
+        epoch-aware counter baselines need the leaf's own stamps.
+        Spool-less on purpose: telemetry is latest-wins, and replaying
+        a retained stale snapshot would regress the root's table."""
+        from relayrl_tpu import telemetry
+        from relayrl_tpu.telemetry.aggregate import (
+            encode_snapshot_frame,
+            fleet_wire_id,
+            snapshot_section,
+        )
+
+        sections = self._fleet_buf.drain()
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            self._fleet_seq += 1
+            sections.append(snapshot_section(
+                reg.snapshot(), self.name, "relay",
+                getattr(reg, "created_unix", 0.0), self._fleet_seq))
+        if not sections:
+            return
+        frame = encode_snapshot_frame(sections)
+        try:
+            self.up.send_trajectory(frame,
+                                    agent_id=fleet_wire_id(self.name))
+        except Exception as e:
+            print(f"[relay/{self.name}] fleet forward failed (dropped; "
+                  f"next interval is fresher anyway): {e!r}", flush=True)
+            return
+        self._m_fwd_fleet.inc()
+        self._m_bytes_fleet.inc(len(frame))
+
+    def _ingest_subtree_snapshot(self, payload: bytes) -> None:
+        from relayrl_tpu.transport.base import swallow_decode_error
+
+        try:
+            self._fleet_buf.ingest_frame(payload)
+        except ValueError as e:
+            self._m_dropped.inc()
+            swallow_decode_error(self.downstream_type, "fleet_frame", e)
+
     # -- trajectory plane (downstream ingest -> upstream forward) --
     def _on_subtree_trajectory(self, tagged_id: str, payload: bytes) -> None:
         """One subtree envelope (downstream transport thread). The id
         arrives with the leaf's seq tag intact and MUST leave with it
-        intact — attribution and dedup belong to the leaves."""
+        intact — attribution and dedup belong to the leaves. Fleet
+        snapshot frames (RLS1) peel off into the fan-in buffer instead
+        of the forward path; with the fleet plane off they fall through
+        and forward verbatim like any other opaque payload."""
+        if self._fleet_buf is not None and is_snapshot_frame(payload):
+            self._ingest_subtree_snapshot(payload)
+            return
         from relayrl_tpu.transport.base import (
             split_agent_seq,
             split_agent_trace,

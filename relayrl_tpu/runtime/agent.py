@@ -138,6 +138,43 @@ def _bind_spool_impl(owner, name: str) -> None:
         owner.spool.send_fn = send_fn
 
 
+def _start_fleet_emitter(owner, tier: str):
+    """Start the per-process fleet snapshot emitter (ISSUE 15,
+    telemetry/aggregate.py) when the plane is on: registry live AND
+    ``telemetry.fleet_interval_s`` > 0. The frame rides the owner's
+    agent transport beside trajectories (no new socket); shared by
+    Agent / VectorAgent / RemoteActorClient so the gating and the wire
+    id convention exist exactly once. Returns the emitter or None."""
+    from relayrl_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    try:
+        interval = float(owner.config.get_telemetry_params()
+                         .get("fleet_interval_s") or 0.0)
+    except Exception:
+        interval = 0.0
+    if not reg.enabled or interval <= 0:
+        return None
+    from relayrl_tpu.telemetry.aggregate import FleetEmitter
+
+    transport = owner.transport
+
+    def send(frame: bytes, wire_id: str) -> None:
+        transport.send_trajectory(frame, agent_id=wire_id)
+
+    return FleetEmitter(send, proc=transport.identity, tier=tier,
+                        interval_s=interval, registry=reg)
+
+
+def _close_fleet_emitter(owner) -> None:
+    """Final-frame flush + thread stop BEFORE the transport closes (the
+    last frame carries this life's closing totals to the root)."""
+    emitter = getattr(owner, "_fleet_emitter", None)
+    if emitter is not None:
+        emitter.close(final=True)
+        owner._fleet_emitter = None
+
+
 def _handle_reconnect_impl(owner, agent_ids: list[str]) -> None:
     """Shared transport-heal handler: re-register every logical agent
     (the server may have reaped them on kernel close — _on_register
@@ -187,6 +224,7 @@ class Agent:
         self.actor: PolicyActor | None = None
         self.transport = None
         self.spool = None  # TrajectorySpool, built on first enable
+        self._fleet_emitter = None
         self.active = False
         if start:
             self.enable_agent()
@@ -228,6 +266,7 @@ class Agent:
         self.transport.on_model = self._on_model
         self.transport.on_reconnect = self._handle_reconnect
         self.transport.start_model_listener()
+        self._fleet_emitter = _start_fleet_emitter(self, "actor")
         self.active = True
         from relayrl_tpu import telemetry
 
@@ -275,6 +314,7 @@ class Agent:
     def disable_agent(self) -> None:
         if not self.active:
             return
+        _close_fleet_emitter(self)
         if self.spool is not None:
             # The spool outlives the transport (its retained window and
             # seq counters survive restart_agent); detach the send hook
@@ -439,6 +479,7 @@ class VectorAgent:
         self.host = None
         self.transport = None
         self.spool = None
+        self._fleet_emitter = None
         self.agent_ids: list[str] = []
         self.active = False
         if start:
@@ -511,6 +552,7 @@ class VectorAgent:
         self.transport.on_reconnect = (
             lambda: _handle_reconnect_impl(self, self.agent_ids))
         self.transport.start_model_listener()
+        self._fleet_emitter = _start_fleet_emitter(self, "actor")
         self.active = True
         from relayrl_tpu import telemetry
 
@@ -526,6 +568,7 @@ class VectorAgent:
             # cycle must not leak one thread (and one pinned host) per
             # cycle; enable_agent restarts it via start_emitter.
             self.host.close()
+        _close_fleet_emitter(self)
         if self.spool is not None:
             self.spool.send_fn = None  # see Agent.disable_agent
         self.transport.close()

@@ -641,6 +641,7 @@ class RemoteActorClient:
         self._serving = None
         self._breaker = None
         self._retry = None
+        self._fleet_emitter = None
         self.trajectory = Trajectory(
             max_length=self.config.get_max_traj_length(),
             on_send=self._send_traj)
@@ -710,6 +711,9 @@ class RemoteActorClient:
         self._serving = make_serving_client(
             self.server_type, self.config, transport=self.transport,
             **serving_overrides)
+        from relayrl_tpu.runtime.agent import _start_fleet_emitter
+
+        self._fleet_emitter = _start_fleet_emitter(self, "client")
         self.active = True
         from relayrl_tpu import telemetry
 
@@ -719,6 +723,9 @@ class RemoteActorClient:
     def disable_agent(self) -> None:
         if not self.active:
             return
+        from relayrl_tpu.runtime.agent import _close_fleet_emitter
+
+        _close_fleet_emitter(self)
         if self.spool is not None:
             self.spool.send_fn = None
         if self._serving is not None:

@@ -33,6 +33,7 @@ from typing import Any, Mapping
 
 from relayrl_tpu.algorithms import build_algorithm, registered_algorithms
 from relayrl_tpu.config import ConfigLoader
+from relayrl_tpu.telemetry.aggregate import is_snapshot_frame
 from relayrl_tpu.transport import make_server_transport
 from relayrl_tpu.telemetry.trace import split_ctx as _split_trace_ctx
 from relayrl_tpu.transport.base import (
@@ -189,6 +190,36 @@ class TrainingServer:
         self._ckpt_consecutive_failures = 0
         self._drop_events = _EventCoalescer()
         self._dup_events = _EventCoalescer()
+
+        # Fleet telemetry aggregation (ISSUE 15, telemetry/aggregate.py):
+        # the root holds the fleet table — every process's snapshot
+        # frames land here through the ordinary ingest funnel (sniffed by
+        # RLS1 magic in _ingest_one, O(relays) frames under a relay
+        # tree), the fleet tick folds this server's own registry in,
+        # evicts stale procs, and runs the SLO alert rules over the
+        # merged snapshot. Gated like tracing: registry live AND
+        # telemetry.fleet_interval_s > 0.
+        tel_params = self.config.get_telemetry_params()
+        self._fleet = None
+        self._alerts = None
+        self._fleet_interval_s = float(tel_params.get("fleet_interval_s")
+                                       or 0.0)
+        self._fleet_stop = threading.Event()
+        self._fleet_thread: threading.Thread | None = None
+        self._fleet_proc = f"server-{os.getpid()}"
+        if reg.enabled and self._fleet_interval_s > 0:
+            from relayrl_tpu.telemetry.aggregate import (
+                AlertEngine,
+                FleetTable,
+                rules_from_config,
+            )
+
+            self._fleet = FleetTable(
+                stale_s=tel_params.get("fleet_stale_s", 15.0), registry=reg)
+            self._alerts = AlertEngine(rules_from_config(tel_params),
+                                       registry=reg)
+            if self._exporter is not None:
+                self._exporter.set_fleet(self._fleet, self._alerts)
 
         # Fault-injection plane: the env-driven plan (RELAYRL_FAULT_PLAN)
         # installs before any hook site resolves; production processes
@@ -770,6 +801,20 @@ class TrainingServer:
 
     def _ingest_one(self, agent_id: str, payload: bytes,
                     depth: int = 0) -> None:
+        if is_snapshot_frame(payload):
+            # Fleet telemetry frame (ISSUE 15): route to the fleet table
+            # BEFORE dedup/guardrails — telemetry carries no seqs, must
+            # never strike a quarantine book, and a fleet-less server
+            # treats it as inert noise rather than a decode failure
+            # (which would count drops and could fire the drops alert
+            # the frames exist to deliver).
+            fleet = self._fleet
+            if fleet is not None:
+                try:
+                    fleet.ingest_frame(payload)
+                except ValueError as e:
+                    swallow_decode_error(self.server_type, "fleet_frame", e)
+            return
         if batch_kind(payload) == BATCH_KIND_ENVELOPES and depth < 8:
             # Relay upstream forward (ISSUE 11): one wire send carrying N
             # whole subtree envelopes, each with its leaf agent's id +
@@ -2076,6 +2121,33 @@ class TrainingServer:
                       f"(#{self._ckpt_consecutive_failures} consecutive): "
                       f"{e!r}", flush=True)
 
+    # -- fleet telemetry tick (ISSUE 15) --
+    def _fleet_loop(self) -> None:
+        while not self._fleet_stop.wait(self._fleet_interval_s):
+            self._fleet_tick()
+
+    def _fleet_tick(self) -> None:
+        """One aggregation interval at the root: fold this server's own
+        registry into the table, evict stale procs, evaluate the SLO
+        rules over the merged snapshot. Public-ish so drills/tests can
+        tick deterministically; isolated — the pane must never take
+        down the plane it watches."""
+        from relayrl_tpu import telemetry
+
+        try:
+            self._fleet.ingest_registry(self._telemetry, self._fleet_proc,
+                                        "server")
+            for proc in self._fleet.sweep():
+                telemetry.emit("fleet_evict", proc=proc)
+            if self._alerts is not None:
+                # Membership rides along so increase rules rebaseline
+                # across evict/rejoin churn instead of firing on it.
+                self._alerts.evaluate(
+                    self._fleet.merged(),
+                    membership=[p["proc"] for p in self._fleet.procs()])
+        except Exception as e:
+            print(f"[TrainingServer] fleet tick failed: {e!r}", flush=True)
+
     # -- lifecycle (ref: training_zmq.rs:322-465 / o3_training_server.rs:153-272) --
     def enable_server(self) -> None:
         if self.active:
@@ -2112,6 +2184,11 @@ class TrainingServer:
                     else self._learner_loop),
             name="learner", daemon=True)
         self._learner_thread.start()
+        if self._fleet is not None:
+            self._fleet_stop.clear()
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_loop, name="fleet-tick", daemon=True)
+            self._fleet_thread.start()
         self.active = True
 
     def wait_warmup(self, timeout: float | None = None) -> bool:
@@ -2135,6 +2212,14 @@ class TrainingServer:
         if not self.active:
             return
         self._stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_stop.set()
+            self._fleet_thread.join(timeout=5)
+            self._fleet_thread = None
+            # One closing tick so the table holds this life's final
+            # registry state (and alerts get a last look) before the
+            # ingest plane stops feeding it.
+            self._fleet_tick()
         # Serving plane first: parked thin-client requests answer with a
         # retryable nack instead of hanging out their timeouts against a
         # closing socket (clients ride their breaker until a restart).

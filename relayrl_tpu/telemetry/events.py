@@ -55,6 +55,15 @@ EVENT_TYPES = (
                          # t0_ns/t1_ns + hop fields) — the NDJSON export
                          # of the flight recorder; volume is bounded by
                          # telemetry.trace_sample_rate + journal rotation
+    # -- fleet aggregation + SLO alerts (ISSUE 15, telemetry/aggregate.py) --
+    "alert_fired",       # an SLO rule's condition held through its
+                         # for_s hold-down (carries rule/metric/value)
+    "alert_resolved",    # the rule's condition cleared
+    "fleet_evict",       # a proc went silent past telemetry.fleet_stale_s
+                         # and left the fleet table
+    "telemetry_exporter",  # a process started its /metrics exporter
+                           # (carries proc + url — the discoverable
+                           # record of per-process ephemeral ports)
 )
 
 

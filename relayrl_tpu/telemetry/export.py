@@ -100,12 +100,35 @@ def render_prometheus(snapshot: dict) -> str:
 class _Handler(BaseHTTPRequestHandler):
     # set per-server via the factory in TelemetryExporter
     registry = None
+    # Fleet aggregation (telemetry/aggregate.py): the root training
+    # server installs its FleetTable (+ AlertEngine) via
+    # TelemetryExporter.set_fleet, enabling /fleet and /fleet/metrics.
+    fleet = None
+    alerts = None
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0]
         if path in ("/metrics", "/"):
             body = render_prometheus(self.registry.snapshot()).encode()
             self._reply(200, _CONTENT_TYPE_PROM, body)
+        elif path == "/fleet":
+            fleet = type(self).fleet
+            if fleet is None:
+                self._reply(404, "application/json",
+                            b'{"error": "no fleet table on this process '
+                            b'(telemetry.fleet_interval_s off, or not the '
+                            b'root server)"}\n')
+                return
+            body = json.dumps(fleet.document(alerts=type(self).alerts),
+                              allow_nan=False).encode()
+            self._reply(200, "application/json", body)
+        elif path == "/fleet/metrics":
+            fleet = type(self).fleet
+            if fleet is None:
+                self._reply(404, "text/plain", b"no fleet table\n")
+                return
+            self._reply(200, _CONTENT_TYPE_PROM,
+                        fleet.prometheus_text().encode())
         elif path == "/snapshot":
             # allow_nan=False is a tripwire, not a formatter: the
             # snapshot contract already nulls non-finite values.
@@ -161,6 +184,15 @@ class TelemetryExporter:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    def set_fleet(self, fleet, alerts=None) -> None:
+        """Install the fleet table (+ alert engine) behind ``/fleet`` and
+        ``/fleet/metrics``. Called by the root training server AFTER the
+        exporter is up (construction order: telemetry serves first, the
+        fleet plane builds later)."""
+        handler = self._httpd.RequestHandlerClass
+        handler.fleet = fleet
+        handler.alerts = alerts
 
     def close(self) -> None:
         self._httpd.shutdown()

@@ -34,6 +34,8 @@ _SECTIONS = (
     ("rlhf", "relayrl_rlhf_"),
     ("trace", "relayrl_trace_"),
     ("serving", "relayrl_serving_"),
+    ("fleet", "relayrl_fleet_"),
+    ("alerts", "relayrl_alert"),
     ("actor", "relayrl_actor_"),
     ("epoch", "relayrl_epoch_"),
 )
@@ -41,6 +43,12 @@ _SECTIONS = (
 
 def fetch_snapshot(url: str, timeout_s: float = 5.0) -> dict:
     with urllib.request.urlopen(url.rstrip("/") + "/snapshot",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fetch_fleet(url: str, timeout_s: float = 5.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/fleet",
                                 timeout=timeout_s) as resp:
         return json.loads(resp.read().decode())
 
@@ -143,6 +151,54 @@ def render(snapshot: dict, prev: dict | None = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+_TIER_ORDER = ("server", "relay", "actor", "client", "other")
+
+
+def render_fleet(doc: dict, prev: dict | None = None) -> str:
+    """``/fleet`` document → one merged fleet pane (ISSUE 15): an alerts
+    line, per-tier proc sections, and the fleet-merged metrics grouped
+    by the same plane prefixes as the single-process view. Pure
+    function (tested without any HTTP)."""
+    procs = doc.get("procs", [])
+    tiers: dict[str, list[dict]] = {}
+    for p in procs:
+        tiers.setdefault(p.get("tier", "other"), []).append(p)
+    tier_counts = " ".join(f"{t}={len(tiers[t])}" for t in _TIER_ORDER
+                           if t in tiers)
+    lines = [f"relayrl fleet · {len(procs)} proc(s) · {tier_counts}"
+             f" · stale_s {doc.get('stale_s')}"
+             f" · {time.strftime('%H:%M:%S')}"]
+    alerts = doc.get("alerts") or []
+    active = [a for a in alerts if a.get("active")]
+    if active:
+        parts = ", ".join(
+            f"{a['name']}({_fmt_num(a.get('value'))} {a.get('op')} "
+            f"{_fmt_num(a.get('threshold'))})" for a in active)
+        lines.append(f"ALERTS: {len(active)} active — {parts}")
+    else:
+        lines.append(f"alerts: none active ({len(alerts)} rule(s) armed)")
+    for tier in _TIER_ORDER:
+        rows = tiers.get(tier)
+        if not rows:
+            continue
+        lines.append(f"-- {tier} " + "-" * max(1, 58 - len(tier)))
+        for p in sorted(rows, key=lambda r: r.get("proc", "")):
+            extra = (f" · restarts {p['restarts']}"
+                     if p.get("restarts") else "")
+            up = p.get("uptime_s")
+            lines.append(
+                f"  {p.get('proc')} · age {p.get('age_s', '?')}s"
+                + (f" · up {up:.0f}s" if isinstance(up, (int, float))
+                   else "") + extra)
+    merged = doc.get("merged") or {}
+    if merged.get("metrics"):
+        # No rate column: merged docs carry no shared monotonic clock.
+        lines.append("== fleet merged " + "=" * 47)
+        lines.append(render(dict(merged, enabled=True, run_id="fleet",
+                                 uptime_s=0.0)).split("\n", 1)[1])
+    return "\n".join(lines).rstrip("\n") + "\n"
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m relayrl_tpu.telemetry.top",
@@ -154,17 +210,25 @@ def main(argv: list[str] | None = None) -> int:
                         help="refresh seconds (default %(default)s)")
     parser.add_argument("--once", action="store_true",
                         help="print one frame and exit")
+    parser.add_argument("--fleet", action="store_true",
+                        help="render the ROOT server's merged fleet pane "
+                             "(/fleet: per-tier proc sections, alerts "
+                             "line, fleet-merged metrics) instead of the "
+                             "single-process /snapshot view")
     args = parser.parse_args(argv)
+    endpoint = "fleet" if args.fleet else "snapshot"
     prev = None
     try:
         while True:
             try:
-                snapshot = fetch_snapshot(args.url)
+                snapshot = (fetch_fleet(args.url) if args.fleet
+                            else fetch_snapshot(args.url))
             except (urllib.error.URLError, OSError, ValueError) as e:
-                print(f"cannot reach {args.url}/snapshot: {e}",
+                print(f"cannot reach {args.url}/{endpoint}: {e}",
                       file=sys.stderr)
                 return 1
-            frame = render(snapshot, prev)
+            frame = (render_fleet(snapshot, prev) if args.fleet
+                     else render(snapshot, prev))
             if args.once:
                 sys.stdout.write(frame)
                 return 0

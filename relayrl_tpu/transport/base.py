@@ -50,11 +50,15 @@ MODEL_TOPIC = b"model"
 
 def pack_trajectory_envelope(agent_id: str, payload: bytes) -> bytes:
     """``payload`` is opaque to the transport plane: per-record msgpack
-    (``types/trajectory.serialize_actions``) or a columnar trajectory
+    (``types/trajectory.serialize_actions``), a columnar trajectory
     frame (``types/columnar.encode_columnar_frame`` — the anakin tier's
-    wire form, sniffed server-side by the RLD1 magic). Envelopes carry
-    attribution + the spool's ``#s<seq>`` tag identically for both, so
-    the whole delivery plane is wire-form-agnostic."""
+    wire form, sniffed server-side by the RLD1 magic), or a fleet
+    telemetry snapshot frame (``telemetry/aggregate.py`` — ``RLS1``
+    magic, id ``@fleet/<proc>``, sniffed at every ingest funnel and at
+    relays; rides beside trajectories so the metrics plane needs no
+    socket of its own). Envelopes carry attribution + the spool's
+    ``#s<seq>`` tag identically for all three, so the whole delivery
+    plane is wire-form-agnostic."""
     return msgpack.packb({"id": agent_id, "traj": payload}, use_bin_type=True)
 
 

@@ -552,6 +552,11 @@ def _spawn_chaos_server(scratch, transport, addrs, resume):
         text=True)
 
 
+# Tier-1 wall budget (ISSUE 15): slow-marked — the fast set keeps one
+# SIGKILL drill per transport (tests/test_recovery.py); this variant
+# re-runs the same contract with frames on the wire (~38 s for the
+# trio). Run via `pytest -m columnar`.
+@pytest.mark.slow
 @pytest.mark.parametrize("transport", ["zmq", "grpc", "native"])
 def test_learner_sigkill_columnar_replay_zero_loss(transport, tmp_path,
                                                    tmp_cwd):
