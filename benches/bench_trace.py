@@ -20,7 +20,7 @@ The committed row asserts (and records the evidence for):
   actors AND re-broadcast through the relay hop;
 * the analyzer's data-age / model-age distributions, with the
   version-lag distribution matching the server-side
-  ``relayrl_rlhf_train_version_lag`` evidence (same samples, two
+  ``relayrl_rlhf_train_lag_versions`` evidence (same samples, two
   pipelines) within sampling error;
 * the journal→analyzer path: spans are re-read from the NDJSON journal
   and must reproduce the ring's trace set.
@@ -194,7 +194,7 @@ def run() -> dict:
     assert data_age["count"] > 0 and model_age["count"] > 0
     snap = telemetry.get_registry().snapshot()
     lag_hist = next(m for m in snap["metrics"]
-                    if m["name"] == "relayrl_rlhf_train_version_lag")
+                    if m["name"] == "relayrl_rlhf_train_lag_versions")
     hist_mean = (lag_hist["sum"] / lag_hist["count"]
                  if lag_hist["count"] else None)
     # Same samples, two pipelines (trace spans vs the live histogram):

@@ -1,4 +1,4 @@
-"""relayrl_tpu.analysis — jaxlint, a JAX-aware static-analysis pass.
+"""relayrl_tpu.analysis — jaxlint + contracts, the static-analysis gate.
 
 The reference prototype shipped with zero correctness tooling; this
 framework's hot paths are exactly the JAX surface where silent hazards
@@ -6,15 +6,24 @@ framework's hot paths are exactly the JAX surface where silent hazards
 buffers) degrade into throughput cliffs that benchmarks only catch after
 the fact. jaxlint is the CI gate that catches them at review time.
 
+The second engine — contracts — guards the cross-artifact agreements
+the runtime rests on: metric registrations vs the observability
+catalog, config defaults vs loader clamps vs the ops knob tables,
+Python wire constants vs ``native/*.cc``, the cross-module lock graph,
+and tests/ markers vs pytest.ini. Its machine-readable inventory is
+committed as ``contracts.json`` next to ``baseline.json``.
+
 Usage::
 
-    python -m relayrl_tpu.analysis                 # lint the framework
+    python -m relayrl_tpu.analysis                 # jaxlint + contracts
+    python -m relayrl_tpu.analysis --contracts     # contracts only
     python -m relayrl_tpu.analysis path/ --no-baseline
     python -m relayrl_tpu.analysis --list-rules
 
-Suppress one line with ``# jaxlint: disable=JAX01`` (same line or the
-line above); grandfathered findings live in ``baseline.json`` next to
-this file. See ``docs/static_analysis.md`` for the rule catalog.
+Suppress one line with ``# jaxlint: disable=JAX01`` (any line of the
+statement, or the comment-only line above); grandfathered findings live
+in ``baseline.json`` next to this file. See ``docs/static_analysis.md``
+for both rule catalogs.
 
 The analyzer itself is stdlib-only and never imports jax, so the gate
 runs on accelerator-free CI hosts; importing it as a subpackage pulls
@@ -22,6 +31,11 @@ only the framework's lightweight types/config layer (numpy + msgpack).
 """
 
 from relayrl_tpu.analysis.cli import main  # noqa: F401
+from relayrl_tpu.analysis.contracts import (  # noqa: F401
+    CONTRACT_RULES,
+    ContractContext,
+    run_contracts,
+)
 from relayrl_tpu.analysis.engine import (  # noqa: F401
     Finding,
     Rule,
@@ -45,5 +59,8 @@ __all__ = [
     "apply_baseline",
     "all_rules",
     "rules_by_code",
+    "CONTRACT_RULES",
+    "ContractContext",
+    "run_contracts",
     "main",
 ]

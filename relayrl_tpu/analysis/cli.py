@@ -1,11 +1,20 @@
-"""jaxlint command line.
+"""jaxlint + contracts command line.
 
     python -m relayrl_tpu.analysis [paths...] [options]
 
+Two engines share one gate: jaxlint (per-line AST rules over the given
+paths) and contracts (cross-artifact drift checks over the installed
+package + repo artifacts). The bare default invocation runs BOTH and
+any *new* finding fails the gate; ``--contracts`` runs the contract
+engine alone, ``--no-contracts`` the linter alone. Explicit paths scan
+with jaxlint only — the contract surfaces are package-wide, not
+path-scoped — unless ``--contracts`` is also given.
+
 Exit codes: 0 = clean (every finding baselined or none), 1 = new
 findings, 2 = bad invocation. The default baseline is the committed
-``relayrl_tpu/analysis/baseline.json``; CI runs the bare default
-invocation and any *new* finding fails the gate.
+``relayrl_tpu/analysis/baseline.json``; the committed contract
+inventory is ``relayrl_tpu/analysis/contracts.json`` (regenerate with
+``--contracts --write-inventory``).
 """
 
 from __future__ import annotations
@@ -39,11 +48,12 @@ def _default_scan_root() -> str:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m relayrl_tpu.analysis",
-        description="jaxlint: JAX-aware static analysis for relayrl_tpu",
+        description=("jaxlint + contracts: static analysis for "
+                     "relayrl_tpu"),
     )
     p.add_argument("paths", nargs="*",
-                   help="files/directories to scan (default: the "
-                        "installed relayrl_tpu package)")
+                   help="files/directories to scan with jaxlint "
+                        "(default: the installed relayrl_tpu package)")
     p.add_argument("--baseline", default=None, metavar="FILE",
                    help="baseline JSON of grandfathered findings "
                         f"(default: {DEFAULT_BASELINE})")
@@ -53,54 +63,123 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the current findings to the baseline file "
                         "and exit 0 (requires an explicit --baseline "
                         "PATH — never overwrites the default silently)")
+    p.add_argument("--contracts", action="store_true",
+                   help="run only the contracts engine (cross-artifact "
+                        "drift checks)")
+    p.add_argument("--no-contracts", action="store_true",
+                   help="run only jaxlint, skipping the contracts engine")
+    p.add_argument("--inventory", default=None, metavar="FILE",
+                   help="committed contract inventory to check against / "
+                        "write (default: the packaged contracts.json)")
+    p.add_argument("--write-inventory", action="store_true",
+                   help="regenerate the contract inventory from the "
+                        "current tree (to --inventory, default the "
+                        "packaged contracts.json) and exit 0")
     p.add_argument("--select", default=None, metavar="CODES",
                    help="comma-separated rule codes to run (default all)")
     p.add_argument("--ignore", default=None, metavar="CODES",
                    help="comma-separated rule codes to skip")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--list-rules", action="store_true",
-                   help="print the rule catalog and exit")
+                   help="print both engines' rule catalogs and exit")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the summary line")
     return p
 
 
-def _pick_rules(select: str | None, ignore: str | None):
+def _pick_rules(select: str | None, ignore: str | None,
+                contract_codes: frozenset[str]):
+    """jaxlint rule objects plus the (selected, ignored) contract-code
+    filters; unknown codes across BOTH engines' catalogs exit 2."""
     rules = all_rules()
+    lint_codes = {r.code for r in rules}
+    selected_contracts: set[str] | None = None
     if select:
         wanted = {c.strip().upper() for c in select.split(",") if c.strip()}
-        unknown = wanted - {r.code for r in rules}
+        unknown = wanted - lint_codes - contract_codes
         if unknown:
             raise SystemExit(
                 f"unknown rule code(s): {', '.join(sorted(unknown))}")
         rules = [r for r in rules if r.code in wanted]
+        selected_contracts = wanted & contract_codes
+    ignored: set[str] = set()
     if ignore:
-        dropped = {c.strip().upper() for c in ignore.split(",") if c.strip()}
-        rules = [r for r in rules if r.code not in dropped]
-    return rules
+        ignored = {c.strip().upper() for c in ignore.split(",") if c.strip()}
+        rules = [r for r in rules if r.code not in ignored]
+    return rules, selected_contracts, ignored
 
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
+    from relayrl_tpu.analysis.contracts import (
+        CONTRACT_CODES,
+        CONTRACT_RULES,
+        DEFAULT_INVENTORY,
+        run_contracts,
+        write_inventory,
+    )
+
     if args.list_rules:
         for rule in all_rules():
             print(f"{rule.code}  {rule.name}: {rule.description}")
+        for code, name, description in CONTRACT_RULES:
+            print(f"{code}  {name}: {description}")
         return 0
 
+    if args.contracts and args.no_contracts:
+        print("--contracts and --no-contracts are mutually exclusive",
+              file=sys.stderr)
+        return 2
+
     try:
-        rules = _pick_rules(args.select, args.ignore)
+        rules, selected_contracts, ignored = _pick_rules(
+            args.select, args.ignore, CONTRACT_CODES)
     except SystemExit as e:
         print(e, file=sys.stderr)
         return 2
 
-    paths = args.paths or [_default_scan_root()]
-    for path in paths:
-        if not os.path.exists(path):
-            print(f"no such path: {path}", file=sys.stderr)
-            return 2
+    run_lint = not args.contracts
+    # contract surfaces are package-wide: the engine runs on the bare
+    # default invocation and on an explicit --contracts, not when the
+    # caller aimed jaxlint at specific paths
+    run_contract_engine = not args.no_contracts and (
+        args.contracts or not args.paths)
 
-    findings = analyze_paths(paths, rules=rules)
+    paths = args.paths or [_default_scan_root()]
+    if run_lint:
+        for path in paths:
+            if not os.path.exists(path):
+                print(f"no such path: {path}", file=sys.stderr)
+                return 2
+
+    findings = []
+    if run_lint:
+        findings.extend(analyze_paths(paths, rules=rules))
+
+    inventory_path = args.inventory or DEFAULT_INVENTORY
+    if run_contract_engine:
+        contract_findings, inventory_doc = run_contracts(
+            inventory_path=args.inventory,
+            check_inventory=not args.write_inventory)
+        if args.write_inventory:
+            write_inventory(inventory_path, inventory_doc)
+            if not args.quiet:
+                print(f"contracts: wrote inventory to {inventory_path}")
+            return 0
+        for f in contract_findings:
+            if selected_contracts is not None \
+                    and f.rule not in selected_contracts:
+                continue
+            if f.rule in ignored:
+                continue
+            findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    elif args.write_inventory:
+        print("--write-inventory requires the contracts engine "
+              "(drop --no-contracts / path arguments or pass "
+              "--contracts)", file=sys.stderr)
+        return 2
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
@@ -145,8 +224,13 @@ def main(argv: Sequence[str] | None = None) -> int:
                 print(f"note: stale baseline entry {rule} @ {path} "
                       f"({snippet[:60]!r}) — fixed code, prune it with "
                       f"--write-baseline")
+            engines = []
+            if run_lint:
+                engines.append(f"{len(rules)} jaxlint rule(s)")
+            if run_contract_engine:
+                engines.append("contracts")
             print(f"jaxlint: {len(new)} new finding(s), {matched} "
                   f"baselined, {len(stale)} stale baseline entr"
                   f"{'y' if len(stale) == 1 else 'ies'}, "
-                  f"{len(rules)} rule(s) active")
+                  f"{' + '.join(engines)} active")
     return 1 if new else 0

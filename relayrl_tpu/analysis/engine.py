@@ -34,6 +34,7 @@ __all__ = [
     "write_baseline",
     "apply_baseline",
     "qualname",
+    "statement_end_line",
 ]
 
 # Calls that wrap a python function into a traced/compiled one.
@@ -267,13 +268,17 @@ class ModuleInfo:
 
 # -- suppression ---------------------------------------------------------
 
-def _suppressed_rules(lines: Sequence[str], line: int) -> set[str]:
-    """Rule codes/slugs disabled for ``line`` (1-based): an end-of-line
-    ``# jaxlint: disable=...`` comment, or a COMMENT-ONLY preceding line
-    (a trailing disable on the previous code line covers that line only —
-    it must not leak onto the next one). Only the first word of each
-    comma-separated token counts, so a trailing reason
-    (``disable=IMP01 - entry script``) doesn't defeat the suppression."""
+def _suppressed_rules(lines: Sequence[str], line: int,
+                      end_line: int | None = None) -> set[str]:
+    """Rule codes/slugs disabled for the statement starting at ``line``
+    (1-based): an end-of-line ``# jaxlint: disable=...`` comment on any
+    line of the statement's span (``line``..``end_line`` — a wrapped
+    call may carry the disable on its closing-paren line), or a
+    COMMENT-ONLY preceding line (a trailing disable on the previous code
+    line covers that line only — it must not leak onto the next one).
+    Only the first word of each comma-separated token counts, so a
+    trailing reason (``disable=IMP01 - entry script``) doesn't defeat
+    the suppression."""
 
     def collect(text: str) -> None:
         m = _SUPPRESS_RE.search(text)
@@ -284,16 +289,35 @@ def _suppressed_rules(lines: Sequence[str], line: int) -> set[str]:
                     out.add(words[0].lower())
 
     out: set[str] = set()
-    if 1 <= line <= len(lines):
-        collect(lines[line - 1])
+    last = max(line, end_line or line)
+    for n in range(line, last + 1):
+        if 1 <= n <= len(lines):
+            collect(lines[n - 1])
     prev = line - 2
     if 0 <= prev < len(lines) and lines[prev].lstrip().startswith("#"):
         collect(lines[prev])
     return out
 
 
-def _is_suppressed(finding: Finding, lines: Sequence[str]) -> bool:
-    disabled = _suppressed_rules(lines, finding.line)
+def statement_end_line(node: ast.AST) -> int:
+    """Last line of the LOGICAL statement a finding anchors to: the full
+    node span for simple statements (a wrapped call's continuation lines
+    belong to it), but only the header for compound statements — a
+    disable inside a ``with``/``except`` BODY must not suppress a
+    finding on the header."""
+    line = getattr(node, "lineno", 1)
+    end = getattr(node, "end_lineno", None) or line
+    body = getattr(node, "body", None)
+    if isinstance(body, list) and body:
+        first = getattr(body[0], "lineno", None)
+        if first is not None:
+            end = max(line, first - 1)
+    return end
+
+
+def _is_suppressed(finding: Finding, lines: Sequence[str],
+                   end_line: int | None = None) -> bool:
+    disabled = _suppressed_rules(lines, finding.line, end_line)
     return bool(disabled & {"all", finding.rule.lower(),
                             finding.name.lower()})
 
@@ -323,7 +347,8 @@ def analyze_source(source: str, path: str = "<string>",
     for rule in rules:
         for node, message in rule.check(module):
             f = rule.finding(module, node, message)
-            if not _is_suppressed(f, module.lines):
+            if not _is_suppressed(f, module.lines,
+                                  statement_end_line(node)):
                 findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -6,6 +6,7 @@ Rules are grouped by the layer they police:
   (the throughput cliffs Podracer-class TPU RL stacks die on).
 * :mod:`concurrency_rules` — runtime/transport thread hazards.
 * :mod:`import_rules` — import-time side effects.
+* :mod:`telemetry_rules` — metric-recording hazards (clock choice).
 
 Adding a rule: subclass :class:`relayrl_tpu.analysis.engine.Rule` in the
 right module, give it a unique ``code`` + ``name``, yield
@@ -20,13 +21,14 @@ from relayrl_tpu.analysis.engine import Rule
 from relayrl_tpu.analysis.rules.concurrency_rules import RULES as _CONC
 from relayrl_tpu.analysis.rules.import_rules import RULES as _IMP
 from relayrl_tpu.analysis.rules.jax_rules import RULES as _JAX
+from relayrl_tpu.analysis.rules.telemetry_rules import RULES as _TEL
 
 __all__ = ["all_rules", "rules_by_code"]
 
 
 def all_rules() -> list[Rule]:
     """Fresh instances of every registered rule, stable order."""
-    return [cls() for cls in (*_JAX, *_CONC, *_IMP)]
+    return [cls() for cls in (*_JAX, *_CONC, *_IMP, *_TEL)]
 
 
 def rules_by_code() -> dict[str, Rule]:

@@ -562,7 +562,6 @@ DEFAULT_CONFIG: dict = {
         "global_step_tag": "Epoch",
     },
     "learner": {
-        "batch_trajectories": 8,
         "bucket_lengths": [64, 256, 1000],
         # Frozen-layer optimizer mask (the RLHF fine-tune recipe,
         # algorithms/freeze.py): a regex — or list of regexes — matched

@@ -36,7 +36,7 @@ Stage map — every stage rides machinery that already exists:
 Telemetry: ``relayrl_rlhf_generated_tokens_total``,
 ``relayrl_rlhf_scored_episodes_total``,
 ``relayrl_rlhf_stage_seconds{stage=generate|score|emit}``, and
-``relayrl_rlhf_version_lag`` (behavior-vs-actor-held version distance
+``relayrl_rlhf_lag_versions`` (behavior-vs-actor-held version distance
 observed at emission). docs/observability.md has the catalog;
 docs/operations.md the runbook.
 """
@@ -131,7 +131,7 @@ class ScoreStage:
             "wall seconds per stage dispatch on the RLHF dataflow",
             labels={"stage": "emit"})
         self._m_lag = reg.histogram(
-            "relayrl_rlhf_version_lag",
+            "relayrl_rlhf_lag_versions",
             "behavior version vs actor-held version at emission "
             "(tokens sampled N publishes behind the model they train)",
             buckets=LAG_BUCKETS)

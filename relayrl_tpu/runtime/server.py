@@ -173,7 +173,7 @@ class TrainingServer:
         from relayrl_tpu.rlhf.scheduler import LAG_BUCKETS
 
         self._m_rlhf_train_lag = reg.histogram(
-            "relayrl_rlhf_train_version_lag",
+            "relayrl_rlhf_train_lag_versions",
             "behavior version (data['bver'], stamped at generation) vs "
             "the learner's dispatched version when the trajectory "
             "trains — the off-policy distance V-trace corrects; "

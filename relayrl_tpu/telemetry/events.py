@@ -62,8 +62,21 @@ EVENT_TYPES = (
     "fleet_evict",       # a proc went silent past telemetry.fleet_stale_s
                          # and left the fleet table
     "telemetry_exporter",  # a process started its /metrics exporter
-                           # (carries proc + url — the discoverable
+                           # (carries url + pid — the discoverable
                            # record of per-process ephemeral ports)
+    # -- guardrails plane (guardrails/) --
+    "watchdog_trip",     # a watchdog predicate fired (carries rule +
+                         # observed value); the halt/rollback driver
+    "guardrails_halt",   # training halted by the guardrail engine
+    "rollback",          # server restored a prior checkpoint/version
+    "publish_blocked",   # a model publish withheld by a guardrail
+    "agent_quarantined",  # agent isolated from ingest (bad traffic)
+    "agent_paroled",     # quarantined agent readmitted after probation
+    # -- server/relay control plane --
+    "resync_keyframe_forced",  # server forced a keyframe publish because
+                               # resyncs exceeded transport.resync_* caps
+    "relay_up",          # relay node established its upstream session
+    "relay_reconnect",   # relay upstream rebuilt after a drop
 )
 
 

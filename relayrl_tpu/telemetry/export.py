@@ -21,6 +21,7 @@ the learner/actor threads never do.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -180,6 +181,13 @@ class TelemetryExporter:
             target=self._httpd.serve_forever, name="telemetry-exporter",
             daemon=True)
         self._thread.start()
+        # The journal is the discoverable record of ephemeral ports: a
+        # fleet operator greps `telemetry_exporter` events instead of
+        # scraping stdout for per-process bind lines.
+        from relayrl_tpu import telemetry
+
+        telemetry.emit("telemetry_exporter", url=self.url,
+                       pid=os.getpid())
 
     @property
     def url(self) -> str:

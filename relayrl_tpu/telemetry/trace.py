@@ -196,7 +196,7 @@ class Tracer:
             "data age in model versions: consuming update's dispatched "
             "version minus the version the trajectory was generated "
             "under (the trace-context twin of "
-            "relayrl_rlhf_train_version_lag)",
+            "relayrl_rlhf_train_lag_versions)",
             buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0))
 
     # -- sampling --

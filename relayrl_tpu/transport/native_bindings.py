@@ -419,6 +419,7 @@ class NativeAgentTransportImpl(AgentTransport):
         # start_model_listener); <= 0 disables the beat entirely.
         self._heartbeat_default = float(heartbeat_s)
         self._heartbeat_s = 0.0
+        self._hb_state = self._HB_ALIVE
         self._listener: threading.Thread | None = None
         self._stop = threading.Event()
         self._m = agent_wire_metrics("native")
@@ -606,10 +607,20 @@ class NativeAgentTransportImpl(AgentTransport):
                     # after redial.
                     if rc == 1:
                         self._notify_reconnect()
-                    self._m_liveness.set(
-                        self._HB_ALIVE if rc in (0, 1)
-                        else self._HB_SLOW if rc == 2
-                        else self._HB_DEAD)
+                    state = (self._HB_ALIVE if rc in (0, 1)
+                             else self._HB_SLOW if rc == 2
+                             else self._HB_DEAD)
+                    self._m_liveness.set(state)
+                    # Journal the TRANSITION only (the gauge carries the
+                    # level; one event per ping would swamp the journal).
+                    if state != self._hb_state:
+                        from relayrl_tpu import telemetry
+
+                        telemetry.emit(
+                            "heartbeat",
+                            state=("alive", "slow", "dead")[state],
+                            prev=("alive", "slow", "dead")[self._hb_state])
+                        self._hb_state = state
             if n < 0:
                 continue
             if n > cap:

@@ -1,0 +1,447 @@
+"""Contracts engine (relayrl_tpu.analysis.contracts) — pass units over
+synthetic fixtures, suppression/baseline mechanics shared with jaxlint,
+inventory determinism, and the repo-wide drift gate.
+
+Layout mirrors docs/static_analysis.md's contracts catalog: the graph
+passes (LOCK/THR) are proven on seeded fixture packages, the wire pass
+on a mutated copy of the real native sources, and the gate tests at the
+bottom pin the committed ``contracts.json`` to a fresh extraction.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from relayrl_tpu.analysis import (
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from relayrl_tpu.analysis.contracts import (
+    CONTRACT_RULES,
+    ContractContext,
+    run_contracts,
+    serialize_inventory,
+)
+from relayrl_tpu.analysis.contracts import (
+    concurrency_pass,
+    markers_pass,
+    telemetry_pass,
+    wire_pass,
+)
+from relayrl_tpu.analysis.contracts.inventory import DEFAULT_INVENTORY
+
+pytestmark = pytest.mark.contracts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+def fixture_ctx(tmp_path, sources: dict[str, str], **roots):
+    """A ContractContext over a synthetic package written to tmp_path.
+    tmp_path has no repo markers above it, so the cross-artifact halves
+    (docs/native/tests) stay off unless a root is passed explicitly."""
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    for rel, src in sources.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return ContractContext(package_root=str(pkg), **roots)
+
+
+class TestRegistry:
+    def test_contract_codes_unique_and_described(self):
+        codes = [code for code, _n, _d in CONTRACT_RULES]
+        assert len(codes) == len(set(codes))
+        for code, name, desc in CONTRACT_RULES:
+            assert code and name and desc, code
+
+    def test_all_emitted_codes_are_in_the_catalog(self):
+        # every pass module only emits codes the catalog declares
+        import relayrl_tpu.analysis.contracts as c
+
+        catalog = {code for code, _n, _d in CONTRACT_RULES}
+        for mod in (c.telemetry_pass, c.config_pass, c.wire_pass,
+                    c.concurrency_pass, c.markers_pass):
+            import inspect
+            import re
+
+            src = inspect.getsource(mod)
+            for code in re.findall(
+                    r'"((?:MET|EVT|CFG|WIRE|LOCK|THR|PYT|CON)\d\d)"', src):
+                assert code in catalog, (mod.__name__, code)
+
+
+class TestLockOrderCycle:
+    def test_positive_ab_ba_cycle_reports_both_sites(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"ab.py": """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def forward():
+                with _a:
+                    with _b:
+                        pass
+
+            def backward():
+                with _b:
+                    with _a:
+                        pass
+        """})
+        findings, inventory = concurrency_pass.run(ctx)
+        lock01 = [f for f in findings if f.rule == "LOCK01"]
+        assert len(lock01) == 1
+        msg = lock01[0].message
+        # both acquisition sites must be named: the inner `with` of
+        # forward() and of backward()
+        assert "fixpkg/ab.py:9" in msg and "fixpkg/ab.py:14" in msg
+        assert "fixpkg.ab._a" in msg and "fixpkg.ab._b" in msg
+        assert set(inventory["lock_edges"]) == {
+            "fixpkg.ab._a -> fixpkg.ab._b",
+            "fixpkg.ab._b -> fixpkg.ab._a"}
+
+    def test_positive_cycle_through_a_callee(self, tmp_path):
+        # A→B direct, B→A only via a call made under _b: the cycle only
+        # exists interprocedurally
+        ctx = fixture_ctx(tmp_path, {"mods.py": """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def take_a():
+                with _a:
+                    pass
+
+            def forward():
+                with _a:
+                    with _b:
+                        pass
+
+            def backward():
+                with _b:
+                    take_a()
+        """})
+        findings, _ = concurrency_pass.run(ctx)
+        lock01 = [f for f in findings if f.rule == "LOCK01"]
+        assert len(lock01) == 1
+        assert "via fixpkg.mods.take_a()" in lock01[0].message
+
+    def test_negative_consistent_order(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"ok.py": """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def one():
+                with _a:
+                    with _b:
+                        pass
+
+            def two():
+                with _a:
+                    with _b:
+                        pass
+        """})
+        findings, inventory = concurrency_pass.run(ctx)
+        assert [f for f in findings if f.rule == "LOCK01"] == []
+        assert inventory["lock_edges"] == [
+            "fixpkg.ok._a -> fixpkg.ok._b"]
+
+
+class TestBlockingUnderLockTransitive:
+    def test_positive_sleep_reached_through_callee(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"svc.py": """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def settle():
+                time.sleep(0.5)
+
+            def update():
+                with _lock:
+                    settle()
+        """})
+        findings, _ = concurrency_pass.run(ctx)
+        lock02 = [f for f in findings if f.rule == "LOCK02"]
+        assert len(lock02) == 1
+        msg = lock02[0].message
+        assert "settle()" in msg and "time.sleep" in msg
+        assert "fixpkg.svc._lock" in msg
+
+    def test_negative_callee_does_not_block(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"svc.py": """
+            import threading
+
+            _lock = threading.Lock()
+
+            def compute():
+                return 2 + 2
+
+            def update():
+                with _lock:
+                    compute()
+        """})
+        findings, _ = concurrency_pass.run(ctx)
+        assert [f for f in findings if f.rule == "LOCK02"] == []
+
+
+class TestThreadNeverJoined:
+    def test_positive_never_joined_nor_daemonized(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"w.py": """
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+        """})
+        findings, _ = concurrency_pass.run(ctx)
+        thr = [f for f in findings if f.rule == "THR01"]
+        assert len(thr) == 1
+        assert "self._t" in thr[0].message
+
+    def test_negative_joined_on_shutdown(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"w.py": """
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def stop(self):
+                    self._t.join()
+
+                def _run(self):
+                    pass
+        """})
+        findings, _ = concurrency_pass.run(ctx)
+        assert [f for f in findings if f.rule == "THR01"] == []
+
+    def test_negative_daemon_kwarg(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"w.py": """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                return t
+        """})
+        findings, _ = concurrency_pass.run(ctx)
+        assert [f for f in findings if f.rule == "THR01"] == []
+
+
+class TestWireParity:
+    def test_real_native_tree_is_clean(self):
+        ctx = ContractContext()
+        findings, inventory = wire_pass.run(ctx)
+        assert findings == []
+        # the extraction actually read the native sources (not a
+        # silently-degraded wheel run)
+        assert inventory["native"].get("kBlobMagic") == 0x31444C52
+
+    def test_mutated_magic_byte_fails_the_check(self, tmp_path):
+        native = tmp_path / "native"
+        native.mkdir()
+        for name in wire_pass.NATIVE_SOURCES:
+            src = os.path.join(NATIVE, name)
+            if os.path.exists(src):
+                shutil.copy(src, native / name)
+        codec = native / "codec.cc"
+        text = codec.read_text()
+        # flip the low byte of the blob magic at its DEFINITION (the
+        # same literal also appears in a layout comment — leave that)
+        assert "kBlobMagic = 0x31444C52" in text
+        codec.write_text(text.replace("kBlobMagic = 0x31444C52",
+                                      "kBlobMagic = 0x31444C53"))
+        ctx = ContractContext(native_root=str(native))
+        findings, _ = wire_pass.run(ctx)
+        wire01 = [f for f in findings if f.rule == "WIRE01"]
+        assert any("blob magic" in f.message for f in wire01)
+
+    def test_deleted_symbol_is_wire02_not_silence(self, tmp_path):
+        native = tmp_path / "native"
+        native.mkdir()
+        for name in wire_pass.NATIVE_SOURCES:
+            src = os.path.join(NATIVE, name)
+            if os.path.exists(src):
+                shutil.copy(src, native / name)
+        codec = native / "codec.cc"
+        codec.write_text(codec.read_text().replace("kBlobMagic",
+                                                   "kRenamedMagic"))
+        ctx = ContractContext(native_root=str(native))
+        findings, _ = wire_pass.run(ctx)
+        assert any(f.rule == "WIRE02" and "kBlobMagic" in f.message
+                   for f in findings)
+
+
+class TestMarkers:
+    def test_positive_both_directions(self, tmp_path):
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(textwrap.dedent("""
+            import pytest
+
+            pytestmark = pytest.mark.widget
+
+            @pytest.mark.parametrize("n", [1])
+            def test_one(n):
+                pass
+        """))
+        ini = tmp_path / "pytest.ini"
+        ini.write_text("[pytest]\nmarkers =\n"
+                       "    gadget: registered but unused\n")
+        ctx = fixture_ctx(tmp_path, {}, tests_root=str(tests),
+                          pytest_ini=str(ini))
+        findings, inventory = markers_pass.run(ctx)
+        assert any(f.rule == "PYT01" and "widget" in f.message
+                   for f in findings)
+        assert any(f.rule == "PYT02" and "gadget" in f.message
+                   for f in findings)
+        # builtin markers never flag
+        assert not any("parametrize" in f.message for f in findings)
+        assert inventory == {"registered": ["gadget"],
+                             "used": ["parametrize", "widget"]}
+
+    def test_negative_registered_and_used(self, tmp_path):
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(
+            "import pytest\npytestmark = pytest.mark.widget\n")
+        ini = tmp_path / "pytest.ini"
+        ini.write_text("[pytest]\nmarkers =\n    widget: a plane\n")
+        ctx = fixture_ctx(tmp_path, {}, tests_root=str(tests),
+                          pytest_ini=str(ini))
+        findings, _ = markers_pass.run(ctx)
+        assert findings == []
+
+
+class TestSuppression:
+    """Contract findings honor the jaxlint comment, including on a
+    continuation line *inside* a multi-line statement's span."""
+
+    def test_multiline_statement_inner_comment_suppresses(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"m.py": """
+            from relayrl_tpu import telemetry
+
+            _C = telemetry.counter(
+                "oops_total",
+                # jaxlint: disable=MET01 - fixture keeps the legacy name
+                "help text")
+        """})
+        findings, _ = telemetry_pass.run(ctx)
+        assert [f for f in findings if f.rule == "MET01"] == []
+
+    def test_unsuppressed_twin_fires(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"m.py": """
+            from relayrl_tpu import telemetry
+
+            _C = telemetry.counter(
+                "oops_total",
+                "help text")
+        """})
+        findings, _ = telemetry_pass.run(ctx)
+        assert any(f.rule == "MET01" for f in findings)
+
+    def test_comment_after_statement_does_not_suppress(self, tmp_path):
+        ctx = fixture_ctx(tmp_path, {"m.py": """
+            from relayrl_tpu import telemetry
+
+            _C = telemetry.counter(
+                "oops_total",
+                "help text")
+            # jaxlint: disable=MET01 - too late, outside the span
+        """})
+        findings, _ = telemetry_pass.run(ctx)
+        assert any(f.rule == "MET01" for f in findings)
+
+
+class TestBaselineRoundTrip:
+    def test_mixed_jaxlint_and_contract_findings(self, tmp_path):
+        jax_findings = analyze_source(
+            "import jax\nD = jax.devices()\n", "m.py")
+        assert jax_findings
+        ctx = fixture_ctx(tmp_path, {"w.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+        """})
+        contract_findings, _ = concurrency_pass.run(ctx)
+        assert contract_findings
+        both = jax_findings + contract_findings
+
+        bl = tmp_path / "baseline.json"
+        write_baseline(bl, both)
+        new, matched, stale = apply_baseline(both, load_baseline(bl))
+        assert (new, matched, stale) == ([], len(both), [])
+
+        # fix the contract finding -> its key goes stale, the jaxlint
+        # entry still matches, nothing is new
+        new, matched, stale = apply_baseline(jax_findings,
+                                             load_baseline(bl))
+        assert new == [] and matched == len(jax_findings)
+        assert [key[0] for key in stale] == ["THR01"]
+
+
+class TestInventory:
+    def test_two_extractions_are_byte_identical(self):
+        doc_a = run_contracts(ContractContext(),
+                              check_inventory=False)[1]
+        doc_b = run_contracts(ContractContext(),
+                              check_inventory=False)[1]
+        assert serialize_inventory(doc_a) == serialize_inventory(doc_b)
+
+    def test_committed_inventory_matches_fresh_extraction(self):
+        """The CON01 gate in test form: regenerate with
+        ``python -m relayrl_tpu.analysis --contracts --write-inventory``
+        whenever a contract legitimately changes."""
+        _, doc = run_contracts(ContractContext(), check_inventory=False)
+        with open(DEFAULT_INVENTORY, "r", encoding="utf-8") as f:
+            committed = f.read()
+        assert committed == serialize_inventory(doc)
+
+
+class TestRepoGate:
+    """The CI hooks: the live tree must carry zero non-baselined
+    contract findings, via the API and via the CLI entrypoint."""
+
+    def test_full_repo_contracts_run_is_clean(self):
+        findings, _ = run_contracts(ContractContext())
+        new, _matched, _stale = apply_baseline(
+            findings, load_baseline(os.path.join(
+                REPO, "relayrl_tpu", "analysis", "baseline.json")))
+        assert new == [], "\n".join(
+            f"{f.path}:{f.line} {f.rule} {f.message}" for f in new)
+
+    def test_default_cli_run_includes_contracts_and_passes(self):
+        from relayrl_tpu.analysis import main
+
+        assert main([]) == 0
+
+    def test_explicit_paths_stay_jaxlint_only(self, capsys):
+        from relayrl_tpu.analysis import main
+
+        assert main([os.path.join(REPO, "scripts")]) == 0
+        cap = capsys.readouterr()
+        assert "contracts" not in cap.out + cap.err

@@ -398,6 +398,45 @@ class TestBlockingUnderLock:
         """) == []
 
 
+class TestWallClockLatency:
+    def test_positive_inline_interval(self):
+        assert "TEL01" in codes("""
+            import time
+            def f(hist, work):
+                t0 = time.time()
+                work()
+                hist.observe(time.time() - t0)
+        """)
+
+    def test_positive_named_interval_through_set(self):
+        assert "TEL01" in codes("""
+            import time
+            def f(gauge, work):
+                start = time.time()
+                work()
+                elapsed = time.time() - start
+                gauge.set(elapsed)
+        """)
+
+    def test_negative_monotonic_interval(self):
+        assert codes("""
+            import time
+            def f(hist, work):
+                t0 = time.monotonic()
+                work()
+                hist.observe(time.monotonic() - t0)
+        """) == []
+
+    def test_negative_wall_timestamp_not_interval(self):
+        # recording the wall clock itself is the cross-host-timestamp
+        # use case the convention keeps time.time() for
+        assert codes("""
+            import time
+            def f(gauge):
+                gauge.set(time.time())
+        """) == []
+
+
 class TestBareExcept:
     def test_positive(self):
         assert "CONC02" in codes("""

@@ -590,7 +590,7 @@ class TestLivePlane:
             for metric in ("relayrl_rlhf_generated_tokens_total",
                            "relayrl_rlhf_scored_episodes_total",
                            "relayrl_rlhf_stage_seconds",
-                           "relayrl_rlhf_version_lag"):
+                           "relayrl_rlhf_lag_versions"):
                 assert metric in names, metric
         finally:
             if sched is not None:

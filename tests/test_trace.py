@@ -611,7 +611,7 @@ def test_live_zmq_end_to_end_trace(tmp_path, capsys):
     assert report["models"]["model_age_s"]["count"] > 0
     snap = telemetry.get_registry().snapshot()
     lag_hist = next(m for m in snap["metrics"]
-                    if m["name"] == "relayrl_rlhf_train_version_lag")
+                    if m["name"] == "relayrl_rlhf_train_lag_versions")
     assert lag_hist["count"] >= len(complete)
     hist_mean = lag_hist["sum"] / lag_hist["count"]
     trace_mean = report["trajectories"]["data_age_versions"]["mean"]
