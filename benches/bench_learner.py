@@ -228,6 +228,77 @@ def bench_pipeline():
              blocked / epochs * 1e3, "blocked_ms/epoch")
 
 
+def bench_pipeline_sharded():
+    """The same blocked-time split on a SHARDED learner: REINFORCE after
+    ``enable_multihost`` over a dp mesh (single-process — the collectives
+    compile into the update either way), sync chain vs the pipelined
+    multichip dispatch the broadcast loop now runs (mesh-aware
+    ``stage_batch`` prefetch, in-flight window, collective
+    ``snapshot_for_publish`` gather into the publisher thread). The dp
+    extent adapts to the bench host (gcd of device count and
+    traj_per_epoch; 1 device still exercises the sharded code path).
+    tests/test_multichip_pipeline.py proves the two modes bit-identical;
+    this row records what the overlap buys the learner thread."""
+    import math
+    import tempfile
+    import time
+
+    from relayrl_tpu.algorithms import build_algorithm
+    from relayrl_tpu.parallel import make_mesh
+    from relayrl_tpu.runtime.pipeline import ModelPublisher
+
+    obs_dim, act_dim, tpe = 16, 4, 8
+    epochs = 8 if quick() else 24
+    dp = math.gcd(len(jax.devices()), tpe)
+    mesh = make_mesh({"dp": dp}, jax.devices()[:dp])
+    episodes = [_pipeline_episode(48, obs_dim, act_dim, seed=s)
+                for s in range(epochs * tpe)]
+
+    def run(mode):
+        algo = build_algorithm(
+            "REINFORCE", obs_dim=obs_dim, act_dim=act_dim,
+            traj_per_epoch=tpe, hidden_sizes=[64, 64], seed_salt=0,
+            with_vf_baseline=True,
+            max_inflight_updates=0 if mode == "sync" else 2,
+            logger_kwargs={"output_dir": tempfile.mkdtemp()})
+        algo.enable_multihost(mesh)
+        algo.warmup()  # single-process: the collective-warmup guard passes
+        publisher = None
+        if mode == "pipelined":
+            publisher = ModelPublisher(lambda s: s.to_bundle().to_bytes())
+        publish_wait = 0.0
+        t_loop = time.monotonic()
+        for ep in episodes:
+            batch = algo.accumulate(ep)
+            if batch is None:
+                continue
+            if mode == "pipelined":
+                algo.train_on_batch(algo.stage_batch(batch))
+                publisher.submit(algo.snapshot_for_publish())
+            else:
+                algo.train_on_batch(batch)  # window 0: fenced at dispatch
+                t0 = time.monotonic()
+                algo.bundle().to_bytes()    # inline gather + serialize
+                publish_wait += time.monotonic() - t0
+        loop_s = time.monotonic() - t_loop
+        algo.inflight.drain()
+        if publisher is not None:
+            publisher.drain(timeout=60)
+            publisher.stop()
+        blocked = algo.inflight.device_wait_s + publish_wait
+        return blocked, loop_s
+
+    for mode in ("sync", "pipelined"):
+        blocked, loop_s = run(mode)
+        emit("learner_pipeline",
+             {"algorithm": "REINFORCE", "mode": f"sharded_{mode}",
+              "mesh": {"dp": dp}, "epochs": epochs, "traj_per_epoch": tpe,
+              "obs_dim": obs_dim, "act_dim": act_dim,
+              "hidden_sizes": [64, 64],
+              "learner_thread_s_per_epoch": round(loop_s / epochs, 6)},
+             blocked / epochs * 1e3, "blocked_ms/epoch")
+
+
 def main():
     from relayrl_tpu.algorithms.reinforce import (
         ReinforceState, make_optimizers, make_reinforce_update)
@@ -338,6 +409,9 @@ def main():
     # Pipelined vs synchronous learner-thread blocked time (the ISSUE-2
     # acceptance metric): same math, different overlap.
     bench_pipeline()
+    # ...and the same split on the sharded (multichip broadcast-loop)
+    # learner: the dispatch window + publish gather over a dp mesh.
+    bench_pipeline_sharded()
 
     # -- flagship non-MLP families: transformer-flash and CNN-pixel, both
     #    through the IMPALA update (the async-fleet north star for big

@@ -299,17 +299,31 @@ class AlgorithmBase(abc.ABC):
         after the last queued update, so it observes it) stamped with
         the host-side version mirror. The publisher thread turns it into
         a :class:`~relayrl_tpu.types.ModelBundle` with the blocking
-        ``device_get`` off the learner thread. Single-host only:
-        multi-host publish is a collective ``bundle()`` on every rank.
+        ``device_get`` off the learner thread.
+
+        On a mesh (``enable_multihost``) the copy is the jitted
+        re-shard-to-replicated ``_gather_params`` — still a non-blocking
+        dispatch, but on a multi-process mesh it is a COLLECTIVE: every
+        rank must call this at the same point (the server's broadcast
+        loop does); the coordinator's publisher thread then reads one
+        local shard of the replicated result (``host_params`` handles
+        the non-fully-addressable read).
         """
         import jax
         import jax.numpy as jnp
 
         from relayrl_tpu.runtime.pipeline import PublishSnapshot
 
-        params = jax.tree_util.tree_map(
-            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
-            self._publish_params())
+        gather = getattr(self, "_gather_params", None)
+        if gather is not None:
+            # A fresh replicated buffer (jit never aliases output to a
+            # non-donated input), so the next update's donation cannot
+            # invalidate it — the same safety jnp.copy provides below.
+            params = gather(self._publish_params())
+        else:
+            params = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                self._publish_params())
         return PublishSnapshot(version=self.dispatched_version,
                                arch=self._publish_arch(), params=params)
 

@@ -432,10 +432,13 @@ class OffPolicyAlgorithm(AlgorithmBase):
         self._place = lambda b: place_batch(b, mesh)
         self._gather_params = jax.jit(lambda p: p,
                                       out_shardings=replicated(mesh))
-        # Collective steps fence every rank anyway; and _mh_ready may
-        # hold sampled batches unboundedly, so staging reuse is unsafe.
-        self.max_inflight_updates = 0
-        self._inflight = None  # rebuilt (sync) on next use
+        # _mh_ready may hold sampled batches unboundedly before the
+        # broadcast ships them, so sample-ring slot reuse is unsafe —
+        # fall back to fresh per-sample allocations. The in-flight
+        # window survives: the sharded update dispatches async (the
+        # collective lives inside the XLA program, not on the host),
+        # bounded by the same max_inflight_updates.
+        self._inflight = None  # rebuilt over the (unchanged) window bound
         self._sample_ring = None
 
     def log_epoch(self, stats=None, metrics=None) -> None:

@@ -257,11 +257,14 @@ class OnPolicyAlgorithm(AlgorithmBase):
         self._place = lambda b: place_batch(b, mesh)
         # The broadcast loop queues assembled batches (_mh_ready) for an
         # unbounded time before training them — staging-slab reuse would
-        # corrupt them — and its step is a collective that fences every
-        # rank anyway, so async dispatch buys nothing there.
+        # corrupt them — so host assembly keeps copying (staging off).
+        # The in-flight window itself survives: the sharded update is a
+        # non-blocking dispatch exactly like the single-host one (the
+        # collective lives inside the XLA program, not on the host), so
+        # the broadcast loop overlaps ingest/broadcast/prefetch with the
+        # in-flight updates under the same max_inflight_updates bound.
         self.buffer.disable_staging()
-        self.max_inflight_updates = 0
-        self._inflight = None  # rebuilt (sync) on next use
+        self._inflight = None  # rebuilt over the (unchanged) window bound
         # One jitted params gather, reused by every bundle() call (a fresh
         # lambda per call would retrace + recompile the all-gather each
         # publish).

@@ -26,6 +26,7 @@ from relayrl_tpu.parallel.learner import (
     place_batch,
     place_state,
 )
+from relayrl_tpu.parallel.compat import shard_map, shard_map_impl_name
 from relayrl_tpu.parallel.context import current_mesh, use_mesh
 from relayrl_tpu.parallel.distributed import (
     broadcast_from_coordinator,
@@ -57,6 +58,8 @@ __all__ = [
     "make_sharded_update",
     "place_batch",
     "place_state",
+    "shard_map",
+    "shard_map_impl_name",
     "current_mesh",
     "use_mesh",
     "broadcast_from_coordinator",

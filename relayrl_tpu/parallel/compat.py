@@ -1,0 +1,98 @@
+"""JAX version-compat surface for ``shard_map``.
+
+``shard_map`` has lived at three addresses across JAX releases:
+
+- ``jax.experimental.shard_map.shard_map`` with a ``check_rep`` kwarg
+  (the 0.4.x series, including the 0.4.37 this repo pins);
+- ``jax.shard_map`` with ``check_rep`` (early 0.5/0.6 promotions);
+- ``jax.shard_map`` with the kwarg renamed ``check_vma`` (0.7+, where
+  ``check_rep`` is removed and the experimental module is a deprecation
+  shim that raises).
+
+Every call site in this repo goes through :func:`shard_map` below, which
+binds whichever surface the installed JAX exposes exactly once at import
+time and normalizes the kwarg: callers always say ``check_vma`` (the
+forward-looking name) and the resolver translates to ``check_rep`` when
+the installed surface wants the old spelling. If no surface resolves,
+:func:`shard_map` raises ONE pointed error naming the installed JAX
+version instead of letting 21 call sites fail with scattered
+AttributeErrors — keep it that way (see docs/testing.md).
+
+Direct ``jax.shard_map`` / ``jax.experimental.shard_map`` references
+outside this module are flagged by jaxlint rule JAX07.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["shard_map", "shard_map_impl_name"]
+
+
+def _resolve() -> tuple[Callable[..., Any], str, str]:
+    """Return ``(raw_fn, kwarg_name, surface_name)`` for the installed JAX.
+
+    ``hasattr(jax, "shard_map")`` is safe on every release: on versions
+    where the top-level name is a deprecation stub it raises
+    AttributeError (so hasattr is False) without side effects.
+    """
+    fn = getattr(jax, "shard_map", None)
+    surface = "jax.shard_map"
+    if fn is None:
+        try:
+            from jax.experimental.shard_map import shard_map as fn  # type: ignore
+        except Exception:
+            fn = None
+        surface = "jax.experimental.shard_map.shard_map"
+    if fn is None:
+        raise RuntimeError(
+            f"no shard_map surface found in the installed jax=={jax.__version__}: "
+            "neither jax.shard_map nor jax.experimental.shard_map.shard_map "
+            "resolves. The relayrl_tpu.parallel.compat resolver knows the "
+            "0.4.x experimental surface (check_rep) and the 0.7+ top-level "
+            "surface (check_vma); this JAX exposes neither, so the compat "
+            "layer needs a new binding — fix it HERE, not at the call sites.")
+    try:
+        params = inspect.signature(fn).parameters
+        kwarg = "check_vma" if "check_vma" in params else "check_rep"
+    except (TypeError, ValueError):  # C-level or wrapped beyond inspection
+        kwarg = "check_vma" if surface == "jax.shard_map" else "check_rep"
+    return fn, kwarg, surface
+
+
+_RAW, _KWARG, _SURFACE = None, None, None
+
+
+def _binding() -> tuple[Callable[..., Any], str, str]:
+    global _RAW, _KWARG, _SURFACE
+    if _RAW is None:
+        _RAW, _KWARG, _SURFACE = _resolve()
+    return _RAW, _KWARG, _SURFACE
+
+
+def shard_map_impl_name() -> str:
+    """The fully-qualified surface the resolver bound (for diagnostics)."""
+    return _binding()[2]
+
+
+def shard_map(f: Callable[..., Any] | None = None, *, mesh, in_specs,
+              out_specs, check_vma: bool = True, **kwargs):
+    """Version-portable ``shard_map``.
+
+    Same contract as ``jax.shard_map``: map ``f`` over ``mesh`` with
+    per-argument ``in_specs``/``out_specs``. Callers always pass
+    ``check_vma`` (never ``check_rep``); the resolver renames it for
+    surfaces that predate the rename. ``f=None`` returns a decorator,
+    matching the upstream partial-application convention.
+    """
+    raw, kwarg, _ = _binding()
+    if f is None:
+        return lambda g: shard_map(g, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=check_vma,
+                                   **kwargs)
+    kwargs[kwarg] = check_vma
+    return raw(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kwargs)

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from relayrl_tpu.parallel.compat import shard_map
 from relayrl_tpu.parallel.mesh import data_axes
 
 
@@ -75,8 +76,6 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
             f"layer stack of {leaves[0].shape[0]} layers is not divisible "
             f"by the pp mesh axis ({n_stages} stages); pick n_layers as a "
             f"multiple of pp (offending leaf shapes: {bad[:3]})")
-    shard_map = jax.shard_map
-
     daxes = data_axes(mesh)
     bspec = daxes if daxes else None
     data = math.prod(mesh.shape[ax] for ax in daxes) if daxes else 1

@@ -46,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from relayrl_tpu.parallel.compat import shard_map
 from relayrl_tpu.ops.flash import (
     _LOG2E,
     _NEG_INF,
@@ -346,7 +347,10 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool,
         fwd_call, _, _ = _calls(C, D, q.dtype)
         qs = _prescale_q(_bthd_to_bht(q))
         kb, vb = _bthd_to_bht(k), _bthd_to_bht(v)
-        idx = jax.lax.axis_index(axis_name)
+        # Non-causal mode schedules are position-independent; an unused
+        # axis_index would leave a dead partition_id op outside any manual
+        # sharding annotation, which the SPMD partitioner rejects.
+        idx = jax.lax.axis_index(axis_name) if causal else jnp.int32(0)
         bh = qs.shape[0]
         o = jnp.zeros((bh, C, D), jnp.float32)
         m = jnp.full((bh, C, 1), _NEG_INF, jnp.float32)
@@ -395,7 +399,7 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool,
         dor, of = _bthd_to_bht(do), _bthd_to_bht(out)
         delta = jnp.sum(dor.astype(jnp.float32) * of.astype(jnp.float32),
                         axis=-1, keepdims=True)
-        idx = jax.lax.axis_index(axis_name)
+        idx = jax.lax.axis_index(axis_name) if causal else jnp.int32(0)
         bh = qs.shape[0]
         dq_acc = jnp.zeros((bh, C, D), jnp.float32)
         dk_acc = jnp.zeros_like(dq_acc)
@@ -528,5 +532,5 @@ def make_ring_flash_attention(mesh: Mesh, axis_name: str = "sp",
     body = functools.partial(ring_flash_attention_sharded,
                              axis_name=axis_name, axis_size=axis_size,
                              causal=causal, block=block, interpret=interpret)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

@@ -27,11 +27,15 @@ split; the server and the algorithm families wire them together:
   live state buffers may be consumed by the very next update while the
   publisher is still reading the snapshot.
 
-Multi-host is deliberately untouched: its publish is a collective
-(``bundle()`` all-gathers on every rank) and its drain contract is the
-``_mh_busy`` flag — this module extends the same contract to the
-single-host loop (``drain()`` counts dispatched-but-unfenced updates and
-pending publishes).
+The multi-host broadcast loop rides the same three pieces: the sharded
+update is just as much a non-blocking dispatch as the single-host one
+(its collectives live inside the XLA program), so it enters the same
+:class:`InflightWindow`; the publish handoff swaps the ``jnp.copy`` for
+the algorithm's jitted re-shard-to-replicated gather (a collective every
+rank dispatches at the same point — coordinator-side, the publisher
+thread then reads one addressable shard of the replicated result); and
+``drain()`` counts the window + pending publishes on top of the
+``_mh_ready``/``_mh_busy`` broadcast-step flags.
 """
 
 from __future__ import annotations
@@ -175,10 +179,21 @@ class PublishSnapshot:
         """The blocking D2H gather — runs on the publisher thread, never
         the learner thread. The wire-v2 publish path consumes the host
         tree directly (the encoder keeps it as the next delta's base);
-        :meth:`to_bundle` wraps it for the v1 full-bundle path."""
-        import jax
+        :meth:`to_bundle` wraps it for the v1 full-bundle path.
 
-        return jax.device_get(self.params)
+        Multi-host snapshots carry the replicated output of the publish
+        gather, which is not fully addressable — ``device_get`` refuses
+        those, but every process holds a complete local copy, so one
+        addressable shard IS the global value."""
+        import jax
+        import numpy as np
+
+        def read(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return np.asarray(x.addressable_data(0))
+            return jax.device_get(x)
+
+        return jax.tree_util.tree_map(read, self.params)
 
     def to_bundle(self):
         from relayrl_tpu.types.model_bundle import ModelBundle

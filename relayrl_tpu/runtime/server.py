@@ -1269,53 +1269,104 @@ class TrainingServer:
             code = int(desc[0])
             if code == self._MH_STOP:
                 self._mh_busy = False  # a preempted batch is dropped
+                # Fence what was dispatched and flush its deferred logs
+                # (every rank drains its own window — the programs were
+                # dispatched symmetrically, so they all complete), then
+                # resolve the fenced probes before shutdown.
+                self._pipeline_quiesce()
+                if coord:
+                    self._guard_poll()
                 break
             if code == self._MH_IDLE:
+                # Idle is fence-for-free, as in the single-host loop: the
+                # device has nothing queued behind the in-flight sharded
+                # updates, so resolving them costs no overlap — and it is
+                # what lets drain() observe pending -> 0 on every rank.
+                self._pipeline_quiesce()
+                if coord:
+                    self._guard_poll()
                 continue
             if not coord:
                 batch = self.algorithm.mh_zero_batch(int(desc[1]),
                                                      int(desc[2]))
             self._mh_busy = True
             batch = broadcast_from_coordinator(batch)
+            algo = self.algorithm
+            t0 = time.monotonic()
             try:
-                self.algorithm.train_on_batch(batch)
+                if self._prefetch:
+                    # Eager sharded H2D (device_put with NamedSharding
+                    # via the mesh-aware _place): the transfer enqueues
+                    # now and overlaps the in-flight updates instead of
+                    # running inside the dispatch below.
+                    batch = algo.stage_batch(batch)
+                # Dispatch-only: the sharded update enters the in-flight
+                # window unfenced (its collectives live inside the XLA
+                # program, so nothing here blocks the host).
+                algo.train_on_batch(batch)
             except Exception as e:
                 print(f"[TrainingServer] multi-host update error: {e!r}",
                       flush=True)
                 self._mh_busy = False
                 continue  # symmetric on all ranks: same data, same failure
-            bundle = self.algorithm.bundle()  # collective all-gather
+            if (coord and self.guardrails is not None
+                    and self.guardrails.watchdog is not None
+                    and self.distributed_info["num_processes"] == 1):
+                # Health probes ride LazyMetrics through the window on
+                # every rank (they are jitted over the same sharded
+                # state). The watchdog DETECTOR stays single-process:
+                # its rollback path restores a checkpoint, which is a
+                # collective a coordinator-solo trip would hang on.
+                self.guardrails.watchdog.observe_dispatch(
+                    algo.inflight.dispatch_count, algo._last_metrics)
             if coord:
                 self.stats["updates"] += 1
                 self._m_updates.inc()
-                try:
-                    # On-policy: one update == one epoch. Off-policy: the
-                    # algorithm throttles to its traj_per_epoch cadence.
-                    self.algorithm.maybe_log_epoch()
-                except Exception as e:
-                    print(f"[TrainingServer] log error: {e!r}", flush=True)
-                try:
-                    import jax
+                # Epoch log: captured now (on-policy: one per update;
+                # off-policy: the trajectory cadence), dumped once the
+                # update it describes is fenced.
+                payload = algo.capture_epoch_stats(True)
+                if payload is not None:
+                    self._pending_logs.append(
+                        (algo.inflight.dispatch_count, payload,
+                         algo._last_metrics))
+            dispatch_dt = time.monotonic() - t0
+            self.timings["dispatch_s"] += dispatch_dt
+            self._m_dispatch.observe(dispatch_dt)
+            try:
+                if self._async_publish:
+                    # The publish gather (jitted re-shard to replicated)
+                    # is a collective DISPATCH on every rank — symmetric
+                    # by construction since async_publish comes from the
+                    # shared config; only the coordinator owns a
+                    # transport, so only it hands the snapshot to the
+                    # publisher thread (D2H + encode off this thread).
+                    snapshot = algo.snapshot_for_publish()
+                    if coord and self._publisher is not None:
+                        self._publisher.submit(snapshot)
+                    ckpt_version = algo.dispatched_version
+                else:
+                    bundle = algo.bundle()  # collective + fences (escape
+                    if coord:               # hatch: async_publish false)
+                        import jax
 
-                    # The collective bundle() all-gathered on every rank;
-                    # only the coordinator owns the actor plane, so only
-                    # it pays the host gather + wire encode.
-                    self._publish_params(bundle.version, bundle.arch,
-                                         jax.device_get(bundle.params))
-                except Exception as e:
-                    print(f"[TrainingServer] publish error: {e!r}", flush=True)
-                if self._tb is not None:
-                    try:
-                        self._tb.poll()
-                    except Exception as e:
-                        print(f"[TrainingServer] tensorboard error: {e!r}",
-                              flush=True)
+                        self._publish_params(bundle.version, bundle.arch,
+                                             jax.device_get(bundle.params))
+                    ckpt_version = bundle.version
+            except Exception as e:
+                print(f"[TrainingServer] publish error: {e!r}", flush=True)
+                ckpt_version = algo.dispatched_version
             # Full-state checkpoint is COLLECTIVE on a multi-host mesh
             # (orbax needs every process to contribute its shards to the
             # shared checkpoint_dir); the due-check derives from the
-            # replicated version and a counter that advances identically
-            # on every rank, so all agree without extra coordination.
-            self._maybe_periodic_checkpoint(bundle.version)
+            # host-side version mirror, which advances identically on
+            # every rank, so all agree without extra coordination — and
+            # the checkpoint path quiesces the window first, extending
+            # the quiesce contract to in-flight sharded updates.
+            self._maybe_periodic_checkpoint(ckpt_version)
+            if coord:
+                self._flush_ready_logs()
+                self._guard_poll()
             self._mh_busy = False
 
     # -- learner loop --
@@ -2168,7 +2219,12 @@ class TrainingServer:
                 t.start()
         if self.inference is not None:
             self.inference.start()
-        if (self.transport is not None and not multi_host
+        # The publisher thread exists wherever there is a transport to
+        # feed — including the multi-host coordinator (non-coordinators
+        # own no actor plane, so they dispatch the publish gather and
+        # drop the snapshot). async_publish=false is the sync escape
+        # hatch on both loops.
+        if (self.transport is not None
                 and self._async_publish and self._publisher is None):
             from relayrl_tpu.runtime.pipeline import ModelPublisher
 

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# NOTE: do NOT point JAX_COMPILATION_CACHE_DIR at a persistent cache
+# here. It looks like a free wall-clock win for the subprocess drills,
+# but on this jaxlib build the cache intermittently SIGABRTs/segfaults
+# the orbax async checkpoint saves (tests/test_checkpoint.py) —
+# reproduced twice under ISSUE 17 and reverted.
+
 # Plugins (jaxtyping) import jax before this conftest runs, and jax.config
 # snapshots JAX_PLATFORMS at import — update the live config too, which works
 # as long as no backend has been initialized yet.

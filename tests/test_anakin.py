@@ -245,6 +245,9 @@ class TestConfigKnobs:
 
 
 class TestNetworkedAnakinZmq:
+    # ISSUE 17 wall re-fit: live-zmq anakin e2e rides the slow tier; the
+    # fast tier keeps cross-process determinism + the unstacker contract.
+    @pytest.mark.slow
     def test_lanes_register_stream_and_hot_swap(self, tmp_cwd):
         """The networked anakin tier against a live zmq TrainingServer:
         N logical lanes register over one connection, every lane's
@@ -329,6 +332,7 @@ def _wait_status(scratch, proc, pred, timeout_s, what) -> dict:
     raise AssertionError(f"timed out waiting for {what}; last={status}")
 
 
+@pytest.mark.slow  # ISSUE 17 wall re-fit: SIGKILL mechanism covered fast by test_recovery's zmq drill
 def test_learner_sigkill_restart_with_anakin_actors_zero_loss(tmp_path,
                                                               tmp_cwd):
     """The acceptance drill: SIGKILL the learner mid-run while a fused

@@ -302,7 +302,10 @@ def _assert_trees_bit_identical(a, b):
 
 @pytest.mark.parametrize("algorithm,hp", [
     ("REINFORCE", {"with_vf_baseline": False}),
-    ("PPO", {"train_iters": 2, "minibatch_count": 2}),
+    # ISSUE 17 wall re-fit: the wire-form equivalence is algorithm-agnostic;
+    # REINFORCE stays fast, the PPO twin rides the slow tier.
+    pytest.param("PPO", {"train_iters": 2, "minibatch_count": 2},
+                 marks=pytest.mark.slow),
 ])
 def test_learner_params_bit_identical_across_wire_forms(
         algorithm, hp, stub_server_factory, tmp_cwd):
@@ -482,7 +485,14 @@ def _live_accounting(transport: str, columnar: bool, tmp_cwd,
         server.disable_server()
 
 
-@pytest.mark.parametrize("transport", ["zmq", "grpc", "native"])
+# ISSUE 17 wall re-fit: zmq fast, grpc/native twins slow (the accounting
+# path above the transport is shared; per-transport wire bytes are still
+# covered fast by the codec/fuzz suites).
+@pytest.mark.parametrize(
+    "transport",
+    ["zmq",
+     pytest.param("grpc", marks=pytest.mark.slow),
+     pytest.param("native", marks=pytest.mark.slow)])
 def test_live_accounting_parity_all_transports(transport, tmp_cwd):
     """Same seed, same windows, both wire forms over a LIVE transport:
     per-lane accepted-step accounting is identical, zero loss on both,

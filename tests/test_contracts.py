@@ -405,6 +405,9 @@ class TestBaselineRoundTrip:
 
 
 class TestInventory:
+    # ISSUE 17 wall re-fit: double full-repo extraction; still runs in
+    # scripts/check.sh stage 2 (no marker filter there).
+    @pytest.mark.slow
     def test_two_extractions_are_byte_identical(self):
         doc_a = run_contracts(ContractContext(),
                               check_inventory=False)[1]
@@ -434,6 +437,9 @@ class TestRepoGate:
         assert new == [], "\n".join(
             f"{f.path}:{f.line} {f.rule} {f.message}" for f in new)
 
+    # ISSUE 17 wall re-fit: subprocess full-CLI run; check.sh stage 1
+    # executes the same command directly on every invocation.
+    @pytest.mark.slow
     def test_default_cli_run_includes_contracts_and_passes(self):
         from relayrl_tpu.analysis import main
 

@@ -240,7 +240,11 @@ class TestEquivalence:
 
     @pytest.mark.parametrize("algo_name,hp", [
         ("REINFORCE", {"with_vf_baseline": True, "train_vf_iters": 3}),
-        ("PPO", {"train_iters": 2, "minibatch_count": 3}),
+        # ISSUE 17 wall re-fit: PPO twin slow — the fast tier keeps this
+        # REINFORCE lock plus the sharded-PPO pipelined-vs-sync lock in
+        # tests/test_multichip_pipeline.py.
+        pytest.param("PPO", {"train_iters": 2, "minibatch_count": 3},
+                     marks=pytest.mark.slow),
     ])
     def test_pipelined_server_matches_synchronous_params(
             self, stub_server_factory, tmp_cwd, algo_name, hp):

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from relayrl_tpu.models import build_policy
 from relayrl_tpu.parallel import make_mesh
@@ -99,6 +100,11 @@ class TestExpertParallel:
         assert param_pspec(gate_path, jnp.zeros((16, 4)), mesh) == \
             jax.sharding.PartitionSpec()
 
+    # ISSUE 17 wall re-fit: the heaviest compile in the fast wall (~30 s
+    # on the 1-core CI host); ep-mesh stepping stays covered fast by the
+    # MULTICHIP dryrun and the dp-mesh pipelined locks in
+    # tests/test_multichip_pipeline.py.
+    @pytest.mark.slow
     def test_sharded_update_on_ep_mesh(self):
         from relayrl_tpu.algorithms.reinforce import (
             ReinforceState,

@@ -143,3 +143,54 @@ def test_reinforce_state_places_on_mesh(tmp_cwd):
     # every leaf is addressable on all 8 devices
     leaves = jax.tree.leaves(placed)
     assert all(len(l.devices()) == 8 for l in leaves if hasattr(l, "devices"))
+
+
+class TestShardMapCompat:
+    """The shard_map surface regression net: every parallel/ module must
+    import against the installed JAX (the compat resolver is the one
+    place allowed to touch the moving raw API), and a shard_mapped
+    program must build and run on a trivial mesh — the exact failure
+    mode the pre-migration tree had (21 tests dead on
+    ``jax.shard_map`` AttributeError) can never come back silently."""
+
+    def test_every_parallel_module_imports(self):
+        import importlib
+        import pkgutil
+
+        import relayrl_tpu.parallel as pkg
+
+        names = [m.name for m in pkgutil.iter_modules(pkg.__path__)]
+        assert "compat" in names and "ring_flash" in names
+        for name in names:
+            importlib.import_module(f"relayrl_tpu.parallel.{name}")
+
+    def test_compat_reports_a_real_surface(self):
+        from relayrl_tpu.parallel.compat import shard_map_impl_name
+
+        assert shard_map_impl_name() in (
+            "jax.shard_map", "jax.experimental.shard_map.shard_map")
+
+    def test_shard_mapped_program_builds_on_single_device_mesh(self):
+        from relayrl_tpu.parallel.compat import shard_map
+        from relayrl_tpu.parallel.mesh import single_device_mesh
+
+        mesh = single_device_mesh()
+        prog = shard_map(lambda x: x * 2.0, mesh=mesh,
+                         in_specs=P(), out_specs=P(), check_vma=False)
+        out = jax.jit(prog)(jnp.arange(4, dtype=jnp.float32))
+        np.testing.assert_array_equal(np.asarray(out),
+                                      [0.0, 2.0, 4.0, 6.0])
+
+    def test_decorator_form(self):
+        from relayrl_tpu.parallel.compat import shard_map
+        from relayrl_tpu.parallel.mesh import single_device_mesh
+
+        mesh = single_device_mesh()
+
+        @shard_map(mesh=mesh, in_specs=P(), out_specs=P(),
+                   check_vma=False)
+        def double(x):
+            return x + x
+
+        np.testing.assert_array_equal(
+            np.asarray(double(jnp.ones(3))), [2.0, 2.0, 2.0])

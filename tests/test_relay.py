@@ -805,6 +805,7 @@ def test_relay_sigkill_drill_zmq(tmp_path, tmp_cwd, fresh_registry):
     _relay_sigkill_drill("zmq", tmp_path, tmp_cwd)
 
 
+@pytest.mark.slow  # ISSUE 17 wall re-fit: transport twin of the fast zmq drill
 def test_relay_sigkill_drill_grpc(tmp_path, tmp_cwd, fresh_registry):
     pytest.importorskip("grpc")
     _relay_sigkill_drill("grpc", tmp_path, tmp_cwd)

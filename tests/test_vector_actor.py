@@ -467,6 +467,9 @@ class TestSyncVectorEnv:
 
 
 class TestVectorSoakSmoke:
+    # ISSUE 17 wall re-fit: soak smokes live in the slow tier alongside
+    # the bench-scale soak (tests/test_soak.py keeps the fast quick shape).
+    @pytest.mark.slow
     def test_quick_vector_soak_one_traj_per_logical_agent(
             self, monkeypatch, tmp_path):
         """Tiny bench_soak --quick --vector shape: 4 logical agents in
