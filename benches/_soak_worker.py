@@ -574,6 +574,122 @@ def serving_client_loop(cfg: dict, agent_idx: int, out: dict,
     client.disable_agent()
 
 
+def serving_mux_loop(cfg: dict) -> list[dict]:
+    """Streamed thin-client mode (``"serving_mux": true``): ONE
+    MultiplexedRemoteClient drives ``agents_per_proc`` logical env lanes
+    over the pipelined serving channel — every lane's request is in
+    flight before any reply is awaited (up to ``serving.stream_window``
+    deep per replica connection), so the process pays one wave of
+    round-trips per fleet step instead of one lock-step round-trip per
+    lane. With ``serving_addrs`` the lanes route session-affine across
+    the replica endpoints. One result row per lane (schema mirrors
+    serving_client_loop's) so the coordinator stays topology-blind;
+    the round latency sample and the streaming-depth evidence
+    (``inflight_high_water``) ride the lane-0 row."""
+    import numpy as np
+
+    from relayrl_tpu.runtime.inference import MultiplexedRemoteClient
+
+    ident = f"soak-{cfg['worker_id']}"
+    addr_overrides = transport_addr_overrides(cfg)
+    if cfg.get("serving_addrs"):
+        addr_overrides["serving_addrs"] = cfg["serving_addrs"]
+    elif cfg.get("serving_addr"):
+        addr_overrides["serving_addr"] = cfg["serving_addr"]
+    lanes = cfg["agents_per_proc"]
+    client = MultiplexedRemoteClient(
+        config_path=cfg.get("config_path"),
+        server_type=cfg.get("server_type", "zmq"),
+        lanes=lanes,
+        seed=cfg["worker_id"] * 1000,
+        identity=ident,
+        handshake_timeout_s=cfg["handshake_timeout_s"],
+        **addr_overrides,
+    )
+    rng = np.random.default_rng(cfg["worker_id"])
+    obs_dim, ep_len = cfg["obs_dim"], cfg["episode_len"]
+    start_barrier_wait(cfg, ident, publish_ready=True)
+    timeline: dict[int, int] = {}
+    lats: list[float] = []  # per-WAVE round-trip seconds (all lanes)
+    steps = [0] * lanes
+    episodes = [0] * lanes
+    rewards = [0.0] * lanes
+    ep_t = 0
+    window_start_ns = time.monotonic_ns()
+    deadline = time.time() + cfg["duration_s"]
+    crashed = None
+    try:
+        while time.time() < deadline:
+            obs_batch = rng.standard_normal(
+                (lanes, obs_dim)).astype(np.float32)
+            t0 = time.monotonic()
+            client.request_for_actions(list(obs_batch), rewards=rewards)
+            lats.append(time.monotonic() - t0)
+            rewards = [1.0] * lanes
+            for i in range(lanes):
+                steps[i] += 1
+            bucket = int(time.time())
+            timeline[bucket] = timeline.get(bucket, 0) + lanes
+            ep_t += 1
+            if ep_t >= ep_len:
+                for i in range(lanes):
+                    client.flag_last_action(i, reward=1.0, terminated=True)
+                    episodes[i] += 1
+                rewards = [0.0] * lanes
+                ep_t = 0
+    except Exception as e:
+        crashed = repr(e)
+    window_end_ns = time.monotonic_ns()
+    lats.sort()
+    from common import percentile_sorted
+
+    def pct(q: float) -> float | None:
+        got = percentile_sorted(lats, q)
+        return None if got is None else round(1000 * got, 3)
+
+    stamp = time.monotonic_ns()
+    # The wave wall IS each lane's action latency under pipelining (all
+    # lanes' requests were concurrently in flight for the whole wave), so
+    # the summary repeats per row but the pooled sample rides lane 0 only
+    # — duplicating it per lane would overweight this process's rounds in
+    # the coordinator's fleet percentiles.
+    latency_ms = {"count": len(lats), "p50": pct(0.50), "p95": pct(0.95),
+                  "p99": pct(0.99),
+                  "max": round(1000 * lats[-1], 3) if lats else None}
+    sample = [round(1000 * lats[i], 3)
+              for i in sorted(set(
+                  list(range(0, len(lats), max(1, len(lats) // 256)))
+                  + ([len(lats) - 1] if lats else [])))]
+    rows = [{
+        "identity": (client._sids[i] if client._sids
+                     else f"{ident}#L{i:03d}"),
+        "steps": steps[i],
+        "episodes": episodes[i],
+        "final_version": client.model_version,
+        "receipts": [],
+        "sub_ts": stamp,  # zero-width window: no model subscription
+        "window_start_ns": window_start_ns,
+        "window_end_ns": window_end_ns,
+        "timeline": ({str(k): v for k, v in timeline.items()}
+                     if i == 0 else {}),
+        "unsub_ts": stamp,
+        "crashed": crashed,
+        "latency_ms": latency_ms,
+        "lat_sample_ms": sample if i == 0 else [],
+    } for i in range(lanes)]
+    rows[0]["mux"] = {
+        "lanes": lanes,
+        "inflight_high_water": client.inflight_high_water,
+        "replica_connections": len(client._clients),
+        "retries": client._m_retries.total(),
+        "overload_nacked": client._m_nacked.total(),
+        "session_resyncs": client._m_resyncs.total(),
+    }
+    chaos_finish(client, rows[0], cfg)
+    client.disable_agent()
+    return rows
+
+
 def main():
     import faulthandler
 
@@ -583,6 +699,12 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     chaos_setup(cfg)
     trace_setup(cfg)
+
+    if cfg.get("serving") and cfg.get("serving_mux"):
+        rows = serving_mux_loop(cfg)
+        with open(cfg["result_path"], "w") as f:
+            json.dump(worker_result(cfg, rows), f)
+        return
 
     if cfg.get("serving"):
         out: dict = {}

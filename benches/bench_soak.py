@@ -245,6 +245,9 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
              columnar_wire: bool | None = None,
              serving: bool = False, max_batch: int | None = None,
              batch_timeout_ms: float = 5.0, relays: int = 0,
+             serving_mux: bool = False, serving_replicas: int = 0,
+             sequence_policy: bool = False,
+             stream_window: int | None = None,
              emit_coalesce_frames: int | None = None,
              trace_rate: float = 1.0) -> dict:
     """``vector=True`` runs the fleet as vector actor hosts: each worker
@@ -286,30 +289,57 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
         if max_batch is None:
             max_batch = max(2, min(32, n_actors))
         config_path = os.path.join(scratch, "serving_config.json")
+        serving_cfg = {
+            "enabled": True, "max_batch": int(max_batch),
+            "batch_timeout_ms": float(batch_timeout_ms),
+            # steady-state rows must never cycle eviction/resync: the
+            # session table comfortably covers the whole logical fleet.
+            "max_sessions": int(max(4096, 2 * n_actors)),
+        }
+        if stream_window is not None:
+            serving_cfg["stream_window"] = int(stream_window)
         with open(config_path, "w") as f:
-            json.dump({"serving": {
-                "enabled": True, "max_batch": int(max_batch),
-                "batch_timeout_ms": float(batch_timeout_ms),
-            }}, f)
-        if transport != "grpc":
+            json.dump({"serving": serving_cfg}, f)
+        if serving_replicas:
+            # Horizontal serving (ISSUE 18): the root only trains and
+            # publishes; N StandaloneInferenceHost replica processes
+            # handshake the model off its agent plane and serve their
+            # own zmq ROUTER endpoints. The root's colocated service
+            # stays OFF (no serving_addr / config_path in its addrs).
+            if transport != "zmq":
+                raise ValueError("replica serving rows run on zmq")
+        elif transport != "grpc":
             # zmq fleets (and native passthrough) need the dedicated
             # ROUTER action plane; grpc rides the in-band GetActions.
             serving_addr = f"tcp://127.0.0.1:{free_port()}"
             addrs["serving_addr"] = serving_addr
             worker_addrs["serving_addr"] = serving_addr
+            addrs["config_path"] = config_path
         else:
             # In-band GetActions lives on the pure-grpcio server only
             # (the native C++ gRPC core does not speak the serving RPC).
             addrs["native_grpc"] = False
-        addrs["config_path"] = config_path
+            addrs["config_path"] = config_path
         worker_addrs["serving"] = True
         worker_addrs["config_path"] = config_path
+        if serving_mux:
+            worker_addrs["serving_mux"] = True
     # IMPALA is the async-fleet north star (BASELINE.md "256 IMPALA
     # actors"): staleness-corrected, so a big fleet on old versions is the
     # intended regime, not an edge case.
     hp = {"traj_per_epoch": traj_per_epoch, "hidden_sizes": [32, 32]}
     if algorithm == "REINFORCE":
         hp.update(with_vf_baseline=True, train_vf_iters=5)
+    if sequence_policy:
+        # Windowed-transformer rows (ISSUE 18): the served policy is a
+        # sequence model, so every action rides the per-session rolling
+        # window in the replicas' session tables. max_seq_len covers a
+        # whole episode (the session window never truncates mid-episode
+        # at the bench's episode_len).
+        seq_len = max(16, 1 << (episode_len - 1).bit_length())
+        hp.update(model_kind="transformer_discrete", d_model=16,
+                  n_layers=1, n_heads=2, max_seq_len=seq_len,
+                  bucket_lengths=(seq_len,))
     server = TrainingServer(
         algorithm, obs_dim=obs_dim, act_dim=act_dim, env_dir=scratch,
         hyperparams=hp,
@@ -369,6 +399,59 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
             orig_decoded(batch)
 
         server.transport.on_trajectory_decoded = counting_decoded
+
+    # Horizontal serving replicas (ISSUE 18): each replica process
+    # handshakes the model off the root's agent plane like an actor,
+    # binds its own serving endpoint, and follows publishes live; the
+    # workers' lanes route session-affine across the endpoint list.
+    replica_procs: list = []
+    replica_infos: list = []
+    replica_stop = os.path.join(scratch, "replica_stop")
+    if serving and serving_replicas:
+        env_r = dict(os.environ)
+        env_r["JAX_PLATFORMS"] = "cpu"
+        env_r["PYTHONPATH"] = os.path.dirname(_HERE)
+        serving_addrs = []
+        for r in range(serving_replicas):
+            saddr = f"tcp://127.0.0.1:{free_port()}"
+            serving_addrs.append(saddr)
+            info = {"name": f"replica{r}", "serving_addr": saddr,
+                    "ready_file": os.path.join(scratch, f"replica{r}_ready"),
+                    "result_path": os.path.join(scratch,
+                                                f"replica{r}_result.json")}
+            rcfg = {
+                "name": info["name"], "config_path": config_path,
+                "server_type": transport, "serving_addr": saddr,
+                "ready_file": info["ready_file"],
+                "stop_file": replica_stop,
+                "result_path": info["result_path"],
+                "handshake_timeout_s": 180.0,
+                **{k: worker_addrs[k]
+                   for k in ("agent_listener_addr", "trajectory_addr",
+                             "model_sub_addr", "server_addr")
+                   if k in worker_addrs},
+            }
+            replica_procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(_HERE, "_serving_replica.py"),
+                 json.dumps(rcfg)],
+                env=env_r, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+            replica_infos.append(info)
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if all(os.path.exists(i["ready_file"]) for i in replica_infos):
+                break
+            for p, i in zip(replica_procs, replica_infos):
+                if p.poll() is not None:
+                    out, _ = p.communicate()
+                    raise RuntimeError(
+                        f"serving {i['name']} died during bring-up "
+                        f"(rc={p.returncode}):\n{out[-3000:]}")
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("serving replicas never became ready")
+        worker_addrs = dict(worker_addrs)
+        worker_addrs["serving_addrs"] = serving_addrs
 
     # Hierarchical relay tree (ISSUE 11): relays > 0 stands N relay
     # processes between the root server and the workers — the root's
@@ -459,7 +542,7 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     worker_snaps = []
     for path, out, p in zip(result_paths, outs, procs):
         if p.returncode != 0 or not os.path.exists(path):
-            for rp in relay_procs:  # don't leak the tree on a bad row
+            for rp in relay_procs + replica_procs:  # don't leak on a bad row
                 rp.kill()
             raise RuntimeError(f"soak worker failed (rc={p.returncode}):\n{out}")
         with open(path) as f:
@@ -519,7 +602,13 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
                    **({"emit_coalesce_frames": emit_coalesce_frames}
                       if emit_coalesce_frames else {}),
                    **({"max_batch": max_batch,
-                       "batch_timeout_ms": batch_timeout_ms}
+                       "batch_timeout_ms": batch_timeout_ms,
+                       "streamed_mux": serving_mux,
+                       "serving_replicas": serving_replicas,
+                       "policy": ("transformer_discrete d16xL1 windowed"
+                                  if sequence_policy else "mlp 32x32"),
+                       **({"stream_window": stream_window}
+                          if stream_window is not None else {})}
                       if serving else {}),
                    **({"unroll_length": unroll_length, "jax_env": jax_env,
                        "obs_dim": obs_dim, "act_dim": act_dim}
@@ -581,8 +670,29 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     result["age_attribution"] = age_attribution(
         [result["telemetry"]] + worker_snaps)
     if serving:
+        replica_rows = []
+        if replica_procs:
+            with open(replica_stop, "w") as f:
+                f.write("stop")
+            for p, info in zip(replica_procs, replica_infos):
+                try:
+                    out, _ = p.communicate(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                row = _read_json(info["result_path"])
+                if row is None:
+                    raise RuntimeError(
+                        f"serving {info['name']} left no result "
+                        f"(rc={p.returncode}):\n{(out or '')[-3000:]}")
+                replica_rows.append(row)
         result["serving"] = _serving_row_block(server, agents,
-                                               result["telemetry"])
+                                               result["telemetry"],
+                                               replica_rows)
+        if replica_rows:
+            result["serving"]["replicas_detail"] = [
+                {"name": r["replica"], "model_version": r["model_version"],
+                 **r["accounting"]} for r in replica_rows]
     if relays:
         # The acceptance evidence (ISSUE 11): the ROOT's live stream
         # count (relayrl_transport_subscribers, read while the tree is
@@ -620,11 +730,16 @@ def run_soak(n_actors: int = 64, agents_per_proc: int = 8,
     return result
 
 
-def _serving_row_block(server, agents: list[dict], snap: dict) -> dict:
+def _serving_row_block(server, agents: list[dict], snap: dict,
+                       replica_rows: list[dict] | None = None) -> dict:
     """The serving-plane SLO block embedded per --serving row: fleet
     action-latency percentiles (pooled from the workers' sorted-sample
-    digests), batch occupancy, close-reason split, and the overload
-    counters — the evidence the ISSUE 10 acceptance reads."""
+    digests), batch occupancy, close-reason split, the overload counters
+    (the ISSUE 10 acceptance evidence), and — serving v2 — the session
+    nack split plus the streamed-client pipeline depth. With horizontal
+    replicas the serving-plane counters live in the REPLICA processes,
+    so every counter pools across the root snapshot AND the replica
+    result snapshots; accounting sums the replica session tables."""
     from common import percentile_sorted
 
     samples = sorted(s for a in agents
@@ -634,24 +749,49 @@ def _serving_row_block(server, agents: list[dict], snap: dict) -> dict:
         got = percentile_sorted(samples, q)
         return None if got is None else round(got, 3)
 
+    snaps = [snap] + [r["telemetry"] for r in (replica_rows or [])
+                      if r.get("telemetry")]
+
     def counter(name: str, labels: dict | None = None) -> float:
         total = 0.0
-        for m in snap["metrics"]:
-            if m["name"] != name:
-                continue
-            got = m.get("labels") or {}
-            if labels is not None and any(got.get(k) != v
-                                          for k, v in labels.items()):
-                continue
-            total += m.get("value") or 0
+        for s in snaps:
+            for m in s["metrics"]:
+                if m["name"] != name:
+                    continue
+                got = m.get("labels") or {}
+                if labels is not None and any(got.get(k) != v
+                                              for k, v in labels.items()):
+                    continue
+                total += m.get("value") or 0
         return total
 
-    occ = next((m for m in snap["metrics"]
-                if m["name"] == "relayrl_serving_batch_occupancy"), None)
+    occs = [m for s in snaps for m in s["metrics"]
+            if m["name"] == "relayrl_serving_batch_occupancy"]
+    occ_sum = sum(m.get("sum") or 0 for m in occs)
+    occ_n = sum(m.get("count") or 0 for m in occs)
     per_agent_p99 = [a["latency_ms"]["p99"] for a in agents
                      if a.get("latency_ms", {}).get("p99") is not None]
+    if replica_rows:
+        # Root serves nothing in replica topology: the accounting is the
+        # fleet of replica session tables.
+        first = replica_rows[0]["accounting"]
+        accounting = {
+            "queue_depth": sum(r["accounting"]["queue_depth"]
+                               for r in replica_rows),
+            "max_batch": first["max_batch"],
+            "batch_timeout_ms": first["batch_timeout_ms"],
+            "buckets": first["buckets"],
+            "sessions": sum(r["accounting"]["sessions"]
+                            for r in replica_rows),
+            "max_sessions": first["max_sessions"],
+            "ctx": first["ctx"],
+            "replicas": len(replica_rows),
+        }
+    else:
+        accounting = server.inference.accounting()
+    mux_rows = [a["mux"] for a in agents if a.get("mux")]
     return {
-        **server.inference.accounting(),
+        **accounting,
         "action_latency_ms": {
             "p50": spct(0.50), "p95": spct(0.95), "p99": spct(0.99),
             "max": samples[-1] if samples else None},
@@ -665,8 +805,36 @@ def _serving_row_block(server, agents: list[dict], snap: dict) -> dict:
                             {"reason": "size"}),
             "deadline": counter("relayrl_serving_batches_total",
                                 {"reason": "deadline"})},
-        "batch_occupancy_mean": (round(occ["sum"] / occ["count"], 2)
-                                 if occ and occ.get("count") else None),
+        "batch_occupancy_mean": (round(occ_sum / occ_n, 2)
+                                 if occ_n else None),
+        # Serving v2: eviction/resync/out-of-step accounting. Steady
+        # state is "every eviction nack answered by a successful client
+        # resync" — unserved evictions would show up as session_nacked
+        # climbing without matching resyncs (and as client crashes).
+        "session_nack_split": {
+            "evicted_lru": counter(
+                "relayrl_serving_session_evictions_total",
+                {"reason": "lru"}),
+            "evicted_ttl": counter(
+                "relayrl_serving_session_evictions_total",
+                {"reason": "ttl"}),
+            "session_resyncs": counter(
+                "relayrl_serving_session_resyncs_total"),
+            "session_nacked": counter(
+                "relayrl_serving_session_nacked_total"),
+        },
+        **({"mux": {
+            "clients": len(mux_rows),
+            "inflight_high_water_max": max(
+                r["inflight_high_water"] for r in mux_rows),
+            "inflight_high_water_per_client": [
+                r["inflight_high_water"] for r in mux_rows],
+            "client_retries": sum(r["retries"] for r in mux_rows),
+            "client_overload_nacked": sum(r["overload_nacked"]
+                                          for r in mux_rows),
+            "client_session_resyncs": sum(r["session_resyncs"]
+                                          for r in mux_rows),
+        }} if mux_rows else {}),
     }
 
 
@@ -1955,6 +2123,74 @@ def main():
     relays = 0
     if "--relays" in sys.argv:
         relays = int(sys.argv[sys.argv.index("--relays") + 1])
+    # Serving-v2 flags (ISSUE 18): --mux drives the fleet as streamed
+    # MultiplexedRemoteClients (one per worker process, lanes pipelined
+    # over the serving channel); --seq serves a windowed transformer
+    # through the per-session state tables; --replicas N stands N
+    # StandaloneInferenceHost processes behind the session-affine router.
+    mux = "--mux" in sys.argv
+    seq = "--seq" in sys.argv
+    serving_replicas = 0
+    if "--replicas" in sys.argv:
+        serving_replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
+    if serving and mux and "--curve" in sys.argv:
+        # The serving-v2 scaling curve (ISSUE 18 acceptance artifact):
+        # streamed/multiplexed clients vs the committed lock-step
+        # plateau (~1.6-1.9k steps/s, the PR 10 rows this file keeps).
+        # One MLP row at the lock-step fleet size (64) for the
+        # equal-client-count face-off, then the windowed-transformer
+        # rows scaling to 256 logical clients across 2 replicas — every
+        # action riding the replicas' per-session window tables.
+        rows = []
+        grid = ([(16, 8, 0, False), (16, 8, 2, True)] if quick else [
+            (64, 64, 0, False),    # MLP, colocated: lock-step face-off
+            (64, 64, 0, True),     # transformer, colocated
+            (128, 64, 2, True),    # transformer, horizontal
+            (256, 64, 2, True),    # the 256-client 2-replica headline
+        ])
+        for n, lanes, n_repl, seq_row in grid:
+            # max_batch == stream_window == lane count: the streamed
+            # client keeps a full wave in flight, so the service closes
+            # full-size batches from in-flight depth (the v2 story) —
+            # occupancy ~64 where the lock-step rows topped out at their
+            # concurrent-client count.
+            r = run_soak(n_actors=n, agents_per_proc=lanes,
+                         duration_s=8.0 if quick else 20.0,
+                         transport=transport, serving=True,
+                         serving_mux=True, serving_replicas=n_repl,
+                         sequence_policy=seq_row, max_batch=lanes,
+                         stream_window=lanes)
+            print(json.dumps(r))
+            assert r["server_stats"]["dropped"] == 0
+            assert r["agents_crashed"] == 0
+            assert r["agents_completed"] == n, "fleet silently shrank"
+            sv = r["serving"]
+            assert (sv["rejected_total"] or 0) == 0, \
+                "streamed clients were overload-nacked in a steady soak"
+            # Zero UNSERVED evictions in steady state: the table covers
+            # the fleet, so nothing is evicted (and nothing nacked
+            # without a successful resync answering it).
+            split = sv["session_nack_split"]
+            assert split["evicted_lru"] == 0, split
+            assert split["session_nacked"] <= split["session_resyncs"]
+            assert sv["mux"]["inflight_high_water_max"] >= 2, \
+                "streaming never got >1 request in flight"
+            rows.append(r)
+        if "--write" in sys.argv:
+            # Append-preserve: the PR 10 lock-step rows stay in the file
+            # as the baseline the new rows are read against.
+            out = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "results",
+                f"soak_scaling_{transport}_serving.json")
+            keep = []
+            if os.path.exists(out):
+                with open(out) as f:
+                    keep = [json.loads(line) for line in f if line.strip()]
+                keep = [r for r in keep
+                        if not r.get("config", {}).get("streamed_mux")]
+            _write_results(f"soak_scaling_{transport}_serving.json",
+                           keep + rows)
+        return
     if "--relay-chaos" in sys.argv:
         # Relay-SIGKILL drill (ISSUE 11): kill a mid-tree relay live,
         # replacement restores the same spool + fan-out addresses; zero
@@ -2090,10 +2326,14 @@ def main():
         # server-colocated InferenceService — the "millions of users"
         # shape in miniature, with the latency SLO block embedded.
         result = run_soak(n_actors=8 if quick else 64,
-                          agents_per_proc=4 if quick else 8,
+                          agents_per_proc=(4 if quick else 8) if not mux
+                          else (8 if quick else 64),
                           duration_s=8.0 if quick else 30.0,
-                          transport=transport, serving=True)
-        _finish(result, f"soak64_{transport}_serving.json")
+                          transport=transport, serving=True,
+                          serving_mux=mux, serving_replicas=serving_replicas,
+                          sequence_policy=seq)
+        _finish(result, None if (mux or serving_replicas or seq)
+                else f"soak64_{transport}_serving.json")
         return
     if anakin:
         # The fused-rollout e2e row: 64 logical agents as 4 processes x

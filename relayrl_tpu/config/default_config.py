@@ -193,7 +193,12 @@ DEFAULT_CONFIG: dict = {
         # overlaps the next window's device dispatch (bounded depth-2
         # hand-off — a slow wire backpressures the rollout loop).
         # Worth it when host_share_of_wall is high and a spare core
-        # exists; single-core hosts should leave it off.
+        # exists; single-core hosts should leave it off. False is the
+        # MEASURED default: the committed A/B
+        # (benches/results/anakin_rollout.json,
+        # speedup_async_emit_vs_sync) shows 0.89-1.18x (median ~0.97)
+        # on the soak host — the hand-off overhead eats the overlap
+        # when rollout and emitter share a core.
         "async_emit": False,
         # Coalesce up to this many completed columnar segments (per
         # logical lane, per rollout window) into ONE transport send —
@@ -201,7 +206,12 @@ DEFAULT_CONFIG: dict = {
         # complete many segments per window, and each send pays the
         # envelope + spool + socket path. 1 keeps the one-frame-per-send
         # behavior; relays batch-forward the same container upstream
-        # (relay.batch_max), so the framing helper is shared.
+        # (relay.batch_max), so the framing helper is shared. 1 is the
+        # MEASURED default: the committed A/B (anakin_rollout.json,
+        # speedup_emit_coalesce_vs_single) is neutral at 0.87-1.13x
+        # (median ~0.99) on CartPole-length episodes — raise it only
+        # when episodes are much shorter than unroll_length AND the
+        # per-send envelope cost shows up in host_share_of_wall.
         "emit_coalesce_frames": 1,
         # Trajectory wire form. "auto" (the default) picks per tier:
         # anakin hosts ship whole rollout segments as contiguous columnar
@@ -391,6 +401,27 @@ DEFAULT_CONFIG: dict = {
         # env loop gives up).
         "request_timeout_s": 2.0,
         "infer_deadline_s": 60.0,
+        # -- serving v2: sessions / streaming / replicas --
+        # Server-side session table (sequence policies): one rolling
+        # observation window per client session, LRU-evicted past
+        # max_sessions and reaped after session_ttl_s idle. Eviction is
+        # a resync, not a failure — the client answers the typed
+        # NACK_SESSION_EVICTED by resending its episode window. Size it
+        # to the concurrent-client count; each session costs
+        # ctx * obs_dim float32s.
+        "max_sessions": 4096,
+        "session_ttl_s": 600.0,
+        # Streamed channel: in-flight requests per client connection
+        # before the multiplexing client stops submitting and drains —
+        # bounds client-side memory and keeps a dead service from
+        # swallowing an unbounded pipeline.
+        "stream_window": 32,
+        # Horizontal serving: list of replica serving endpoints (e.g.
+        # ["tcp://hostA:6671", "tcp://hostB:6671"]). null = single
+        # endpoint (server.inference_server). Clients route
+        # session-affine by crc32(session_id) % len(replicas) and
+        # rotate + resync on replica death.
+        "replicas": None,
     },
     # -- hierarchical relay tree (relayrl_tpu/relay/,
     #    docs/architecture.md "relay tree") --
@@ -466,9 +497,9 @@ DEFAULT_CONFIG: dict = {
         "lanes": 4,
         # "vector" = local batched generation (sequence policies: the
         # vmapped step_window path); "remote" = thin clients against the
-        # serving plane (serving.enabled on the training server) — only
-        # where its contracts allow (non-sequence policies; the service
-        # refuses step_window policies with a pointed error).
+        # serving plane (serving.enabled on the training server) —
+        # sequence policies serve through the per-session window table;
+        # keep serving.max_sessions at or above the lane count.
         "generation_tier": "vector",
         # Bounded-staleness pacing: once this many episodes have been
         # scored under ONE behavior version, generation pauses until a

@@ -438,7 +438,9 @@ class ConfigLoader:
         params.update(self._section("serving"))
         params["enabled"] = bool(params.get("enabled", False))
         for key, default, lo in (("max_batch", 16, 1),
-                                 ("queue_limit", 1024, 1)):
+                                 ("queue_limit", 1024, 1),
+                                 ("max_sessions", 4096, 1),
+                                 ("stream_window", 32, 1)):
             try:
                 params[key] = max(lo, int(params.get(key, default)))
             except (TypeError, ValueError):
@@ -447,7 +449,8 @@ class ConfigLoader:
                              ("retry_after_s", 0.05),
                              ("stale_after_s", 5.0),
                              ("request_timeout_s", 2.0),
-                             ("infer_deadline_s", 60.0)):
+                             ("infer_deadline_s", 60.0),
+                             ("session_ttl_s", 600.0)):
             try:
                 value = params.get(key, default)
                 params[key] = max(0.0, float(default if value is None
@@ -467,6 +470,11 @@ class ConfigLoader:
                 params["buckets"] = None
         else:
             params["buckets"] = None
+        replicas = params.get("replicas")
+        if isinstance(replicas, (list, tuple)) and replicas:
+            params["replicas"] = [str(a) for a in replicas]
+        else:
+            params["replicas"] = None
         return params
 
     def get_relay_params(self) -> dict[str, Any]:

@@ -10,9 +10,11 @@ Stage map — every stage rides machinery that already exists:
   ``step_window`` path (``runtime/vector_actor.py`` — generation through
   this stage is BIT-identical to a local ``PolicyActor`` at the same
   seed + params version, the lock tests/test_rlhf.py holds); thin-client
-  generation via the serving plane is available for the policies its
-  contracts allow (non-sequence — the service refuses ``step_window``
-  policies with a pointed error naming this module). Behavior policy
+  generation via the serving plane serves sequence policies too since
+  serving v2 — the service holds each lane's rolling window in its
+  session table, capacity bounded by ``serving.max_sessions`` (size it
+  to the lane count; an evicted lane resyncs from its client mirror,
+  it does not fail). Behavior policy
   evidence is recorded per token at generation time: ``logp_a`` (the
   V-trace numerator's denominator) already rides every record's aux;
   the stage adds ``bver``, the params version the token was sampled
@@ -336,10 +338,10 @@ class GenerationStage:
 class _RemoteLanes:
     """Thin-client generation tier: N ``RemoteActorClient`` lanes against
     the serving plane, adapted to the batched actor-host surface the
-    GenerationStage drives. Only where the serving contracts allow —
-    the InferenceService refuses sequence policies (their rolling
-    window would have to live server-side) with an error pointing back
-    at the vector tier of this scheduler.
+    GenerationStage drives. Sequence policies serve through the
+    service's per-session window table (serving v2) — keep
+    ``serving.max_sessions`` at or above the lane count so steady-state
+    generation never cycles through eviction/resync.
 
     The N round-trips fire CONCURRENTLY (one worker per lane): serial
     requests would cost N x the round-trip per token AND present the

@@ -667,6 +667,11 @@ class TrainingServer:
         core has no action RPC)."""
         if getattr(self.transport, "supports_inband_infer", False):
             self.transport.on_infer = self.inference.handle_request_blocking
+            # Bidi StreamActions (serving v2): one parked RPC thread per
+            # stream regardless of in-flight depth — frames go through
+            # the non-blocking enqueue, replies ride the batch worker's
+            # callbacks.
+            self.transport.on_infer_submit = self.inference.handle_request
         else:
             self.inference.bind_zmq(addr_overrides.get(
                 "serving_addr",

@@ -77,6 +77,15 @@ EVENT_TYPES = (
                                # resyncs exceeded transport.resync_* caps
     "relay_up",          # relay node established its upstream session
     "relay_reconnect",   # relay upstream rebuilt after a drop
+    # -- serving plane v2 (ISSUE 18, runtime/inference.py) --
+    "serving_session_evicted",  # a session left the service table
+                                # (carries sid + reason lru/ttl); the
+                                # client answers the paired nack with a
+                                # window resend, so steady-state soaks
+                                # assert reason=lru count == 0
+    "serving_replica_reroute",  # a mux client re-routed a session to a
+                                # new replica after its home replica
+                                # died (carries sid + old/new replica)
 )
 
 

@@ -242,6 +242,13 @@ NACK_OVERLOADED = 3
 # thin clients fail fast with the reply's error text instead of
 # retrying a misconfiguration into a deadline exhaustion.
 NACK_UNAVAILABLE = 4
+# Serving plane only: the request named a session id the service no
+# longer holds (LRU-evicted under serving.max_sessions, expired past
+# serving.session_ttl_s, or a fresh replica after re-route/restart).
+# RESYNC, not failure: the client answers by resending the same request
+# with its episode window attached — session state is always
+# reconstructible-from-client (the replica-death contract).
+NACK_SESSION_EVICTED = 5
 
 
 class IngestNack(RuntimeError):
@@ -530,6 +537,14 @@ class ServerTransport(abc.ABC):
         # default, and on every broadcast-only backend) answers clients
         # with a pointed "serving disabled" error instead of hanging.
         self.on_infer = None
+        # Streamed serving plane (pipelined bidi inference,
+        # ``StreamActions``): backends with a bidi action stream call
+        # ``on_infer_submit(request_bytes, reply) -> bool`` per inbound
+        # frame — the InferenceService's non-blocking enqueue, which
+        # ALWAYS eventually invokes ``reply(reply_bytes)`` (served,
+        # nacked, or shed at stop). None disables the stream RPC with a
+        # typed unavailable nack, exactly like ``on_infer``.
+        self.on_infer_submit = None
 
     @abc.abstractmethod
     def start(self) -> None: ...

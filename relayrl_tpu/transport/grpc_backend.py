@@ -133,6 +133,66 @@ class _Servicer:
         finally:
             self._owner._infer_slots.release()
 
+    def stream_actions(self, request_iterator, context):
+        """Bidi serving stream (serving v2): every inbound frame is a
+        pipelined inference request handed to the embedder's
+        non-blocking submit hook; replies flow back on THIS stream in
+        whatever order their batches execute (req-id matched client
+        side). One stream parks ONE RPC thread regardless of its
+        in-flight depth — the pipelining reason to prefer it over N
+        parked GetActions unary calls — so it is not gated by the
+        ``_infer_slots`` semaphore; the InferenceService's own
+        ``queue_limit`` overload nacks are the backpressure."""
+        import queue as queue_mod
+
+        from relayrl_tpu.transport.base import NACK_UNAVAILABLE
+        from relayrl_tpu.transport.serving import pack_infer_nack
+
+        submit = self._owner.on_infer_submit
+        if submit is None:
+            yield pack_infer_nack(
+                -1, NACK_UNAVAILABLE,
+                "inference serving is not enabled on this server "
+                "(set serving.enabled: true)")
+            return
+        out: "queue_mod.Queue[bytes | None]" = queue_mod.Queue()
+        state = {"inflight": 0, "drained": False}
+        lock = threading.Lock()
+
+        def reply(b: bytes) -> None:
+            # Runs on batch-worker (or pump) threads: deliver, then
+            # close the stream once the client half-closed AND the last
+            # in-flight reply is out.
+            with lock:
+                state["inflight"] -= 1
+                last = state["drained"] and state["inflight"] == 0
+            out.put(b)
+            if last:
+                out.put(None)
+
+        def pump() -> None:
+            try:
+                for payload in request_iterator:
+                    with lock:
+                        state["inflight"] += 1
+                    submit(payload, reply)
+            except Exception:
+                pass  # cancelled/broken stream: drain and fall through
+            finally:
+                with lock:
+                    state["drained"] = True
+                    empty = state["inflight"] == 0
+                if empty:
+                    out.put(None)
+
+        threading.Thread(target=pump, name="grpc-serving-stream-pump",
+                         daemon=True).start()
+        while True:
+            item = out.get()
+            if item is None:
+                return
+            yield item
+
     def client_poll(self, request: bytes, context) -> bytes:
         req = msgpack.unpackb(request, raw=False)
         agent_id = str(req.get("id", "?"))
@@ -246,6 +306,9 @@ class GrpcServerTransport(ServerTransport):
                 request_deserializer=_identity, response_serializer=_identity),
             "GetActions": grpc.unary_unary_rpc_method_handler(
                 servicer.get_actions,
+                request_deserializer=_identity, response_serializer=_identity),
+            "StreamActions": grpc.stream_stream_rpc_method_handler(
+                servicer.stream_actions,
                 request_deserializer=_identity, response_serializer=_identity),
         }
         self._server = grpc.server(

@@ -68,7 +68,19 @@ def _unpack_array(buf: bytes, shape: list, dtype: str) -> np.ndarray:
 
 
 def pack_infer_request(agent_id: str, req_id: int, key: np.ndarray,
-                       obs: np.ndarray, mask: np.ndarray | None) -> bytes:
+                       obs: np.ndarray, mask: np.ndarray | None,
+                       session: str | None = None, reset: bool = False,
+                       window: np.ndarray | None = None,
+                       step: int = 0) -> bytes:
+    """``session``/``reset``/``window`` are the serving-v2 per-session
+    fields (absent on the v1 wire — old clients and old services
+    interoperate): ``session`` names the server-side rolling window a
+    sequence policy serves from; ``reset`` marks an episode start (the
+    service zeroes the window BEFORE pushing this observation);
+    ``window`` is the resync payload — the episode's prior observations
+    ``[n, obs_dim]`` (oldest first, excluding the current ``obs``) that
+    rebuilds the session after a NACK_SESSION_EVICTED or on a fresh
+    replica after re-route."""
     kb, _, kd = _pack_array(key)
     ob, oshape, od = _pack_array(obs)
     req = {"id": agent_id, "req": int(req_id),
@@ -77,14 +89,33 @@ def pack_infer_request(agent_id: str, req_id: int, key: np.ndarray,
         mb, mshape, _ = _pack_array(np.asarray(mask, np.float32))
         req["mask"] = mb
         req["ms"] = mshape
+    if session is not None:
+        req["sid"] = str(session)
+        # Per-episode step counter (1-based, counting this observation):
+        # the service's push-idempotency key. A client retry of a served
+        # request whose reply was lost arrives with the SAME stp — the
+        # service recomputes from the already-pushed window instead of
+        # pushing the observation twice (same client key → bit-identical
+        # recompute), so at-least-once delivery cannot corrupt state.
+        req["stp"] = int(step)
+    if reset:
+        req["rst"] = True
+    if window is not None:
+        wb, wshape, _ = _pack_array(np.asarray(window, np.float32))
+        req["win"] = wb
+        req["ws"] = wshape
     return msgpack.packb(req, use_bin_type=True)
 
 
 def unpack_infer_request(buf: bytes) -> dict:
-    """Decoded request: ``{id, req, key, obs, mask}`` with numpy arrays.
+    """Decoded request: ``{id, req, key, obs, mask, sid, rst, win}`` with
+    numpy arrays (``sid``/``win`` None and ``rst`` False on the v1 wire).
     Raises the transport plane's droppable error classes on malformed
     frames (ValueError/KeyError/TypeError)."""
-    req = msgpack.unpackb(buf, raw=False)
+    return _infer_request_fields(msgpack.unpackb(buf, raw=False))
+
+
+def _infer_request_fields(req: dict) -> dict:
     key = np.frombuffer(req["key"], dtype=np.dtype(req.get("kd", "uint32")))
     out = {
         "id": str(req.get("id", "?")),
@@ -92,19 +123,31 @@ def unpack_infer_request(buf: bytes) -> dict:
         "key": key.copy(),
         "obs": _unpack_array(req["obs"], req["os"], req["od"]),
         "mask": None,
+        "sid": None if req.get("sid") is None else str(req["sid"]),
+        "rst": bool(req.get("rst", False)),
+        "stp": int(req.get("stp", 0)),
+        "win": None,
     }
     if req.get("mask") is not None:
         out["mask"] = _unpack_array(req["mask"], req["ms"], "float32")
+    if req.get("win") is not None:
+        out["win"] = _unpack_array(req["win"], req["ws"], "float32")
     return out
 
 
 def pack_action_reply(req_id: int, version: int, act: np.ndarray,
-                      next_key: np.ndarray, aux: dict) -> bytes:
-    ab, ashape, ad = _pack_array(act)
+                      next_key: np.ndarray, aux: dict,
+                      ctx: int | None = None) -> bytes:
     reply = {"req": int(req_id), "code": NACK_OK, "ver": int(version),
-             "act": ab, "as": ashape, "ad": ad,
              "key": _pack_array(next_key)[0],
              "aux": {k: list(_pack_array(v)) for k, v in aux.items()}}
+    ab, ashape, ad = _pack_array(act)
+    reply.update({"act": ab, "as": ashape, "ad": ad})
+    if ctx is not None:
+        # Session-served replies carry the service's window length so
+        # the client can bound its resync mirror to exactly the rows a
+        # resync could ever need (sequence policies only).
+        reply["ctx"] = int(ctx)
     return msgpack.packb(reply, use_bin_type=True)
 
 
@@ -121,7 +164,10 @@ def unpack_infer_reply(buf: bytes) -> dict:
     ``ver``, ``act`` (ndarray), ``key`` (the carried-forward PRNG key
     bytes, kept raw: the client round-trips them verbatim), ``aux``
     (name → 0-d/array ndarray)."""
-    reply = msgpack.unpackb(buf, raw=False)
+    return _infer_reply_fields(msgpack.unpackb(buf, raw=False))
+
+
+def _infer_reply_fields(reply: dict) -> dict:
     out = {"req": int(reply.get("req", -1)), "code": int(reply.get("code", 0)),
            "error": str(reply.get("error") or ""),
            "retry_after_s": float(reply.get("retry_after_s") or 0.0)}
@@ -131,7 +177,131 @@ def unpack_infer_reply(buf: bytes) -> dict:
         out["key"] = reply["key"]
         out["aux"] = {k: _unpack_array(*v)
                       for k, v in (reply.get("aux") or {}).items()}
+        if reply.get("ctx") is not None:
+            out["ctx"] = int(reply["ctx"])
     return out
+
+
+# -- wave frames (coalesced wire) -------------------------------------------
+#
+# A multiplexing client's per-step wire cost is dominated by per-request
+# overhead — one msgpack round + one socket hop each way per lane
+# (~190us/step measured on the bench host, ~40% of the total step
+# budget). Pipelining alone cannot reclaim it on a saturated core: there
+# is no latency to hide, only work to amortize. Wave frames carry a
+# whole homogeneous wave in ONE frame with STACKED tensors (one obs
+# block, one key block), and the service coalesces replies the same way
+# per dispatched batch — per-lane codec cost drops to near zero while
+# the decoded rows stay bit-identical to the single-request wire (the
+# parity lock covers both).
+
+
+def pack_infer_wave(entries: list[dict]) -> bytes:
+    """One frame for a wave of lane requests. ``entries`` rows:
+    ``{id, req, key, obs, mask, sid, stp, rst}``. The caller guarantees
+    homogeneity (same obs shape/dtype, same key dtype, masks all None or
+    all present at one shape) and that no row carries a resync window —
+    resyncs and retries always ride the single-request wire."""
+    keys = np.stack([np.asarray(e["key"]) for e in entries])
+    obs = np.stack([np.asarray(e["obs"]) for e in entries])
+    kb, ks, kd = _pack_array(keys)
+    ob, oshape, od = _pack_array(obs)
+    wave = {"wave": 1,
+            "reqs": [int(e["req"]) for e in entries],
+            "ids": [str(e["id"]) for e in entries],
+            "key": kb, "ks": ks, "kd": kd,
+            "obs": ob, "os": oshape, "od": od}
+    if entries[0].get("mask") is not None:
+        mb, mshape, _ = _pack_array(np.stack(
+            [np.asarray(e["mask"], np.float32) for e in entries]))
+        wave["mask"] = mb
+        wave["ms"] = mshape
+    if entries[0].get("sid") is not None:
+        # Session rows: sid == id on the mux wire (one session per lane
+        # sid), so only the step/reset columns ship.
+        wave["ses"] = True
+        wave["stps"] = [int(e.get("stp", 0)) for e in entries]
+        wave["rst"] = [1 if e.get("rst") else 0 for e in entries]
+    return msgpack.packb(wave, use_bin_type=True)
+
+
+def _unpack_infer_wave(req: dict) -> list[dict]:
+    keys = _unpack_array(req["key"], req["ks"], req["kd"])
+    obs = _unpack_array(req["obs"], req["os"], req["od"])
+    masks = None
+    if req.get("mask") is not None:
+        masks = _unpack_array(req["mask"], req["ms"], "float32")
+    ids = [str(s) for s in req["ids"]]
+    ses = bool(req.get("ses"))
+    stps = req.get("stps") or [0] * len(ids)
+    rsts = req.get("rst") or [0] * len(ids)
+    # Rows are views of the one decoded (owned) block — downstream
+    # writes copy (np.stack at dispatch, window-row assignment), so the
+    # shared base is never mutated.
+    return [{"id": ids[i], "req": int(req["reqs"][i]),
+             "key": keys[i], "obs": obs[i],
+             "mask": None if masks is None else masks[i],
+             "sid": ids[i] if ses else None,
+             "rst": bool(rsts[i]), "stp": int(stps[i]), "win": None}
+            for i in range(len(ids))]
+
+
+def unpack_infer_any(buf: bytes) -> list[dict]:
+    """Decode either wire shape into request rows: a wave frame expands
+    to its lanes, a single request becomes a one-row list."""
+    req = msgpack.unpackb(buf, raw=False)
+    if req.get("wave"):
+        return _unpack_infer_wave(req)
+    return [_infer_request_fields(req)]
+
+
+def pack_reply_wave(req_ids: list, version: int, acts: np.ndarray,
+                    keys: np.ndarray, aux: dict,
+                    ctx: int | None = None) -> bytes:
+    """One frame answering several batchmates from one wave: stacked
+    act/key/aux blocks (first axis = the wave rows), one shared version
+    (a dispatch batch is single-model-version by construction)."""
+    reply = {"wave": 1, "reqs": [int(r) for r in req_ids],
+             "code": NACK_OK, "ver": int(version)}
+    ab, ashape, ad = _pack_array(acts)
+    kb, ks, kd = _pack_array(keys)
+    reply.update({"act": ab, "as": ashape, "ad": ad,
+                  "key": kb, "ks": ks, "kd": kd,
+                  "aux": {k: list(_pack_array(v)) for k, v in aux.items()}})
+    if ctx is not None:
+        reply["ctx"] = int(ctx)
+    return msgpack.packb(reply, use_bin_type=True)
+
+
+def _unpack_reply_wave(reply: dict) -> list[dict]:
+    acts = _unpack_array(reply["act"], reply["as"], reply["ad"])
+    keys = _unpack_array(reply["key"], reply["ks"], reply["kd"])
+    aux = {k: _unpack_array(*v)
+           for k, v in (reply.get("aux") or {}).items()}
+    ctx = reply.get("ctx")
+    ver = int(reply.get("ver", -1))
+    out = []
+    for i in range(len(reply["reqs"])):
+        # ``[i, ...]`` keeps 0-d rows as 0-d ndarrays (never numpy
+        # scalars) — the single-reply wire's exact dtype contract.
+        row = {"req": int(reply["reqs"][i]), "code": NACK_OK,
+               "error": "", "retry_after_s": 0.0, "ver": ver,
+               "act": acts[i, ...],
+               "key": keys[i].tobytes(),
+               "aux": {k: v[i, ...] for k, v in aux.items()}}
+        if ctx is not None:
+            row["ctx"] = int(ctx)
+        out.append(row)
+    return out
+
+
+def unpack_reply_any(buf: bytes) -> list[dict]:
+    """Decode either reply shape into reply rows (nacks are always
+    single frames — only served actions coalesce)."""
+    reply = msgpack.unpackb(buf, raw=False)
+    if reply.get("wave"):
+        return _unpack_reply_wave(reply)
+    return [_infer_reply_fields(reply)]
 
 
 # -- server side ------------------------------------------------------------
@@ -324,6 +494,188 @@ class ZmqServingClient:
         self._sock.close(linger=0)
 
 
+class StreamWaiter:
+    """One in-flight streamed request: ``wait`` blocks for ITS reply
+    (req-id matched by the receiver loop). ``reply`` is None until
+    delivery; a waiter failed wholesale (stream broke, client closing)
+    completes with ``error`` set instead."""
+
+    __slots__ = ("req_id", "event", "reply", "error")
+
+    def __init__(self, req_id: int):
+        self.req_id = int(req_id)
+        self.event = threading.Event()
+        self.reply: dict | None = None
+        self.error: str | None = None
+
+    def resolve(self, reply: dict) -> None:
+        self.reply = reply
+        self.event.set()
+
+    def fail(self, error: str) -> None:
+        self.error = error
+        self.event.set()
+
+
+class ZmqStreamingClient:
+    """Pipelined DEALER against the service's ROUTER: N requests in
+    flight per client, replies matched by request id, out-of-order
+    completion legal — the serving-v2 stream channel that lets one thin
+    process drive dozens of env lanes over in-flight windows instead of
+    lock-step round-trips.
+
+    The DEALER is owned by ONE receiver thread (zmq sockets are not
+    thread-safe); submitting threads hand their frames to it over an
+    inproc PUSH/PULL pipe (the ZmqServingPlane pattern, mirrored
+    client-side), so a submit never waits on a reply and never touches
+    the DEALER. ``inflight_high_water`` records the deepest concurrent
+    pipeline seen — the bench/test evidence that streaming actually
+    streams (≥2 asserted by the serving smoke)."""
+
+    def __init__(self, addr: str, identity: str | None = None):
+        import os
+        import secrets
+
+        import zmq
+
+        self._zmq = zmq
+        self._ctx = zmq.Context.instance()
+        self._dealer = self._ctx.socket(zmq.DEALER)
+        self._dealer.setsockopt(
+            zmq.IDENTITY,
+            (identity or f"INFER-{os.getpid()}{secrets.token_hex(4)}")
+            .encode())
+        self._dealer.connect(addr)
+        self._inproc = f"inproc://relayrl-serving-cli-{id(self):x}"
+        self._pull = self._ctx.socket(zmq.PULL)
+        self._pull.bind(self._inproc)
+        self._push = self._ctx.socket(zmq.PUSH)
+        self._push.connect(self._inproc)
+        self._push_lock = threading.Lock()
+        self._pending: dict[int, StreamWaiter] = {}
+        self._plock = threading.Lock()
+        self.inflight_high_water = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="zmq-serving-stream", daemon=True)
+        self._thread.start()
+
+    def submit(self, payload: bytes, req_id: int) -> StreamWaiter:
+        """Queue one request for send and return its waiter — returns
+        immediately; the reply lands on the waiter whenever its batch
+        executes, in any order relative to other in-flight requests."""
+        waiter = StreamWaiter(req_id)
+        with self._plock:
+            if self._stop.is_set():
+                waiter.fail("streaming client closed")
+                return waiter
+            self._pending[req_id] = waiter
+            depth = len(self._pending)
+            if depth > self.inflight_high_water:
+                self.inflight_high_water = depth
+        with self._push_lock:
+            self._push.send(payload)
+        return waiter
+
+    def submit_wave(self, payload: bytes,
+                    req_ids: list[int]) -> list[StreamWaiter]:
+        """Queue one coalesced wave frame (``pack_infer_wave``) carrying
+        several requests; returns one waiter per request, resolved
+        independently (replies may coalesce differently than requests —
+        the receiver matches by req id either way)."""
+        waiters = [StreamWaiter(r) for r in req_ids]
+        with self._plock:
+            if self._stop.is_set():
+                for waiter in waiters:
+                    waiter.fail("streaming client closed")
+                return waiters
+            for waiter in waiters:
+                self._pending[waiter.req_id] = waiter
+            depth = len(self._pending)
+            if depth > self.inflight_high_water:
+                self.inflight_high_water = depth
+        with self._push_lock:
+            self._push.send(payload)
+        return waiters
+
+    def wait(self, waiter: StreamWaiter, timeout_s: float) -> dict:
+        """Block for one waiter's reply. On timeout the waiter is
+        RETRACTED (a late reply is dropped by the receiver, never
+        adopted by a retry — retries carry fresh req ids)."""
+        if not waiter.event.wait(timeout_s):
+            self.cancel(waiter.req_id)
+            # Resolve-vs-cancel race: the receiver may have completed
+            # the waiter between the wait timeout and the pop.
+            if not waiter.event.is_set():
+                raise TimeoutError(
+                    f"streamed inference reply not received in "
+                    f"{timeout_s:.2f}s")
+        if waiter.error is not None:
+            raise ConnectionError(waiter.error)
+        return waiter.reply
+
+    def request(self, payload: bytes, req_id: int, timeout_s: float) -> dict:
+        """Serial-compatible surface (ZmqServingClient drop-in): submit
+        and wait. Callers that never overlap submits get exactly the
+        lock-step behavior, over the same pipelined channel."""
+        return self.wait(self.submit(payload, req_id), timeout_s)
+
+    def cancel(self, req_id: int) -> None:
+        with self._plock:
+            self._pending.pop(req_id, None)
+
+    def _loop(self) -> None:
+        zmq = self._zmq
+        poller = zmq.Poller()
+        poller.register(self._dealer, zmq.POLLIN)
+        poller.register(self._pull, zmq.POLLIN)
+        while not self._stop.is_set():
+            events = dict(poller.poll(100))
+            if self._pull in events:
+                while True:
+                    try:
+                        frame = self._pull.recv(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    self._dealer.send(frame)
+            if self._dealer in events:
+                while True:
+                    try:
+                        raw = self._dealer.recv(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    try:
+                        rows = unpack_reply_any(raw)
+                    except Exception:
+                        continue  # corrupt frame: its waiters time out
+                    for reply in rows:
+                        with self._plock:
+                            waiter = self._pending.pop(reply["req"], None)
+                        # req=-1 decode-failure nacks are ambiguous on a
+                        # pipelined channel (unlike the serial client's
+                        # one-outstanding adoption rule) — unmatched
+                        # replies drop and the affected waiter retries
+                        # on timeout.
+                        if waiter is not None:
+                            waiter.resolve(reply)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._plock:
+            pending, self._pending = list(self._pending.values()), {}
+        for waiter in pending:
+            waiter.fail("streaming client closed")
+        with self._push_lock:
+            # Under the send lock: a racing submit that passed the _stop
+            # check must finish its send before the socket dies.
+            self._push.close(linger=0)
+        for sock in (self._dealer, self._pull):
+            sock.close(linger=0)
+
+
 class GrpcServingClient:
     """In-band ``GetActions`` unary RPC on the agent's existing channel
     (pure-grpcio fleets). The request/response pairing is the RPC itself,
@@ -377,6 +729,141 @@ class GrpcServingClient:
         pass  # the agent transport owns the channel
 
 
+class GrpcStreamingClient:
+    """Bidi ``StreamActions`` on the agent's existing channel — the grpc
+    equivalent of :class:`ZmqStreamingClient`: N requests in flight,
+    req-id matched, out-of-order replies legal. One long-lived
+    stream-stream call carries every request; a broken stream fails the
+    in-flight waiters (their owners retry) and the next submit opens a
+    fresh call on whatever channel the transport currently holds (so a
+    ``_rebuild_channel`` heal is picked up automatically)."""
+
+    def __init__(self, agent_transport):
+        import grpc
+
+        self._grpc = grpc
+        self._transport = agent_transport
+        self._lock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: dict[int, StreamWaiter] = {}
+        self.inflight_high_water = 0
+        self._queue = None          # outbound request queue of the live call
+        self._receiver = None
+        self._closed = False
+        self._permanent: str | None = None
+
+    def _ensure_stream_locked(self):
+        import queue as queue_mod
+
+        if self._queue is not None:
+            return self._queue
+        channel = self._transport._channel
+        stub = channel.stream_stream(
+            "/relayrl.RelayRLRoute/StreamActions",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x)
+        q: "queue_mod.Queue[bytes | None]" = queue_mod.Queue()
+
+        def request_iter():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+
+        responses = stub(request_iter())
+        self._queue = q
+        self._receiver = threading.Thread(
+            target=self._recv_loop, args=(q, responses),
+            name="grpc-serving-stream", daemon=True)
+        self._receiver.start()
+        return q
+
+    def _recv_loop(self, q, responses) -> None:
+        grpc = self._grpc
+        error = "inference stream closed"
+        try:
+            for raw in responses:
+                try:
+                    reply = unpack_infer_reply(raw)
+                except Exception:
+                    continue
+                with self._plock:
+                    waiter = self._pending.pop(reply["req"], None)
+                if waiter is not None:
+                    waiter.resolve(reply)
+        except grpc.RpcError as e:
+            code = getattr(e, "code", lambda: None)()
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                # PERMANENT: no StreamActions RPC on this server (native
+                # C++ core, or a pre-v2 pure-grpcio build) — same
+                # misconfiguration contract as GetActions UNIMPLEMENTED.
+                self._permanent = (
+                    "inference unavailable: this gRPC server does not "
+                    "implement StreamActions — serve inference on the "
+                    "zmq plane (serving_plane=\"zmq\") or run a "
+                    "serving-v2 pure-grpcio server")
+                error = self._permanent
+            else:
+                error = f"inference stream broke: {e}"
+        # Stream over (server gone, half-close, or error): fail every
+        # in-flight waiter and let the next submit reopen.
+        with self._lock:
+            if self._queue is q:
+                self._queue = None
+                self._receiver = None
+        with self._plock:
+            pending, self._pending = list(self._pending.values()), {}
+        for waiter in pending:
+            waiter.fail(error)
+
+    def submit(self, payload: bytes, req_id: int) -> StreamWaiter:
+        waiter = StreamWaiter(req_id)
+        if self._permanent is not None:
+            raise RuntimeError(self._permanent)
+        with self._lock:
+            if self._closed:
+                waiter.fail("streaming client closed")
+                return waiter
+            q = self._ensure_stream_locked()
+            with self._plock:
+                self._pending[req_id] = waiter
+                depth = len(self._pending)
+                if depth > self.inflight_high_water:
+                    self.inflight_high_water = depth
+            q.put(payload)
+        return waiter
+
+    def wait(self, waiter: StreamWaiter, timeout_s: float) -> dict:
+        if not waiter.event.wait(timeout_s):
+            with self._plock:
+                self._pending.pop(waiter.req_id, None)
+            if not waiter.event.is_set():
+                raise TimeoutError(
+                    f"streamed inference reply not received in "
+                    f"{timeout_s:.2f}s")
+        if waiter.error is not None:
+            raise ConnectionError(waiter.error)
+        return waiter.reply
+
+    def request(self, payload: bytes, req_id: int, timeout_s: float) -> dict:
+        return self.wait(self.submit(payload, req_id), timeout_s)
+
+    def cancel(self, req_id: int) -> None:
+        with self._plock:
+            self._pending.pop(req_id, None)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            q, self._queue = self._queue, None
+            receiver, self._receiver = self._receiver, None
+        if q is not None:
+            q.put(None)  # half-close; the receiver fails any stragglers
+        if receiver is not None:
+            receiver.join(timeout=5)
+
+
 def make_serving_client(server_type: str, config, transport=None,
                         **overrides):
     """The thin client's action channel for a fleet transport kind:
@@ -385,22 +872,29 @@ def make_serving_client(server_type: str, config, transport=None,
     DEALER against ``server.inference_server`` (native passthrough —
     the C++ core has no request/response action RPC). Pass
     ``serving_plane="zmq"`` to force the zmq plane on a grpc fleet whose
-    server runs the native C++ gRPC core (it does not speak GetActions)."""
+    server runs the native C++ gRPC core (it does not speak GetActions).
+    ``stream=True`` returns the pipelined streaming client for the plane
+    instead of the lock-step one (N in-flight requests, out-of-order
+    replies — the serving-v2 channel)."""
     plane = overrides.get("serving_plane") or (
         "grpc" if server_type == "grpc" else "zmq")
+    stream = bool(overrides.get("stream", False))
     if plane == "grpc":
         if transport is None or not hasattr(transport, "_channel"):
             raise ValueError(
                 "grpc serving plane needs the agent's GrpcAgentTransport")
-        return GrpcServingClient(transport)
+        return (GrpcStreamingClient(transport) if stream
+                else GrpcServingClient(transport))
     addr = overrides.get("serving_addr")
     if addr is None:
         addr = config.get_inference_server().address
-    return ZmqServingClient(addr, identity=overrides.get("identity"))
+    cls = ZmqStreamingClient if stream else ZmqServingClient
+    return cls(addr, identity=overrides.get("identity"))
 
 
 __all__ = [
     "pack_infer_request", "unpack_infer_request", "pack_action_reply",
     "pack_infer_nack", "unpack_infer_reply", "ZmqServingPlane",
-    "ZmqServingClient", "GrpcServingClient", "make_serving_client",
+    "ZmqServingClient", "ZmqStreamingClient", "GrpcServingClient",
+    "GrpcStreamingClient", "StreamWaiter", "make_serving_client",
 ]

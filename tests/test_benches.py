@@ -266,6 +266,165 @@ def test_bench_soak_serving_quick_smoke(tmp_path):
     assert "relayrl_serving_requests_total" in names
 
 
+@pytest.mark.serving
+@pytest.mark.slow
+def test_bench_soak_serving_mux_quick_smoke(tmp_path):
+    """Streamed-mux --serving smoke (ISSUE 18): two MultiplexedRemoteClient
+    processes x 4 lanes against the colocated InferenceService. Each
+    streaming client must demonstrably PIPELINE — >= 2 requests in
+    flight on its one DEALER socket at some point (the lock-step
+    baseline can never exceed 1) — with zero rejects, zero LRU
+    evictions, per-lane trajectory attribution intact, and the
+    session/nack split present in the SLO block."""
+    import os
+
+    sys.path.insert(0, str(BENCH_DIR))
+    monkey_cwd = os.getcwd()
+    try:
+        import bench_soak
+
+        os.chdir(tmp_path)
+        result = bench_soak.run_soak(
+            n_actors=8, agents_per_proc=4, duration_s=4.0,
+            traj_per_epoch=8, serving=True, serving_mux=True,
+            max_batch=4, batch_timeout_ms=5.0)
+    finally:
+        os.chdir(monkey_cwd)
+        sys.path.pop(0)
+    assert result["config"]["mode"] == "serving"
+    assert result["config"]["streamed_mux"] is True
+    assert result["agents_completed"] == 8
+    assert result["agents_crashed"] == 0
+    assert result["server_stats"]["dropped"] == 0
+    assert result["distinct_traj_agents"] == 8  # per-lane sids intact
+    sv = result["serving"]
+    assert sv["rejected_total"] == 0
+    assert sv["batch_occupancy_mean"] > 1, \
+        "dynamic batching never engaged"
+    mux = sv["mux"]
+    assert mux["clients"] == 2  # one streaming client per worker proc
+    assert len(mux["inflight_high_water_per_client"]) == 2
+    assert all(hw >= 2 for hw in mux["inflight_high_water_per_client"]), \
+        f"a streaming client never pipelined: {mux}"
+    split = sv["session_nack_split"]
+    assert split["evicted_lru"] == 0  # sized table: no working-set churn
+    assert {"evicted_ttl", "session_resyncs",
+            "session_nacked"} <= set(split)
+
+
+@pytest.mark.serving
+@pytest.mark.slow
+def test_serving_replica_sigkill_drill(tmp_path):
+    """Multi-replica SIGKILL drill (ISSUE 18): two StandaloneInferenceHost
+    replica PROCESSES serve a windowed transformer policy behind the
+    session-affine router; SIGKILL the replica that owns lane 0
+    mid-episode. The streamed client must re-route the orphaned lanes to
+    the survivor and resync their session windows — every post-kill
+    round still answers all lanes, with >= 1 recorded resync."""
+    import os
+    import time
+
+    from _util import free_port
+    from relayrl_tpu import telemetry
+    from relayrl_tpu.runtime.inference import MultiplexedRemoteClient
+    from relayrl_tpu.runtime.server import TrainingServer
+
+    telemetry.set_registry(telemetry.Registry(run_id="sigkill-drill"))
+    scratch = str(tmp_path)
+    cfg_path = os.path.join(scratch, "drill_cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"serving": {"enabled": True, "max_batch": 4,
+                               "batch_timeout_ms": 2.0,
+                               "request_timeout_s": 1.0}}, f)
+    addrs = {
+        "agent_listener_addr": f"tcp://127.0.0.1:{free_port()}",
+        "trajectory_addr": f"tcp://127.0.0.1:{free_port()}",
+        "model_pub_addr": f"tcp://127.0.0.1:{free_port()}",
+    }
+    # Root trains + publishes only; serving lives in the replicas.
+    server = TrainingServer(
+        "REINFORCE", obs_dim=6, act_dim=3, env_dir=scratch,
+        server_type="zmq",
+        hyperparams={"traj_per_epoch": 10_000,
+                     "model_kind": "transformer_discrete", "d_model": 16,
+                     "n_layers": 1, "n_heads": 2, "max_seq_len": 16,
+                     "bucket_lengths": (16,)},
+        **addrs)
+    procs, serving_addrs, client = [], [], None
+    stop_file = os.path.join(scratch, "replica_stop")
+    try:
+        for r in range(2):
+            saddr = f"tcp://127.0.0.1:{free_port()}"
+            serving_addrs.append(saddr)
+            rcfg = {
+                "name": f"drill-replica-{r}", "config_path": cfg_path,
+                "server_type": "zmq", "serving_addr": saddr,
+                "ready_file": os.path.join(scratch, f"r{r}_ready"),
+                "stop_file": stop_file,
+                "result_path": os.path.join(scratch, f"r{r}_result.json"),
+                "handshake_timeout_s": 180.0,
+                "agent_listener_addr": addrs["agent_listener_addr"],
+                "trajectory_addr": addrs["trajectory_addr"],
+                "model_sub_addr": addrs["model_pub_addr"],
+            }
+            procs.append(subprocess.Popen(
+                [sys.executable, str(BENCH_DIR / "_serving_replica.py"),
+                 json.dumps(rcfg)],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=scratch))
+        deadline = time.time() + 180
+        for r, proc in enumerate(procs):
+            ready = os.path.join(scratch, f"r{r}_ready")
+            while not os.path.exists(ready):
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"replica {r} died during startup:\n"
+                        f"{proc.stdout.read()[-2000:]}")
+                assert time.time() < deadline, f"replica {r} never ready"
+                time.sleep(0.1)
+        import numpy as np
+
+        client = MultiplexedRemoteClient(
+            config_path=cfg_path, server_type="zmq", lanes=4, seed=17,
+            identity="drill-mux", serving_addrs=serving_addrs,
+            agent_listener_addr=addrs["agent_listener_addr"],
+            trajectory_addr=addrs["trajectory_addr"],
+            model_sub_addr=addrs["model_pub_addr"])
+        assert len(client._clients) == 2  # one stream per replica
+        rng = np.random.default_rng(5)
+
+        def run_rounds(n):
+            for _ in range(n):
+                obs = [o.astype(np.float32)
+                       for o in rng.standard_normal((4, 6))]
+                recs = client.request_for_actions(
+                    obs, rewards=[0.1] * 4)
+                assert len(recs) == 4
+                assert all(r is not None for r in recs)
+
+        run_rounds(3)
+        victim = client._lane_client[0]  # lane 0's home replica
+        procs[victim].kill()             # SIGKILL, no goodbye
+        procs[victim].wait(timeout=30)
+        run_rounds(3)                    # must still answer every lane
+        assert client._lane_client[0] == 1 - victim, \
+            "lane 0 never re-routed off the dead replica"
+        assert client._m_resyncs.total() >= 1, \
+            "re-route happened without a session window resync"
+    finally:
+        with open(stop_file, "w") as f:
+            f.write("stop")
+        if client is not None:
+            client.disable_agent()
+        for proc in procs:
+            try:
+                proc.communicate(timeout=30)
+            except Exception:
+                proc.kill()
+        server.disable_server()
+
+
 @pytest.mark.relay
 @pytest.mark.slow
 def test_bench_soak_relay_quick_smoke(tmp_path):

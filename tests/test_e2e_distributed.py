@@ -219,7 +219,14 @@ def _transports_available():
     return ["zmq", "grpc"] + (["native"] if native_available() else [])
 
 
-@pytest.mark.parametrize("kind", ["zmq", "grpc", "native"])
+# Wall re-fit convention: zmq is the fast per-transport representative;
+# the grpc/native twins exercise the same repoint path over a different
+# socket and ride the slow tier.
+@pytest.mark.parametrize("kind", [
+    "zmq",
+    pytest.param("grpc", marks=pytest.mark.slow),
+    pytest.param("native", marks=pytest.mark.slow),
+])
 def test_agent_restart_and_repoint(tmp_cwd, kind):
     """Agent lifecycle parity (ref o3_agent.rs restart/enable/disable):
     restart against the same server keeps serving; restart with address

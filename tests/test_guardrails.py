@@ -1197,9 +1197,12 @@ class TestRollback:
 # probes are observers: bit-identical params on vs off
 # ---------------------------------------------------------------------------
 class TestBitIdentity:
+    # Wall re-fit convention: REINFORCE is the fast per-algorithm
+    # representative; the PPO twin rides the slow tier.
     @pytest.mark.parametrize("algo_name,hp", [
         ("REINFORCE", {"with_vf_baseline": True, "train_vf_iters": 2}),
-        ("PPO", {"train_iters": 2, "minibatch_count": 2}),
+        pytest.param("PPO", {"train_iters": 2, "minibatch_count": 2},
+                     marks=pytest.mark.slow),
     ])
     def test_guardrails_probes_do_not_perturb_training(
             self, tmp_cwd, algo_name, hp):

@@ -73,9 +73,78 @@ def _versioned_bundle(bundle, version):
                        version=version)
 
 
-def _submit(svc, key, obs, req_id=1, agent_id="t", mask=None):
+def _transformer_bundle(obs_dim=5, act_dim=3, max_seq_len=8, seed=0):
+    """A tiny windowed (sequence) policy bundle — the arch the serving
+    plane refused before serving v2."""
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    arch = {"kind": "transformer_discrete", "obs_dim": obs_dim,
+            "act_dim": act_dim, "d_model": 16, "n_layers": 1,
+            "n_heads": 2, "max_seq_len": max_seq_len}
+    policy = build_policy(arch)
+    return ModelBundle(version=1, arch=dict(arch),
+                       params=policy.init_params(jax.random.PRNGKey(seed)))
+
+
+class _SessionDriver:
+    """Hand-rolled serving-v2 session client against a live service:
+    carries the PRNG key, the monotonic push cursor, the episode-start
+    flag, and the episode mirror — the protocol RemoteActorClient speaks,
+    laid bare so tests can replay/evict/desync at will."""
+
+    def __init__(self, svc, sid, seed):
+        self.svc = svc
+        self.sid = sid
+        self.key = np.asarray(jax.random.PRNGKey(seed))
+        self.step = 0
+        self.episode_start = True
+        self.mirror: list = []
+        self._req = 0
+
+    def raw(self, obs, with_win=False, step=None, key=None, timeout=30):
+        """One request (no client state advance): returns the decoded
+        reply."""
+        self._req += 1
+        win = np.stack(self.mirror) if (with_win and self.mirror) else None
+        done, box = _submit(
+            self.svc, self.key if key is None else key, obs,
+            req_id=self._req, agent_id=self.sid,
+            session=self.sid, reset=self.episode_start, window=win,
+            step=self.step + 1 if step is None else step)
+        assert done.wait(timeout)
+        return box["reply"]
+
+    def act(self, obs):
+        """One successful action with the full resync protocol: on a
+        SESSION_EVICTED nack, resend with the episode window attached."""
+        from relayrl_tpu.transport.base import NACK_SESSION_EVICTED
+
+        reply = self.raw(obs)
+        if reply["code"] == NACK_SESSION_EVICTED:
+            reply = self.raw(obs, with_win=True)
+        assert reply["code"] == 1, reply.get("error")
+        self.key = np.frombuffer(reply["key"], self.key.dtype).copy()
+        self.step += 1
+        self.episode_start = False
+        ctx = reply.get("ctx")
+        assert ctx is not None
+        self.mirror.append(np.asarray(obs, np.float32))
+        if len(self.mirror) > ctx:
+            del self.mirror[:len(self.mirror) - ctx]
+        return reply
+
+    def end_episode(self):
+        self.episode_start = True
+        self.mirror = []
+
+
+def _submit(svc, key, obs, req_id=1, agent_id="t", mask=None,
+            session=None, reset=False, window=None, step=0):
     """One decoded request against a live service; returns (event, box) —
-    box['reply'] is the decoded reply once event fires."""
+    box['reply'] is the decoded reply once event fires. ``session`` /
+    ``reset`` / ``window`` / ``step`` are the serving-v2 per-session
+    fields (sequence policies)."""
     from relayrl_tpu.transport.serving import (
         pack_infer_request,
         unpack_infer_reply,
@@ -89,7 +158,9 @@ def _submit(svc, key, obs, req_id=1, agent_id="t", mask=None):
         done.set()
 
     svc.handle_request(
-        pack_infer_request(agent_id, req_id, key, obs, mask), reply)
+        pack_infer_request(agent_id, req_id, key, obs, mask,
+                           session=session, reset=reset, window=window,
+                           step=step), reply)
     return done, box
 
 
@@ -115,6 +186,38 @@ class TestServingCodec:
         assert np.array_equal(out["aux"]["vec"], aux["vec"])
         assert np.frombuffer(out["key"], np.uint32).tolist() == [1, 2]
 
+    def test_session_fields_round_trip(self):
+        """The serving-v2 wire fields (session id, reset flag, push
+        cursor, resync window, reply ctx) survive the codec — and stay
+        ABSENT on v1 frames so old clients and old services interop."""
+        from relayrl_tpu.transport.serving import (
+            pack_action_reply,
+            pack_infer_request,
+            unpack_infer_reply,
+            unpack_infer_request,
+        )
+
+        key = np.asarray(jax.random.PRNGKey(1))
+        obs = np.arange(5, dtype=np.float32)
+        win = np.arange(10, dtype=np.float32).reshape(2, 5)
+        out = unpack_infer_request(pack_infer_request(
+            "a", 7, key, obs, None, session="a#L001", reset=True,
+            window=win, step=3))
+        assert out["sid"] == "a#L001" and out["rst"] is True
+        assert out["stp"] == 3
+        assert np.array_equal(out["win"], win)
+        v1 = unpack_infer_request(pack_infer_request("a", 7, key, obs,
+                                                     None))
+        assert v1["sid"] is None and v1["rst"] is False
+        assert v1["stp"] == 0 and v1["win"] is None
+        reply = unpack_infer_reply(pack_action_reply(
+            7, 3, np.asarray(np.int32(1)), np.array([1, 2], np.uint32),
+            {}, ctx=8))
+        assert reply["ctx"] == 8
+        assert "ctx" not in unpack_infer_reply(pack_action_reply(
+            7, 3, np.asarray(np.int32(1)), np.array([1, 2], np.uint32),
+            {}))
+
     def test_request_round_trip_with_mask_and_uint8(self):
         from relayrl_tpu.transport.serving import (
             pack_infer_request,
@@ -131,6 +234,74 @@ class TestServingCodec:
         assert np.array_equal(out["obs"], obs)
         assert np.array_equal(out["mask"], mask)
         assert np.array_equal(out["key"], key)
+
+    def test_wave_request_rows_match_single_wire(self):
+        """Coalesced wave frames are a pure wire optimization: every
+        decoded row is field-identical to the same request on the
+        single-request wire (bit-exact obs/key/mask, same session
+        columns)."""
+        from relayrl_tpu.transport.serving import (
+            pack_infer_request,
+            pack_infer_wave,
+            unpack_infer_any,
+            unpack_infer_request,
+        )
+
+        rng = np.random.default_rng(3)
+        entries = []
+        for i in range(4):
+            entries.append({
+                "id": f"a#L{i:03d}", "req": 100 + i,
+                "key": np.asarray(jax.random.PRNGKey(i)),
+                "obs": rng.standard_normal(6).astype(np.float32),
+                "mask": None, "sid": f"a#L{i:03d}", "stp": i + 1,
+                "rst": i == 0})
+        rows = unpack_infer_any(pack_infer_wave(entries))
+        assert len(rows) == 4
+        for e, row in zip(entries, rows):
+            single = unpack_infer_request(pack_infer_request(
+                e["id"], e["req"], e["key"], e["obs"], None,
+                session=e["sid"], reset=e["rst"], step=e["stp"]))
+            for k in ("id", "req", "sid", "rst", "stp", "win", "mask"):
+                assert row[k] == single[k], k
+            assert np.array_equal(row["obs"], single["obs"])
+            assert row["obs"].dtype == single["obs"].dtype
+            assert np.array_equal(row["key"], single["key"])
+        # A single frame still decodes through the same entry point.
+        assert unpack_infer_any(pack_infer_request(
+            "b", 9, entries[0]["key"], entries[0]["obs"],
+            None))[0]["req"] == 9
+
+    def test_wave_reply_rows_match_single_wire(self):
+        from relayrl_tpu.transport.serving import (
+            pack_action_reply,
+            pack_reply_wave,
+            unpack_infer_reply,
+            unpack_reply_any,
+        )
+
+        acts = np.asarray([2, 0, 1], np.int32)
+        keys = np.asarray(jax.random.split(jax.random.PRNGKey(1), 3))
+        aux = {"logp_a": np.asarray([-0.1, -0.2, -0.3], np.float32),
+               "v": np.asarray([0.5, 0.6, 0.7], np.float32)}
+        rows = unpack_reply_any(pack_reply_wave(
+            [11, 12, 13], 5, acts, keys, aux, ctx=16))
+        assert len(rows) == 3
+        for i, row in enumerate(rows):
+            single = unpack_infer_reply(pack_action_reply(
+                11 + i, 5, acts[i, ...], keys[i],
+                {k: v[i, ...] for k, v in aux.items()}, ctx=16))
+            assert row["req"] == single["req"]
+            assert row["code"] == single["code"] == 1
+            assert row["ver"] == single["ver"] == 5
+            assert row["ctx"] == single["ctx"] == 16
+            assert row["key"] == single["key"]  # raw key bytes, verbatim
+            assert np.array_equal(row["act"], single["act"])
+            assert row["act"].dtype == single["act"].dtype
+            assert row["act"].shape == single["act"].shape  # 0-d stays 0-d
+            for k in aux:
+                assert np.array_equal(row["aux"][k], single["aux"][k])
+                assert row["aux"][k].dtype == single["aux"][k].dtype
 
     def test_malformed_request_answers_error(self, tmp_cwd, fresh_registry):
         from relayrl_tpu.runtime.inference import InferenceService
@@ -347,19 +518,25 @@ class TestBatchingQueue:
         finally:
             svc.stop()
 
-    def test_sequence_policies_refused(self, tmp_cwd, fresh_registry):
-        from relayrl_tpu.models import build_policy
+    def test_sequence_requests_without_session_id_get_pointed_error(
+            self, tmp_cwd, fresh_registry):
+        """A v1 (session-less) request against a sequence policy answers
+        with an error naming serving.max_sessions — the serving-v2
+        replacement for the old constructor refusal."""
         from relayrl_tpu.runtime.inference import InferenceService
-        from relayrl_tpu.types.model_bundle import ModelBundle
 
-        arch = {"kind": "transformer_discrete", "obs_dim": 5, "act_dim": 3,
-                "d_model": 16, "n_layers": 1, "n_heads": 2,
-                "max_seq_len": 8}
-        policy = build_policy(arch)
-        bundle = ModelBundle(version=1, arch=dict(arch),
-                             params=policy.init_params(jax.random.PRNGKey(0)))
-        with pytest.raises(ValueError, match="sequence policies"):
-            InferenceService(bundle)
+        svc = InferenceService(_transformer_bundle(), max_batch=1,
+                               batch_timeout_ms=1.0)
+        svc.start()
+        try:
+            key = np.asarray(jax.random.PRNGKey(0))
+            obs = np.zeros(5, np.float32)
+            done, box = _submit(svc, key, obs)
+            assert done.wait(30)
+            assert box["reply"]["code"] == 0
+            assert "serving.max_sessions" in box["reply"]["error"]
+        finally:
+            svc.stop()
 
     def test_install_params_owns_memory(self, tmp_cwd, fresh_registry):
         """The colocated publish feed must copy: mutating the publisher's
@@ -421,6 +598,14 @@ def _bare_client(fake, infer_deadline_s=5.0, request_timeout_s=0.2):
     client._request_timeout_s = request_timeout_s
     client._infer_deadline_s = infer_deadline_s
     client.version = -1
+    client._session_id = "bare"
+    client._session_step = 0
+    client._episode_start = True
+    client._mirror = []
+    client._replica_addrs = None
+    client._replica_idx = 0
+    client._replica_fail_streak = 0
+    client._serving_overrides = {}
 
     class _T:
         identity = "bare"
@@ -430,6 +615,8 @@ def _bare_client(fake, infer_deadline_s=5.0, request_timeout_s=0.2):
     client._m_request_s = reg.histogram("relayrl_serving_client_request_seconds", "t")
     client._m_retries = reg.counter("relayrl_serving_client_retries_total", "t")
     client._m_nacked = reg.counter("relayrl_serving_client_nacked_total", "t")
+    client._m_resyncs = reg.counter("relayrl_serving_client_resyncs_total", "t")
+    client._m_reroutes = reg.counter("relayrl_serving_client_reroutes_total", "t")
     return client
 
 
@@ -672,8 +859,10 @@ class TestServedParityE2E:
 
 
 class TestFaultPlaneAndHeal:
-    # ISSUE 17 wall re-fit: per-site fault sweep rides the slow tier; the
-    # fast tier keeps the killed-service heal drill below.
+    # Wall re-fit: both single-service heal drills ride the slow tier —
+    # the fast tier's serving-heal representative is now the replica
+    # SIGKILL re-route drill in TestStreamingChannel (kills a live host,
+    # heals through re-route + session resync).
     @pytest.mark.slow
     def test_agent_infer_fault_site_drop_and_corrupt_heal(
             self, tmp_cwd, fresh_registry):
@@ -721,6 +910,7 @@ class TestFaultPlaneAndHeal:
             faults.install_plan(None)
             svc.stop()
 
+    @pytest.mark.slow
     def test_killed_service_heals_clients_without_wedging(
             self, tmp_cwd, fresh_registry):
         """The chaos drill: the inference service dies mid-run and
@@ -862,6 +1052,28 @@ class TestConfig:
         assert p["batch_timeout_ms"] == 0.0  # negative clamps to 0
         assert p["buckets"] is None          # malformed list → derived
         assert p["queue_limit"] == 1         # floor 1
+        # serving-v2 knob defaults ride along untouched
+        assert p["max_sessions"] == 4096
+        assert p["session_ttl_s"] == 600.0
+        assert p["stream_window"] == 32
+        assert p["replicas"] is None
+
+    def test_serving_v2_params_clamped(self, tmp_cwd):
+        from relayrl_tpu.config import ConfigLoader
+
+        cfg_path = tmp_cwd / "cfg.json"
+        cfg_path.write_text(json.dumps({"serving": {
+            "max_sessions": 0, "session_ttl_s": -3,
+            "stream_window": "bogus",
+            "replicas": ["tcp://a:1", "tcp://b:2"]}}))
+        p = ConfigLoader(None, str(cfg_path)).get_serving_params()
+        assert p["max_sessions"] == 1        # floor 1
+        assert p["session_ttl_s"] == 0.0     # negative clamps to 0 (off)
+        assert p["stream_window"] == 32      # malformed → default
+        assert p["replicas"] == ["tcp://a:1", "tcp://b:2"]
+        cfg_path.write_text(json.dumps({"serving": {"replicas": []}}))
+        p = ConfigLoader(None, str(cfg_path)).get_serving_params()
+        assert p["replicas"] is None         # empty list → single endpoint
 
     def test_bucket_list_covers_max_batch(self, tmp_cwd):
         from relayrl_tpu.config import ConfigLoader
@@ -898,3 +1110,320 @@ class TestConfig:
         cfg_path.write_text(json.dumps({"actor": {"host_mode": "remote"}}))
         p = ConfigLoader(None, str(cfg_path)).get_actor_params()
         assert p["host_mode"] == "remote"
+
+
+class TestServingSessions:
+    """Serving v2: the server-side session table (sequence policies)."""
+
+    def _svc(self, **kw):
+        from relayrl_tpu.runtime.inference import InferenceService
+
+        svc = InferenceService(_transformer_bundle(),
+                               max_batch=kw.pop("max_batch", 1),
+                               batch_timeout_ms=1.0, **kw)
+        svc.start()
+        return svc
+
+    def test_sequence_parity_across_episodes(self, tmp_cwd,
+                                             fresh_registry):
+        """The acceptance lock extended to sequence policies: a session
+        client's served action stream is bit-identical to a local
+        windowed PolicyActor at the same seed — across an episode
+        boundary (the server-side window must zero exactly where the
+        local one does)."""
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+
+        svc = self._svc()
+        try:
+            local = PolicyActor(_transformer_bundle(), seed=7,
+                                use_kv_cache=False)
+            drv = _SessionDriver(svc, "par", seed=7)
+            rng = np.random.default_rng(2)
+            for episode in range(2):
+                for _ in range(5):
+                    obs = rng.standard_normal(5).astype(np.float32)
+                    r1 = local.request_for_action(obs)
+                    r2 = drv.act(obs)
+                    assert np.array_equal(np.asarray(r1.act), r2["act"])
+                    for k in r1.data:
+                        assert np.array_equal(np.asarray(r1.data[k]),
+                                              r2["aux"][k]), (episode, k)
+                local.flag_last_action(1.0, terminated=True)
+                drv.end_episode()
+        finally:
+            svc.stop()
+
+    def test_idempotent_push_retry(self, tmp_cwd, fresh_registry):
+        """An at-least-once redelivery (same ``stp``) recomputes from
+        the current window WITHOUT re-pushing: identical action bytes,
+        and the next step still sees a single push."""
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+
+        svc = self._svc()
+        try:
+            local = PolicyActor(_transformer_bundle(), seed=3,
+                                use_kv_cache=False)
+            drv = _SessionDriver(svc, "retry", seed=3)
+            rng = np.random.default_rng(5)
+            obs = rng.standard_normal(5).astype(np.float32)
+            pre_key = drv.key.copy()
+            pre_reset = drv.episode_start
+            first = drv.act(obs)
+            # Retry the ORIGINAL payload (client timed out, the reply
+            # was lost): same cursor, same key, same reset flag.
+            drv.episode_start = pre_reset
+            replay = drv.raw(obs, step=drv.step, key=pre_key)
+            drv.episode_start = False
+            assert replay["code"] == 1
+            assert np.array_equal(first["act"], replay["act"])
+            assert replay["key"] == first["key"]
+            local.request_for_action(obs)
+            obs2 = rng.standard_normal(5).astype(np.float32)
+            r1 = local.request_for_action(obs2)
+            r2 = drv.act(obs2)
+            assert np.array_equal(np.asarray(r1.act), r2["act"]), \
+                "double-push corrupted the session window"
+        finally:
+            svc.stop()
+
+    def test_out_of_step_cursor_nacks(self, tmp_cwd, fresh_registry):
+        from relayrl_tpu.transport.base import NACK_SESSION_EVICTED
+
+        svc = self._svc()
+        try:
+            drv = _SessionDriver(svc, "skew", seed=1)
+            drv.act(np.zeros(5, np.float32))
+            reply = drv.raw(np.ones(5, np.float32), step=drv.step + 5)
+            assert reply["code"] == NACK_SESSION_EVICTED
+            assert svc._m_session_nacked.total() >= 1
+        finally:
+            svc.stop()
+
+    def test_lru_eviction_resync_bit_identical_continuation(
+            self, tmp_cwd, fresh_registry):
+        """max_sessions pressure evicts the LRU session; its client's
+        next request draws NACK_SESSION_EVICTED, resends its episode
+        window, and the CONTINUATION is bit-identical to an
+        uninterrupted local actor — eviction is a resync, never a lost
+        episode."""
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.transport.base import NACK_SESSION_EVICTED
+
+        svc = self._svc(max_sessions=2)
+        try:
+            local = PolicyActor(_transformer_bundle(), seed=11,
+                                use_kv_cache=False)
+            victim = _SessionDriver(svc, "victim", seed=11)
+            rng = np.random.default_rng(8)
+            seq = [rng.standard_normal(5).astype(np.float32)
+                   for _ in range(6)]
+            for obs in seq[:3]:
+                r1 = local.request_for_action(obs)
+                r2 = victim.act(obs)
+                assert np.array_equal(np.asarray(r1.act), r2["act"])
+            # Two fresher sessions push "victim" off the 2-entry table.
+            for name in ("fresh-a", "fresh-b"):
+                _SessionDriver(svc, name, seed=1).act(
+                    np.zeros(5, np.float32))
+            assert svc._m_evictions["lru"].total() >= 1
+            # Mid-episode request now draws the typed resync nack...
+            nack = victim.raw(seq[3])
+            assert nack["code"] == NACK_SESSION_EVICTED
+            # ...and the protocol-following client continues losslessly.
+            for obs in seq[3:]:
+                r1 = local.request_for_action(obs)
+                r2 = victim.act(obs)
+                assert np.array_equal(np.asarray(r1.act), r2["act"]), \
+                    "post-resync continuation diverged from local"
+            assert svc._m_resyncs.total() >= 1
+            assert svc.accounting()["sessions"] <= 2
+        finally:
+            svc.stop()
+
+    def test_ttl_expiry_reaps_idle_sessions(self, tmp_cwd,
+                                            fresh_registry):
+        svc = self._svc(session_ttl_s=0.05)
+        try:
+            idle = _SessionDriver(svc, "idle", seed=2)
+            idle.act(np.zeros(5, np.float32))
+            time.sleep(0.15)
+            # Any later batch sweeps the expired session out.
+            _SessionDriver(svc, "busy", seed=4).act(
+                np.ones(5, np.float32))
+            assert svc._m_evictions["ttl"].total() >= 1
+            assert "idle" not in svc._sessions
+        finally:
+            svc.stop()
+
+
+class TestStreamingChannel:
+    """Serving v2: the pipelined request channel and the multiplexed
+    client."""
+
+    def test_streamed_out_of_order_matches_lockstep(self, tmp_cwd,
+                                                    fresh_registry):
+        """N pipelined submits collected in REVERSE order decode to
+        byte-for-byte the replies the lock-step client gets for the same
+        payloads — out-of-order delivery is a scheduling change, not a
+        numerics change."""
+        from relayrl_tpu.runtime.inference import InferenceService
+        from relayrl_tpu.transport.serving import (
+            ZmqServingClient,
+            ZmqStreamingClient,
+            pack_infer_request,
+        )
+
+        svc = InferenceService(_reinforce_bundle(str(tmp_cwd)),
+                               max_batch=4, batch_timeout_ms=2.0)
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        svc.bind_zmq(addr)
+        svc.start()
+        stream = ZmqStreamingClient(addr)
+        serial = ZmqServingClient(addr)
+        try:
+            rng = np.random.default_rng(6)
+            payloads = []
+            for i in range(8):
+                key = np.asarray(jax.random.PRNGKey(100 + i))
+                obs = rng.standard_normal(6).astype(np.float32)
+                payloads.append(pack_infer_request(f"s{i}", i + 1, key,
+                                                   obs, None))
+            waiters = [stream.submit(p, i + 1)
+                       for i, p in enumerate(payloads)]
+            assert stream.inflight_high_water >= 2, \
+                "pipelined submits never overlapped"
+            streamed = [stream.wait(w, 30) for w in reversed(waiters)]
+            streamed.reverse()
+            lockstep = [serial.request(p, i + 1, 30)
+                        for i, p in enumerate(payloads)]
+            for i, (a, b) in enumerate(zip(streamed, lockstep)):
+                assert a["code"] == b["code"] == 1
+                assert np.array_equal(a["act"], b["act"]), i
+                assert a["key"] == b["key"], i
+                for k in a["aux"]:
+                    assert np.array_equal(a["aux"][k], b["aux"][k]), (i, k)
+        finally:
+            stream.close()
+            serial.close()
+            svc.stop()
+
+    def test_multiplexed_client_matches_local_actors(self, tmp_cwd,
+                                                     fresh_registry):
+        """One MultiplexedRemoteClient process driving 4 env lanes over
+        the live zmq stream channel produces, per lane, the exact action
+        stream of a local PolicyActor(seed=seed+lane) — and its episode
+        bytes ship through the standard trajectory plane unchanged."""
+        from relayrl_tpu.runtime.inference import MultiplexedRemoteClient
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+        from relayrl_tpu.types.model_bundle import ModelBundle
+
+        server, cfg_path, client_addrs = _serving_stack(
+            tmp_cwd, traj_per_epoch=10_000)
+        mux = None
+        try:
+            bundle = ModelBundle(
+                version=server.algorithm.version,
+                arch=dict(server.algorithm.bundle().arch),
+                params=server.algorithm.bundle().params)
+            lanes = 4
+            sent_local = [[] for _ in range(lanes)]
+            sent_mux = [[] for _ in range(lanes)]
+            locals_ = [PolicyActor(bundle, seed=40 + i,
+                                   on_send=sent_local[i].append)
+                       for i in range(lanes)]
+            mux = MultiplexedRemoteClient(config_path=cfg_path,
+                                          lanes=lanes, seed=40,
+                                          **client_addrs)
+            for i in range(lanes):
+                mux.trajectories[i]._on_send = sent_mux[i].append
+            rng = np.random.default_rng(17)
+            for step in range(6):
+                obs = [rng.standard_normal(6).astype(np.float32)
+                       for _ in range(lanes)]
+                rewards = None if step == 0 else [0.5] * lanes
+                recs = mux.request_for_actions(obs, rewards=rewards)
+                for i in range(lanes):
+                    r1 = locals_[i].request_for_action(
+                        obs[i], reward=0.0 if step == 0 else 0.5)
+                    assert np.array_equal(np.asarray(r1.act),
+                                          np.asarray(recs[i].act)), \
+                        (step, i)
+                    for k in r1.data:
+                        assert np.array_equal(
+                            np.asarray(r1.data[k]),
+                            np.asarray(recs[i].data[k])), (step, i, k)
+            assert mux.inflight_high_water >= 2, \
+                "multiplexed lanes never overlapped in flight"
+            for i in range(lanes):
+                locals_[i].flag_last_action(1.0, terminated=True)
+                mux.flag_last_action(i, 1.0, terminated=True)
+                assert sent_local[i] == sent_mux[i], \
+                    f"lane {i} episode bytes differ from local"
+        finally:
+            if mux is not None:
+                mux.disable_agent()
+            server.disable_server()
+
+    def test_replica_reroute_and_session_resync_after_kill(
+            self, tmp_cwd, fresh_registry):
+        """Two sequence-policy replicas; the client's session-affine home
+        replica dies mid-episode. The client rotates to the survivor,
+        answers its SESSION_EVICTED nack with the episode window, and the
+        action stream continues bit-identical to an uninterrupted local
+        windowed actor — replica death costs a resync round-trip, never
+        an episode."""
+        from relayrl_tpu.runtime.inference import (
+            InferenceService,
+            RemoteActorClient,
+        )
+        from relayrl_tpu.runtime.policy_actor import PolicyActor
+
+        server, _, client_addrs = _serving_stack(tmp_cwd)
+        cfg_path = os.path.join(str(tmp_cwd), "replica_cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"serving": {"enabled": True,
+                                   "request_timeout_s": 0.25,
+                                   "infer_deadline_s": 30.0},
+                       "actor": {"spool_entries": 64}}, f)
+        addrs = [f"tcp://127.0.0.1:{free_port()}" for _ in range(2)]
+        replicas = []
+        for addr in addrs:
+            svc = InferenceService(_transformer_bundle(), max_batch=4,
+                                   batch_timeout_ms=2.0)
+            svc.bind_zmq(addr)
+            svc.start()
+            replicas.append(svc)
+        client_addrs = {k: v for k, v in client_addrs.items()
+                        if k != "serving_addr"}
+        client = None
+        try:
+            local = PolicyActor(_transformer_bundle(), seed=13,
+                                use_kv_cache=False)
+            client = RemoteActorClient(
+                config_path=cfg_path, seed=13, identity="drill-thin",
+                serving_addrs=addrs, **client_addrs)
+            rng = np.random.default_rng(21)
+            seq = [rng.standard_normal(5).astype(np.float32)
+                   for _ in range(6)]
+            for obs in seq[:3]:
+                r1 = local.request_for_action(obs)
+                r2 = client.request_for_action(obs)
+                assert np.array_equal(np.asarray(r1.act),
+                                      np.asarray(r2.act))
+            home = client._replica_idx
+            replicas[home].stop()  # the drill: home replica dies
+            for obs in seq[3:]:
+                r1 = local.request_for_action(obs)
+                r2 = client.request_for_action(obs)
+                assert np.array_equal(np.asarray(r1.act),
+                                      np.asarray(r2.act)), \
+                    "post-re-route continuation diverged from local"
+            assert client._replica_idx != home
+            assert client._m_reroutes.total() >= 1
+            assert client._m_resyncs.total() >= 1
+        finally:
+            if client is not None:
+                client.disable_agent()
+            for svc in replicas:
+                svc.stop()
+            server.disable_server()
