@@ -38,25 +38,48 @@ from common import bench_cwd, emit, quick, setup_platform
 setup_platform()
 
 
-def _bundle(obs_dim=4, act_dim=2, hidden=(32, 32)):
+def _bundle(obs_dim=4, act_dim=2, hidden=(32, 32), policy="mlp",
+            max_seq_len=8):
     import jax
 
     from relayrl_tpu.models import build_policy
     from relayrl_tpu.types.model_bundle import ModelBundle
 
-    arch = {"kind": "mlp_discrete", "obs_dim": obs_dim, "act_dim": act_dim,
-            "hidden_sizes": list(hidden)}
-    policy = build_policy(arch)
+    if policy == "transformer":
+        # Windowed sequence policy (ISSUE 20): the vector tier serves it
+        # through the batched step_window path, the fused tier through
+        # the rolling-window scan carry — same W=max_seq_len ring rule.
+        # W=8 matches the RLHF plane's transformer (prompt 2 + 6 new
+        # tokens), the workload class this axis exists to size.
+        arch = {"kind": "transformer_discrete", "obs_dim": obs_dim,
+                "act_dim": act_dim, "d_model": 32, "n_layers": 2,
+                "n_heads": 2, "max_seq_len": max_seq_len}
+    elif policy == "transformer_small":
+        # The drill-shaped end of the axis (the d16 L1 model the chaos
+        # and parity suites run): per-step attention compute no longer
+        # swamps the scan, so this cell shows the dispatch-overhead win
+        # the fused tier was built for, where d32 L2 above shows the
+        # compute-bound floor the no-cache recompute converges to.
+        arch = {"kind": "transformer_discrete", "obs_dim": obs_dim,
+                "act_dim": act_dim, "d_model": 16, "n_layers": 1,
+                "n_heads": 2, "max_seq_len": max_seq_len}
+    else:
+        arch = {"kind": "mlp_discrete", "obs_dim": obs_dim,
+                "act_dim": act_dim, "hidden_sizes": list(hidden)}
+    pol = build_policy(arch)
     return ModelBundle(version=0, arch=arch,
-                       params=policy.init_params(jax.random.PRNGKey(0)))
+                       params=pol.init_params(jax.random.PRNGKey(0)))
 
 
-def run_vector_baseline(lanes: int, min_steps: int = 4000,
+def run_vector_baseline(lanes: int, policy: str = "mlp",
+                        min_steps: int = 4000,
                         min_wall_s: float = 2.0) -> dict:
     """Host-bound reference: VectorActorHost over SyncVectorEnv CartPole,
     measured over whole run_vector_gym_loop batches (includes the numpy
     env loop and per-step record assembly — the real per-step cost a
-    driver pays on this path)."""
+    driver pays on this path). ``policy="transformer"`` measures the
+    batched step_window serving path (host-side window push + full
+    attention recompute per step)."""
     from relayrl_tpu.envs import CartPoleEnv, SyncVectorEnv
     from relayrl_tpu.runtime.vector_actor import (
         VectorActorHost,
@@ -64,7 +87,7 @@ def run_vector_baseline(lanes: int, min_steps: int = 4000,
     )
 
     sink = []
-    host = VectorActorHost(_bundle(), num_envs=lanes,
+    host = VectorActorHost(_bundle(policy=policy), num_envs=lanes,
                            on_send=lambda lane, p: sink.append(len(p)))
     venv = SyncVectorEnv([CartPoleEnv for _ in range(lanes)])
     run_vector_gym_loop(host, venv, steps=32, seed=0)  # warmup + compile
@@ -76,12 +99,13 @@ def run_vector_baseline(lanes: int, min_steps: int = 4000,
         steps += chunk
         total += chunk * lanes
     wall = time.perf_counter() - t0
-    return {"lanes": lanes, "env_steps_total": total,
+    return {"lanes": lanes, "policy": policy, "env_steps_total": total,
             "env_steps_per_sec": round(total / wall, 1),
             "payloads": len(sink)}
 
 
 def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
+               policy: str = "mlp",
                async_emit: bool = False, coalesce: int = 1,
                min_steps: int = 20000, min_wall_s: float = 2.0) -> dict:
     """Fused rollout at (lanes, unroll, wire): the full
@@ -97,8 +121,8 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
     from relayrl_tpu.runtime.anakin import AnakinActorHost
 
     sink: list[bytes] = []
-    host = AnakinActorHost(_bundle(), "CartPole-v1", num_envs=lanes,
-                           unroll_length=unroll,
+    host = AnakinActorHost(_bundle(policy=policy), "CartPole-v1",
+                           num_envs=lanes, unroll_length=unroll,
                            columnar_wire=(wire == "columnar"),
                            async_emit=async_emit,
                            emit_coalesce_frames=coalesce,
@@ -167,6 +191,7 @@ def run_anakin(lanes: int, unroll: int, wire: str = "columnar",
     host_key = "encode" if wire == "columnar" else "unstack"
     return {
         "lanes": lanes, "unroll_length": unroll, "wire": wire,
+        "policy": policy,
         "emit": "async" if async_emit else "sync",
         "emit_coalesce_frames": coalesce,
         "windows": windows, "env_steps_total": total,
@@ -242,6 +267,55 @@ def main():
                                  > best["rollout_steps_per_sec"])):
                     best = row
 
+    # The sequence-policy axis (ISSUE 20): the SAME shootout with a
+    # windowed transformer — vector tier serves batched step_window
+    # (host window push + one attention recompute per env step), the
+    # fused tier carries the rolling window inside the scan. Columnar
+    # sync emit only: the wire-form/emitter A/Bs above are policy-
+    # agnostic host costs.
+    seq_variants = [
+        ("transformer", "transformer_discrete d32 L2 h2 W8 (rlhf-shaped)"),
+        ("transformer_small",
+         "transformer_discrete d16 L1 h2 W8 (drill-shaped)"),
+    ]
+    seq_unrolls = [32] if is_quick else [32, 128]
+    seq_vector_rates: dict[tuple[str, int], float] = {}
+    seq_best_e2e: dict[tuple[str, int], float] = {}
+    seq_best_rollout: dict[tuple[str, int], float] = {}
+    for policy, _desc in seq_variants:
+        for lanes in lanes_grid:
+            row = run_vector_baseline(
+                lanes, policy=policy,
+                min_steps=1000 if is_quick else 4000,
+                min_wall_s=0.5 if is_quick else 2.0)
+            seq_vector_rates[policy, lanes] = row["env_steps_per_sec"]
+            emit("anakin_vector_baseline",
+                 {"lanes": lanes, "policy": policy},
+                 row["env_steps_per_sec"], "env_steps/s")
+            rows.append({"bench": "anakin_vector_baseline", **row})
+        for lanes in lanes_grid:
+            for unroll in seq_unrolls:
+                row = run_anakin(
+                    lanes, unroll, wire="columnar", policy=policy,
+                    min_steps=2000 if is_quick else 20000,
+                    min_wall_s=0.5 if is_quick else 2.0)
+                base = seq_vector_rates[policy, lanes]
+                row["speedup_rollout_vs_vector"] = round(
+                    row["rollout_steps_per_sec"] / base, 1)
+                row["speedup_e2e_vs_vector"] = round(
+                    row["e2e_steps_per_sec"] / base, 1)
+                emit("anakin_fused_rollout",
+                     {"lanes": lanes, "unroll": unroll, "wire": "columnar",
+                      "policy": policy},
+                     row["e2e_steps_per_sec"], "env_steps/s")
+                rows.append({"bench": "anakin_fused_rollout", **row})
+                cell = (policy, lanes)
+                seq_best_e2e[cell] = max(seq_best_e2e.get(cell, 0.0),
+                                         row["e2e_steps_per_sec"])
+                seq_best_rollout[cell] = max(
+                    seq_best_rollout.get(cell, 0.0),
+                    row["rollout_steps_per_sec"])
+
     headline = {
         "bench": "anakin_headline",
         "config": {"env": "CartPole-v1", "policy": "mlp_discrete 32x32",
@@ -256,6 +330,7 @@ def main():
             str(lanes): round(
                 max(r["rollout_steps_per_sec"] for r in rows
                     if r["bench"] == "anakin_fused_rollout"
+                    and r["policy"] == "mlp"
                     and r["lanes"] == lanes) / vector_rates[lanes], 1)
             for lanes in lanes_grid},
         # ISSUE 9's acceptance ratio: columnar-wire e2e vs per-record
@@ -263,6 +338,7 @@ def main():
         "best_e2e_columnar": max(
             (r["e2e_steps_per_sec"] for r in rows
              if r["bench"] == "anakin_fused_rollout"
+             and r["policy"] == "mlp"
              and r["wire"] == "columnar"), default=None),
         "speedup_columnar_e2e_vs_records": {
             f"{lanes}x{unroll}": round(cell["columnar"] / cell["records"], 2)
@@ -285,6 +361,37 @@ def main():
                 cell["columnar_coalesce"] / cell["columnar"], 2)
             for (lanes, unroll), cell in sorted(e2e_by_cell.items())
             if cell.get("columnar_coalesce") and cell.get("columnar")},
+        # ISSUE 20's acceptance ratio: fused windowed-transformer e2e vs
+        # the vector tier's batched step_window e2e at the SAME lane
+        # count (the 64-lane cell is the acceptance gate: >= 5x — met by
+        # the drill-shaped model; the rlhf-shaped d32 L2 cell shows the
+        # compute-bound floor the no-cache window recompute converges
+        # to as per-step attention grows).
+        "transformer": {
+            "speedup_e2e_at_equal_lanes": {
+                str(lanes): round(max(
+                    seq_best_e2e[policy, lanes]
+                    / seq_vector_rates[policy, lanes]
+                    for policy, _ in seq_variants), 1)
+                for lanes in lanes_grid},
+            "variants": {
+                desc: {
+                    "vector_step_window_env_steps_per_sec": {
+                        str(lanes): seq_vector_rates[policy, lanes]
+                        for lanes in lanes_grid},
+                    "speedup_e2e_at_equal_lanes": {
+                        str(lanes): round(seq_best_e2e[policy, lanes]
+                                          / seq_vector_rates[policy,
+                                                             lanes], 1)
+                        for lanes in lanes_grid},
+                    "speedup_rollout_at_equal_lanes": {
+                        str(lanes): round(seq_best_rollout[policy, lanes]
+                                          / seq_vector_rates[policy,
+                                                             lanes], 1)
+                        for lanes in lanes_grid},
+                }
+                for policy, desc in seq_variants},
+        },
         "note": ("columnar wire (ISSUE 9): whole rollout segments ship "
                  "as contiguous frames — the per-step record assembly + "
                  "per-record msgpack that bounded e2e is gone; every row "

@@ -188,6 +188,16 @@ DEFAULT_CONFIG: dict = {
         # On-device env id for the anakin tier, resolved through the JAX
         # env registry (envs/jax/__init__.py; see envs.list_envs()).
         "jax_env": "CartPole-v1",
+        # Rolling observation-window rows for sequence policies
+        # (windowed transformers), shared by every tier that serves
+        # them: the vector host's stacked per-lane windows, the serving
+        # plane's session windows, and the anakin scan carry. null (the
+        # default) uses the model's full serving context
+        # (min(actor_context, max_seq_len)); an explicit value narrows
+        # it — it is clamped to [1, model context], never widened.
+        # Narrower windows cut the fused step's attention cost
+        # (O(W^2 d) per step) at the price of shorter memory.
+        "window_size": None,
         # Anakin host shave (ROADMAP item 1): move the frame
         # encode/unstack + send onto a dedicated emitter thread so it
         # overlaps the next window's device dispatch (bounded depth-2
@@ -496,11 +506,29 @@ DEFAULT_CONFIG: dict = {
         # step_window width for sequence policies).
         "lanes": 4,
         # "vector" = local batched generation (sequence policies: the
-        # vmapped step_window path); "remote" = thin clients against the
-        # serving plane (serving.enabled on the training server) —
-        # sequence policies serve through the per-session window table;
-        # keep serving.max_sessions at or above the lane count.
+        # vmapped step_window path); "anakin" = fused on-device
+        # generation (runtime/anakin.py): TokenGen-v0 runs inside the
+        # lax.scan with the rolling-window carry, so generate throughput
+        # is fused tokens/s instead of per-step round-trips — per-token
+        # logp_a/bver evidence still rides each record and episodes
+        # still withhold/score/re-inject through the interceptor seam;
+        # "remote" = thin clients against the serving plane
+        # (serving.enabled on the training server) — sequence policies
+        # serve through the per-session window table; keep
+        # serving.max_sessions at or above the lane count.
         "generation_tier": "vector",
+        # Fused-tier scan length: env steps (= tokens) per lane per
+        # rollout dispatch when generation_tier is "anakin". One
+        # dispatch emits `lanes x generation_unroll` tokens under ONE
+        # behavior version, so this is the burst size the pacing loop
+        # and the learner's queue see — a whole actor.unroll_length
+        # window (32) at short TokenGen episodes is ~50-100 episodes
+        # per burst, which blows straight through
+        # max_episodes_per_version inside a single dispatch and trains
+        # the learner on 100+-version-stale data. Keep it near
+        # max_new_tokens (about one episode per lane per dispatch);
+        # raise it only if dispatch overhead dominates generate time.
+        "generation_unroll": 8,
         # Bounded-staleness pacing: once this many episodes have been
         # scored under ONE behavior version, generation pauses until a
         # newer model swap lands (or pace_timeout_s passes — a dead

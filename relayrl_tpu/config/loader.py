@@ -294,6 +294,17 @@ class ConfigLoader:
         jax_env = params.get("jax_env")
         params["jax_env"] = (str(jax_env) if jax_env
                              else DEFAULT_CONFIG["actor"]["jax_env"])
+        # window_size: None defers to the model's serving context
+        # (resolve_actor_context); an explicit value narrows the rolling
+        # window and is clamped to >= 1. The hosts clamp it to the model
+        # context again at build time — config cannot widen past it.
+        ws = params.get("window_size")
+        if ws is not None:
+            try:
+                ws = max(1, int(ws))
+            except (TypeError, ValueError):
+                ws = None
+        params["window_size"] = ws
         params["async_emit"] = bool(params.get("async_emit", False))
         try:
             params["emit_coalesce_frames"] = max(1, int(
@@ -542,6 +553,7 @@ class ConfigLoader:
                                  ("rm_n_layers", 1, 1),
                                  ("rm_seed", 7, 0),
                                  ("lanes", 4, 1),
+                                 ("generation_unroll", 8, 1),
                                  ("score_batch", 8, 1),
                                  ("score_queue", 256, 1),
                                  ("max_episodes_per_version", 64, 0)):
@@ -557,7 +569,8 @@ class ConfigLoader:
             params["pace_timeout_s"] = 5.0
         if params.get("scorer") not in ("programmatic", "reward_model"):
             params["scorer"] = "programmatic"
-        if params.get("generation_tier") not in ("vector", "remote"):
+        if params.get("generation_tier") not in ("vector", "remote",
+                                                 "anakin"):
             params["generation_tier"] = "vector"
         return params
 

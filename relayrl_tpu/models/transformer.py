@@ -117,7 +117,7 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 2
 
     @nn.compact
-    def __call__(self, x, cache=None, t=None):
+    def __call__(self, x, cache=None, t=None, readout_idx=None):
         """Full mode (``cache=None``): x ``[B, T, d]`` -> ``[B, T, d]``.
 
         Decode mode: x is ONE position ``[B, 1, d]``; ``cache`` is this
@@ -126,7 +126,17 @@ class TransformerBlock(nn.Module):
         instead of recomputing the whole window — O(W) per step vs the
         window path's O(W^2). Returns ``(out, new_cache)``. Param
         names/creation order are identical in both modes (init always runs
-        the full path), so one param tree serves both."""
+        the full path), so one param tree serves both.
+
+        Readout mode (``readout_idx`` set, final layer of the window
+        path): x is the full window ``[B, W, d]`` but only row
+        ``readout_idx`` is ever read by the heads, so k/v project over
+        every row (earlier positions must still be attended) while the
+        query, attention-output projection, and MLP run for the ONE
+        readout row — the dead (W-1)/W of the final block's compute that
+        the full path pays per actor step. Returns ``[B, 1, d]``. The
+        row's attention is computed densely (a 1-row query is trivially
+        dense; every backend computes the same causal function)."""
         B, T, _ = x.shape
         head_dim = self.d_model // self.n_heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
@@ -136,6 +146,22 @@ class TransformerBlock(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, T, self.n_heads, head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
+        if readout_idx is not None:
+            q_row = jax.lax.dynamic_slice_in_dim(q, readout_idx, 1, axis=1)
+            attn = dense_attention(q_row, k, v, causal=True,
+                                   q_offset=readout_idx)
+            attn = attn.reshape(B, 1, self.d_model)
+            x = jax.lax.dynamic_slice_in_dim(x, readout_idx, 1, axis=1)
+            x = x + nn.Dense(self.d_model, dtype=self.compute_dtype,
+                             name="attn_out")(attn).astype(x.dtype)
+            h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+            h = h.astype(self.compute_dtype)
+            h = nn.Dense(self.mlp_ratio * self.d_model,
+                         dtype=self.compute_dtype, name="mlp_up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                         name="mlp_down")(h)
+            return x + h.astype(x.dtype)
         if cache is None:
             attn = self.attn_fn(q, k, v)
             new_cache = None
@@ -225,15 +251,46 @@ class TransformerCore(nn.Module):
     moe_top_k: int = 2
 
     @nn.compact
-    def __call__(self, obs, mask=None, cache=None, t=None):
+    def __call__(self, obs, mask=None, cache=None, t=None, readout_t=None):
         """Full mode: obs ``[B, T, D]`` -> (logits, v). Decode mode
         (``cache`` = tuple of per-layer (k, v) pairs, ``t`` = position):
         obs is ``[B, 1, D]``; returns ``((logits, v), new_cache)`` for the
-        single position. Init always traces the full path, so both modes
-        share one param tree."""
+        single position. Readout mode (``readout_t`` = dynamic row index):
+        obs is a full window ``[B, W, D]`` but only position ``readout_t``
+        is decoded — layers ``0..L-2`` run over every row (deeper layers
+        attend all earlier positions' hidden states, so those are live),
+        the final layer runs row-only (its other rows feed nothing), and
+        the heads see the one row; returns ``(logits[B, A], v[B])``. Init
+        always traces the full path, so all modes share one param tree."""
         decode = cache is not None
         x = _embed_obs(self, obs, self.d_model, self.max_seq_len,
                        start=t if decode else 0)
+        if readout_t is not None:
+            idx = jnp.asarray(readout_t, jnp.int32)
+            for i in range(self.n_layers - 1):
+                x = TransformerBlock(
+                    self.d_model, self.n_heads, self.mlp_ratio,
+                    self.attn_fn, self.compute_dtype,
+                    moe_experts=self.moe_experts,
+                    moe_top_k=self.moe_top_k, name=f"block_{i}")(x)
+            final = TransformerBlock(
+                self.d_model, self.n_heads, self.mlp_ratio, self.attn_fn,
+                self.compute_dtype, moe_experts=self.moe_experts,
+                moe_top_k=self.moe_top_k,
+                name=f"block_{self.n_layers - 1}")
+            if self.moe_experts > 0:
+                # MoE routing is a cross-token decision — no per-row
+                # shortcut; run the block whole and slice the row.
+                x = jax.lax.dynamic_slice_in_dim(final(x), idx, 1, axis=1)
+            else:
+                x = final(x, readout_idx=idx)
+            mask_row = None
+            if mask is not None:
+                mask_row = jax.lax.dynamic_slice_in_dim(mask, idx, 1,
+                                                        axis=1)
+            logits, v = _readout_heads(x, mask_row, self.act_dim,
+                                       self.d_model, self.has_critic)
+            return logits[:, 0], v[:, 0]
         new_cache = []
         for i in range(self.n_layers):
             block = TransformerBlock(
@@ -266,10 +323,22 @@ def _as_btd(obs, mask):
     return obs, mask, lead
 
 
-def _policy_from_apply(arch: Mapping[str, Any], init_params, apply_fn) -> Policy:
+def _policy_from_apply(arch: Mapping[str, Any], init_params, apply_fn,
+                       apply_row_fn=None) -> Policy:
     """Build the sequence-policy ABI (step/evaluate/mode/windowed variants)
     over any ``apply_fn(params, obs[B,T,D], mask) -> (logits[B,T,A],
-    v[B,T])`` — shared by the plain and pipeline transformer families."""
+    v[B,T])`` — shared by the plain and pipeline transformer families.
+
+    ``apply_row_fn(params, obs[B,W,D], mask, idx) -> (logits[B,A], v[B])``
+    is the optional readout-row-only forward for the window paths
+    (step_window/mode_window): the full forward computes logits for every
+    window row and reads one, so a family that can decode just the
+    readout row (TransformerCore readout mode) skips the final layer's
+    dead (W-1)/W — the per-step win every window-driven actor tier
+    (vector batched step_window, serving sessions, the fused anakin scan)
+    inherits from this one seam, which is also what keeps their bytes
+    identical to each other. Families without a row decode (the pipeline
+    family's staged apply) omit it and keep the full-forward readout."""
 
     def step(params, rng, obs, mask=None):
         obs, mask, lead = _as_btd(obs, mask)
@@ -303,8 +372,11 @@ def _policy_from_apply(arch: Mapping[str, Any], init_params, apply_fn) -> Policy
 
     def _window_logits(params, window, t, mask):
         obs_b, mask_b, _ = _as_btd(window, mask)
-        logits, v = apply_fn(params, obs_b, mask_b)
         idx = jnp.clip(t - 1, 0, obs_b.shape[1] - 1)
+        if apply_row_fn is not None:
+            logits_r, v_r = apply_row_fn(params, obs_b, mask_b, idx)
+            return logits_r[0], v_r[0]
+        logits, v = apply_fn(params, obs_b, mask_b)
         return logits[0, idx], v[0, idx]
 
     def step_window(params, rng, window, t, mask=None):
@@ -402,7 +474,10 @@ def _build_core_policy(arch: Mapping[str, Any], moe_experts: int = 0) -> Policy:
         _, new_cache = core.apply(params, window, None, cache=cache, t=0)
         return new_cache
 
-    policy = _policy_from_apply(arch, init_params, core.apply)
+    policy = _policy_from_apply(
+        arch, init_params, core.apply,
+        apply_row_fn=lambda params, obs, mask, idx: core.apply(
+            params, obs, mask, readout_t=idx))
     import dataclasses as _dc
 
     return _dc.replace(policy, init_cache=init_cache,

@@ -9,7 +9,11 @@ Stage map — every stage rides machinery that already exists:
   Sequence (transformer) policies run the vector tier's vmapped
   ``step_window`` path (``runtime/vector_actor.py`` — generation through
   this stage is BIT-identical to a local ``PolicyActor`` at the same
-  seed + params version, the lock tests/test_rlhf.py holds); thin-client
+  seed + params version, the lock tests/test_rlhf.py holds);
+  ``rlhf.generation_tier: "anakin"`` moves generation INSIDE the fused
+  scan (:class:`FusedGenerationStage` — TokenGen as pure JAX in the
+  ``lax.scan`` with the rolling-window carry, ``lanes × unroll`` tokens
+  per device dispatch instead of one per-step round-trip); thin-client
   generation via the serving plane serves sequence policies too since
   serving v2 — the service holds each lane's rolling window in its
   session table, capacity bounded by ``serving.max_sessions`` (size it
@@ -52,6 +56,12 @@ from typing import Callable
 
 import numpy as np
 
+from relayrl_tpu.types.columnar import (
+    DecodedTrajectory,
+    encode_columnar_frame,
+    is_columnar_frame,
+    parse_frame,
+)
 from relayrl_tpu.types.trajectory import (
     deserialize_actions,
     serialize_actions,
@@ -86,6 +96,33 @@ def extract_generation(records, prompt_len: int):
             f"({tokens.shape[0]} with prompt_len {prompt_len})")
     tokens[write] = int(np.asarray(last.act).reshape(-1)[0])
     return tokens, gen_len, marker
+
+
+def extract_generation_frame(dt: DecodedTrajectory, prompt_len: int):
+    """Columnar twin of :func:`extract_generation`: one decoded frame
+    (the anakin tier ships whole episodes as contiguous columnar frames,
+    markers pre-folded) → ``(tokens[i32], gen_len)``. The terminal
+    marker is folded into the frame (``n_records == n_steps + 1``), so
+    there is no marker object to patch — the score lands on ``r[-1]``
+    directly, which is exactly where the server's native decoder folds a
+    scored marker's reward."""
+    if dt.n_steps < 1:
+        raise ValueError("frame has no real steps to score")
+    if dt.n_records != dt.n_steps + 1:
+        raise ValueError(
+            f"frame is not one terminated episode (n_steps {dt.n_steps}, "
+            f"n_records {dt.n_records}) — the score stage patches the "
+            f"folded terminal reward, which a mid-episode chunk lacks")
+    gen_len = int(dt.n_steps)
+    tokens = np.asarray(
+        dt.columns["o"][-1]).astype(np.int32).reshape(-1).copy()
+    write = int(prompt_len) + gen_len - 1
+    if write >= tokens.shape[0]:
+        raise ValueError(
+            f"generation of {gen_len} tokens overflows the context window "
+            f"({tokens.shape[0]} with prompt_len {prompt_len})")
+    tokens[write] = int(np.asarray(dt.columns["a"][-1]).reshape(-1)[0])
+    return tokens, gen_len
 
 
 class ScoreStage:
@@ -206,10 +243,21 @@ class ScoreStage:
                 t0 = time.monotonic()
                 episodes = []
                 for lane, payload in batch:
-                    records = deserialize_actions(payload)
-                    tokens, gen_len, marker = extract_generation(
-                        records, self.prompt_len)
-                    episodes.append((lane, records, tokens, gen_len, marker))
+                    if is_columnar_frame(payload):
+                        # Anakin-tier generation: one whole episode per
+                        # frame, markers pre-folded. The decoded frame
+                        # stands in for the record list; the marker slot
+                        # is None (the terminal reward lives in r[-1]).
+                        dt = parse_frame(payload)
+                        tokens, gen_len = extract_generation_frame(
+                            dt, self.prompt_len)
+                        episodes.append((lane, dt, tokens, gen_len, None))
+                    else:
+                        records = deserialize_actions(payload)
+                        tokens, gen_len, marker = extract_generation(
+                            records, self.prompt_len)
+                        episodes.append(
+                            (lane, records, tokens, gen_len, marker))
                 scores = self._score_batch(episodes)
                 self._m_score_s.observe(time.monotonic() - t0)
                 if trace_id:
@@ -221,18 +269,40 @@ class ScoreStage:
                         if self.version_fn is not None else None)
                 for (lane, records, _tok, _gl, marker), score in zip(
                         episodes, scores):
-                    if marker is not None:
-                        marker.update_reward(float(score))
-                    else:  # defensive: episode ended without a marker
-                        records[-1].update_reward(
-                            records[-1].rew + float(score))
-                    if held is not None:
-                        for r in records:
-                            bver = (r.data or {}).get("bver")
-                            if bver is not None:
-                                self._m_lag.observe(
-                                    max(0, held - int(bver)))
-                    self.emit_fn(lane, serialize_actions(records))
+                    if isinstance(records, DecodedTrajectory):
+                        # Columnar patch: the marker is folded, so the
+                        # score IS the terminal row's reward (the
+                        # terminal record's own rew is always masked to
+                        # 0 — "the reward rides the marker" — and
+                        # update_reward REPLACES, so folded terminal =
+                        # 0 + score). ``u`` stays untouched: u[-1]=0
+                        # mirrors the per-record fold exactly.
+                        r_col = np.array(records.columns["r"], copy=True)
+                        r_col[-1] = r_col.dtype.type(score)
+                        records.columns = dict(records.columns)
+                        records.columns["r"] = r_col
+                        if held is not None:
+                            bvers = records.aux.get("bver")
+                            if bvers is not None:
+                                for bver in np.asarray(
+                                        bvers).reshape(-1).tolist():
+                                    self._m_lag.observe(
+                                        max(0, held - int(bver)))
+                        payload_out = encode_columnar_frame(records)
+                    else:
+                        if marker is not None:
+                            marker.update_reward(float(score))
+                        else:  # defensive: episode ended without a marker
+                            records[-1].update_reward(
+                                records[-1].rew + float(score))
+                        if held is not None:
+                            for r in records:
+                                bver = (r.data or {}).get("bver")
+                                if bver is not None:
+                                    self._m_lag.observe(
+                                        max(0, held - int(bver)))
+                        payload_out = serialize_actions(records)
+                    self.emit_fn(lane, payload_out)
                     self._m_scored.inc()
                     with self._scored_lock:
                         self.scored.append(float(score))
@@ -335,6 +405,52 @@ class GenerationStage:
         return done
 
 
+class FusedGenerationStage:
+    """Anakin-tier generate stage (``rlhf.generation_tier: "anakin"``):
+    generation happens INSIDE the fused scan — TokenGen runs as pure JAX
+    in the ``lax.scan`` with the rolling-window carry, so one
+    ``rollout()`` dispatch produces ``lanes × unroll_length`` tokens
+    with zero per-token host round-trips. ``bver`` is stamped at unstack
+    (``record_bver=True`` — the whole window is one model version by
+    construction) and ``logp_a`` rides each record's aux as everywhere
+    else, so the per-token behavior evidence the V-trace correction and
+    the lag histogram read is identical to the vector tier's. Episodes
+    still leave through the interceptor seam (withheld → scored →
+    re-injected); this object only drives rollouts and keeps the pacing
+    loop's accounting surface (``host``/``episodes_done``/
+    ``run_round``/``tokens_generated``)."""
+
+    def __init__(self, agent):
+        from relayrl_tpu import telemetry
+
+        self.agent = agent
+        self.host = agent.host
+        self.episodes_done = 0
+        self.tokens_generated = 0
+        reg = telemetry.get_registry()
+        self._m_tokens = reg.counter(
+            "relayrl_rlhf_generated_tokens_total",
+            "tokens generated (one per lane per batched dispatch)")
+        self._m_gen_s = reg.histogram(
+            "relayrl_rlhf_stage_seconds",
+            "wall seconds per stage dispatch on the RLHF dataflow",
+            labels={"stage": "generate"})
+
+    def run_round(self) -> int:
+        """One fused window: ``lanes × unroll_length`` tokens in a
+        single device dispatch. Returns completed episodes (TokenGen
+        ends every episode as ``terminated``, so in-scan autoreset
+        starts the next prompt without leaving the device)."""
+        t0 = time.monotonic()
+        stats = self.agent.rollout()
+        self._m_tokens.inc(int(stats["steps"]))
+        self._m_gen_s.observe(time.monotonic() - t0)
+        self.tokens_generated += int(stats["steps"])
+        done = int(stats["episodes"])
+        self.episodes_done += done
+        return done
+
+
 class _RemoteLanes:
     """Thin-client generation tier: N ``RemoteActorClient`` lanes against
     the serving plane, adapted to the batched actor-host surface the
@@ -414,13 +530,18 @@ class RlhfScheduler:
 
         # Env lanes run scorer-less: the terminal reward is the score
         # stage's to assign (the whole point of the decoupled dataflow).
-        def env_fn():
-            return TokenGenEnv(vocab_size=p["vocab_size"],
-                               prompt_len=p["prompt_len"],
-                               max_new_tokens=p["max_new_tokens"],
-                               scorer=None)
+        # The anakin tier has no host-side envs at all — TokenGen runs
+        # as pure JAX inside the fused scan.
+        if self.tier == "anakin":
+            self.venv = None
+        else:
+            def env_fn():
+                return TokenGenEnv(vocab_size=p["vocab_size"],
+                                   prompt_len=p["prompt_len"],
+                                   max_new_tokens=p["max_new_tokens"],
+                                   scorer=None)
 
-        self.venv = SyncVectorEnv([env_fn for _ in range(self.lanes)])
+            self.venv = SyncVectorEnv([env_fn for _ in range(self.lanes)])
 
         if self.tier == "remote":
             from relayrl_tpu.runtime.inference import RemoteActorClient
@@ -446,6 +567,31 @@ class RlhfScheduler:
                     lambda payload, _k=k: self._withhold(_k, payload))
             self._emit = lambda lane, payload: sends[lane](payload)
             version_fn = lambda: host.version  # noqa: E731
+        elif self.tier == "anakin":
+            from relayrl_tpu.runtime.agent import VectorAgent
+
+            # Fused generation: TokenGen-v0 inside the scan, whole
+            # episodes shipped as columnar frames (the anakin default),
+            # bver stamped at unstack. The interceptor seam is the SAME
+            # one the vector tier uses — withheld episodes come back
+            # through emit_lane with spool seqs assigned at emission, so
+            # the at-least-once window only ever holds scored bytes.
+            self.agent = VectorAgent(
+                num_envs=self.lanes, server_type=server_type, seed=seed,
+                identity=identity, host_mode="anakin",
+                unroll_length=p["generation_unroll"],
+                jax_env="TokenGen-v0",
+                jax_env_kwargs={"vocab_size": p["vocab_size"],
+                                "prompt_len": p["prompt_len"],
+                                "max_new_tokens": p["max_new_tokens"]},
+                record_bver=True,
+                handshake_timeout_s=handshake_timeout_s,
+                send_interceptor=self._withhold, rng_keys=rng_keys,
+                config_path=config_path, **addr_overrides)
+            self._clients = []
+            host = self.agent.host
+            self._emit = self.agent.emit_lane
+            version_fn = lambda: self.agent.host.version  # noqa: E731
         else:
             from relayrl_tpu.runtime.agent import VectorAgent
 
@@ -464,7 +610,9 @@ class RlhfScheduler:
             self.scorer, prompt_len=p["prompt_len"], emit_fn=self._emit,
             batch=p["score_batch"], max_queue=p["score_queue"],
             version_fn=version_fn)
-        self.generation = GenerationStage(host, self.venv, seed=seed)
+        self.generation = (FusedGenerationStage(self.agent)
+                           if self.tier == "anakin"
+                           else GenerationStage(host, self.venv, seed=seed))
 
     def _make_scorer(self, p: dict):
         from relayrl_tpu.rlhf.scorers import make_scorer

@@ -412,10 +412,13 @@ class VectorAgent:
         identity: str | None = None,
         host_mode: str | None = None,
         jax_env: str | None = None,
+        jax_env_kwargs: dict | None = None,
         unroll_length: int | None = None,
         columnar_wire: bool | None = None,
         async_emit: bool | None = None,
         emit_coalesce_frames: int | None = None,
+        window_size: int | None = None,
+        record_bver: bool = False,
         send_interceptor=None,
         rng_keys=None,
         **addr_overrides,
@@ -450,8 +453,21 @@ class VectorAgent:
             self.host_mode = "vector"
         self.jax_env = str(jax_env if jax_env is not None
                            else actor_params["jax_env"])
+        # Env-construction kwargs for the anakin tier (e.g. TokenGen's
+        # vocab_size/prompt_len/max_new_tokens), forwarded to the JAX
+        # env registry; inert on the vector tier (host-bound envs are
+        # built by the driver, not the agent).
+        self.jax_env_kwargs = dict(jax_env_kwargs or {})
         self.unroll_length = int(unroll_length if unroll_length is not None
                                  else actor_params["unroll_length"])
+        # actor.window_size: narrows the sequence-policy rolling window
+        # below the model context (anakin scan carry; the vector host
+        # sizes its windows from the model arch directly).
+        self.window_size = (actor_params.get("window_size")
+                            if window_size is None else window_size)
+        # Per-token behavior-version evidence (RLHF): stamp ``bver``
+        # into each record's aux on the anakin tier.
+        self.record_bver = bool(record_bver)
         # actor.columnar_wire: "auto" -> columnar frames on the anakin
         # tier (whole-segment frames decoded server-side straight into
         # the staging slabs), per-record wire on the host-bound tiers.
@@ -527,9 +543,13 @@ class VectorAgent:
                     max_traj_length=self.config.get_max_traj_length(),
                     on_send=self._send_lane,
                     seed=self._seed,
+                    rng_keys=self._rng_keys,
                     columnar_wire=self.columnar_wire,
                     async_emit=self.async_emit,
                     emit_coalesce_frames=self.emit_coalesce_frames,
+                    window_size=self.window_size,
+                    record_bver=self.record_bver,
+                    **self.jax_env_kwargs,
                 )
             else:
                 self.host = VectorActorHost(

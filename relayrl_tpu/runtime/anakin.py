@@ -49,6 +49,8 @@ from relayrl_tpu.models import build_policy, validate_policy
 from relayrl_tpu.runtime.policy_actor import (
     apply_bundle_swap,
     apply_wire_swap,
+    resolve_actor_context,
+    window_advance,
 )
 from relayrl_tpu.types.action import ActionRecord
 from relayrl_tpu.types.columnar import (
@@ -70,7 +72,8 @@ def resolve_jax_env(env, **env_kwargs) -> JaxEnv:
     return make_jax(str(env), **env_kwargs)
 
 
-def make_fused_rollout(policy, env: JaxEnv, unroll_length: int):
+def make_fused_rollout(policy, env: JaxEnv, unroll_length: int,
+                       sequence: bool = False):
     """Build the one-dispatch window producer:
 
     ``fn(params, explore, carry) -> (carry, window)`` where ``carry`` is
@@ -82,8 +85,43 @@ def make_fused_rollout(policy, env: JaxEnv, unroll_length: int):
     retraces); the env composition is :func:`step_autoreset`, so episode
     boundaries stay on-device. The carry is donated on accelerator
     backends — the window producer is a ring, not an allocator.
+
+    ``sequence=True`` runs sequence policies: the carry grows a per-lane
+    rolling observation window (``[W, obs_dim]`` ring + valid-length
+    counter, advanced by :func:`window_advance` — the same rule every
+    host tier pushes with) and each step dispatches through
+    ``policy.step_window`` with the post-push count of real rows, so the
+    action stream is bit-identical to a vector-tier ``step_window`` lane
+    at the same key. The window resets to empty at in-scan autoreset
+    boundaries via the same ``jnp.where`` masking ``step_autoreset``
+    uses for the env state — a new episode never attends the previous
+    one's tail. Shipped obs follow ``normalize_obs``'s wire-dtype rule
+    (uint8 stays uint8, everything else float32) because the vector
+    tier normalizes BEFORE windowing, and byte parity rides on it.
+    The window recomputes attention from the ring each step — the
+    KV-cache (``step_cached``) stays off the scan path: a cache carry
+    would be ``[W, n_layers, n_heads, ...]`` per lane and its positions
+    shift on every roll, which re-materializes the whole cache anyway.
     """
     def lane_rollout(params, explore, carry):
+        def seq_body(c, _):
+            pkey, ekey, state, obs, win, wlen = c
+            pkey, sub = jax.random.split(pkey)
+            wire_obs = (obs if obs.dtype == jnp.uint8
+                        else jnp.asarray(obs, jnp.float32))
+            win, wlen = window_advance(win, wlen, wire_obs)
+            # step_window takes the post-push count of REAL rows (it
+            # reads out at t-1 itself) — same convention as the hosts.
+            act, aux = policy.step_window(params, sub, win, wlen, None)
+            (ekey, state, next_obs, rew, term, trunc,
+             final_obs) = step_autoreset(env, ekey, state, act)
+            done = jnp.logical_or(term, trunc)
+            win = jnp.where(done, jnp.zeros_like(win), win)
+            wlen = jnp.where(done, jnp.int32(0), wlen)
+            out = {"obs": wire_obs, "act": act, "rew": rew, "term": term,
+                   "trunc": trunc, "final_obs": final_obs, "aux": aux}
+            return (pkey, ekey, state, next_obs, win, wlen), out
+
         def body(c, _):
             pkey, ekey, state, obs = c
             pkey, sub = jax.random.split(pkey)
@@ -94,7 +132,8 @@ def make_fused_rollout(policy, env: JaxEnv, unroll_length: int):
                    "trunc": trunc, "final_obs": final_obs, "aux": aux}
             return (pkey, ekey, state, next_obs), out
 
-        return jax.lax.scan(body, carry, None, length=unroll_length)
+        return jax.lax.scan(seq_body if sequence else body, carry, None,
+                            length=unroll_length)
 
     vect = jax.vmap(lane_rollout, in_axes=(None, None, 0))
     # Donation is honored on TPU/GPU; CPU hosts would warn per dispatch.
@@ -112,6 +151,13 @@ class AnakinActorHost:
     dispatch. ``rng_keys`` (stacked ``[N, 2]``) overrides the default
     per-lane policy-key derivation, mirroring VectorActorHost's parity
     hook.
+
+    Sequence policies (windowed transformers) run fused too: the scan
+    carry holds each lane's rolling observation window, ``window_size``
+    optionally narrows it below the model context (clamped exactly like
+    ``actor.window_size`` on the other tiers), and ``record_bver=True``
+    stamps each record's producing model version into the aux plane —
+    the per-token behavior evidence the RLHF score stage reads.
     """
 
     def __init__(
@@ -128,6 +174,8 @@ class AnakinActorHost:
         columnar_wire: bool = True,
         async_emit: bool = False,
         emit_coalesce_frames: int = 1,
+        window_size: int | None = None,
+        record_bver: bool = False,
         **env_kwargs,
     ):
         if num_envs < 1:
@@ -149,17 +197,36 @@ class AnakinActorHost:
         self.policy = build_policy(self.arch)
         if validate:
             validate_policy(self.policy, bundle.params)
+        # Sequence policies run fused: the scan carry grows a per-lane
+        # rolling window sized to the model's serving context (narrowed
+        # by actor.window_size when set — never widened past it, the
+        # same clamp resolve_actor_context applies on the other tiers).
+        self._window_size: int | None = None
         if self.policy.step_window is not None:
+            ctx = resolve_actor_context(self.arch)
+            self._window_size = (ctx if window_size is None
+                                 else max(1, min(int(window_size), ctx)))
+        elif getattr(self.policy, "step_cached", None) is not None:
             raise ValueError(
-                "sequence policies are not supported by the fused rollout "
-                "engine yet (the scan carry would need the rolling window "
-                "pytree); use actor.host_mode=\"vector\"")
+                "KV-cache-only policies (step_cached without step_window) "
+                "cannot run in the fused scan — the cache carry's "
+                "positions shift on every window roll, so the scan "
+                "recomputes from the rolling window instead; use "
+                "actor.host_mode=\"process\" for the cached single-lane "
+                "path or the serving plane (InferenceService) for "
+                "stateless clients")
         self.params = bundle.params
         self.version = bundle.version
         self._explore_kwargs = exploration_kwargs(self.arch)
         self._wire_decoder = None  # one decoder, all lanes (see VectorActorHost)
+        # Per-token behavior evidence for the RLHF plane: stamp each
+        # record's producing model version (``bver``) into the window's
+        # aux at unstack. Opt-in — it widens the wire by one int32
+        # column, so plain RL rollouts keep their bytes.
+        self.record_bver = bool(record_bver)
         self._rollout_fn = make_fused_rollout(
-            self.policy, self.env, self.unroll_length)
+            self.policy, self.env, self.unroll_length,
+            sequence=self._window_size is not None)
 
         # Per-lane key derivation matches VectorActorHost (policy keys
         # split from PRNGKey(seed)); env reset/autoreset keys come from an
@@ -179,7 +246,16 @@ class AnakinActorHost:
         init_keys, carry_keys = (reset_keys[: self.num_envs],
                                  reset_keys[self.num_envs:])
         states, obs = jax.jit(jax.vmap(self.env.reset))(init_keys)
-        self._carry = (pol_keys, carry_keys, states, obs)
+        if self._window_size is not None:
+            # Windows are ALWAYS float32, matching both host tiers —
+            # the push casts, the wire obs keeps normalize_obs's dtype.
+            win = jnp.zeros(
+                (self.num_envs, self._window_size, int(self.env.obs_dim)),
+                jnp.float32)
+            wlen = jnp.zeros(self.num_envs, jnp.int32)
+            self._carry = (pol_keys, carry_keys, states, obs, win, wlen)
+        else:
+            self._carry = (pol_keys, carry_keys, states, obs)
 
         # Wire form: ``columnar_wire=True`` (the anakin-tier default,
         # config ``actor.columnar_wire``) ships each completed per-lane
@@ -281,6 +357,12 @@ class AnakinActorHost:
         reg.gauge("relayrl_actor_unroll_length",
                   "env steps per lane per fused rollout dispatch").set(
                       self.unroll_length)
+        if self._window_size is not None:
+            reg.gauge(
+                "relayrl_actor_window_size",
+                "rolling observation-window rows per lane in the fused "
+                "sequence scan carry (0 rows = feed-forward policy)"
+            ).set(self._window_size)
 
     # -- fused action API --
     def rollout(self) -> dict:
@@ -298,11 +380,19 @@ class AnakinActorHost:
             # window: every step of this window is computed by a single
             # model version (maybe_swap's atomicity across lanes AND
             # unroll steps).
+            version = self.version
             self._carry, window = self._rollout_fn(
                 self.params, self._explore_kwargs, self._carry)
         window = jax.block_until_ready(window)
         t1 = time.monotonic()
         host_window = jax.device_get(window)
+        if self.record_bver:
+            # The whole window is one model version by construction
+            # (params read once under the lock), so the stamp is a fill.
+            host_window = dict(host_window)
+            host_window["aux"] = dict(host_window["aux"])
+            host_window["aux"]["bver"] = np.full(
+                (self.num_envs, self.unroll_length), version, np.int32)
         if self.async_emit:
             if self._emit_error is not None:
                 err, self._emit_error = self._emit_error, None

@@ -67,6 +67,7 @@ from relayrl_tpu.transport.serving import (
     unpack_infer_any,
     unpack_infer_request,
 )
+from relayrl_tpu.runtime.policy_actor import push_window
 from relayrl_tpu.types.action import ActionRecord
 from relayrl_tpu.types.model_bundle import ModelBundle, exploration_kwargs
 from relayrl_tpu.types.trajectory import Trajectory
@@ -717,15 +718,9 @@ class InferenceService:
 
     @staticmethod
     def _push_session(sess: _Session, obs: np.ndarray) -> None:
-        # Mirrors PolicyActor._push_window exactly — the parity contract
-        # requires the served window to roll the way a local one does.
-        w = sess.window
-        if sess.length < w.shape[0]:
-            w[sess.length] = obs
-            sess.length += 1
-        else:
-            w[:-1] = w[1:]
-            w[-1] = obs
+        # The parity contract requires the served window to roll the way
+        # a local one does — so advance through the shared rule.
+        sess.length, _ = push_window(sess.window, sess.length, obs)
 
     def _evict_lru(self) -> None:
         from relayrl_tpu import telemetry

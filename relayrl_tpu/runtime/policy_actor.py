@@ -19,6 +19,7 @@ import threading
 from typing import Any, Mapping
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from relayrl_tpu.models import build_policy, validate_policy
@@ -46,6 +47,46 @@ def resolve_actor_context(arch) -> int:
             f"actor_context {ctx} exceeds the model's max_seq_len "
             f"{max_seq} (positional table size)")
     return ctx
+
+
+def push_window(window: np.ndarray, length: int, obs) -> tuple[int, bool]:
+    """Advance one rolling observation-history window in place: write
+    ``obs`` at ``length`` while the window is filling, else shift left by
+    one and write at the end. Returns ``(new_length, rolled)``.
+
+    This is THE window-advance rule — the single copy every tier that
+    serves sequence policies goes through (PolicyActor's per-episode
+    window, VectorActorHost's stacked per-lane windows, the serving
+    plane's session table), and the numpy half of the parity pair with
+    :func:`window_advance`, the functional JAX twin the anakin scan
+    carry uses. The byte-parity contract across tiers rides on all four
+    call sites advancing identically (the PR 3 window off-by-one lived
+    in exactly this duplication)."""
+    cap = window.shape[0]
+    if length < cap:
+        window[length] = obs
+        return length + 1, False
+    window[:-1] = window[1:]  # rolling: drop the oldest
+    window[-1] = obs
+    return cap, True
+
+
+def window_advance(window, length, obs):
+    """Functional JAX twin of :func:`push_window` for scan carries (the
+    anakin tier's per-lane rolling window): fixed shapes, traced length,
+    no in-place mutation. Returns ``(new_window, new_length)`` with
+    exactly :func:`push_window`'s semantics — filling writes at
+    ``length``, a full window shifts left and writes at ``cap - 1``,
+    ``new_length`` saturates at ``cap`` (the count of real rows
+    ``step_window`` expects). The numpy/JAX pair is locked row-for-row
+    by tests/test_anakin.py's helper-parity golden."""
+    cap = window.shape[0]
+    length = jnp.asarray(length, jnp.int32)
+    rolled = length >= cap
+    shifted = jnp.where(rolled, jnp.roll(window, -1, axis=0), window)
+    new_window = shifted.at[jnp.minimum(length, cap - 1)].set(
+        jnp.asarray(obs, window.dtype))
+    return new_window, jnp.minimum(length + 1, cap)
 
 
 def apply_bundle_swap(actor, bundle: "ModelBundle") -> bool:
@@ -424,13 +465,9 @@ class PolicyActor:
     def _push_window(self, obs: np.ndarray) -> bool:
         """Append one observation to the rolling history (lock held).
         Returns True once the window has started rolling."""
-        if self._window_len < self._window.shape[0]:
-            self._window[self._window_len] = obs
-            self._window_len += 1
-            return False
-        self._window[:-1] = self._window[1:]  # rolling: drop the oldest
-        self._window[-1] = obs
-        return True
+        self._window_len, rolled = push_window(
+            self._window, self._window_len, obs)
+        return rolled
 
     def _rebuild_cache(self, t: int) -> None:
         """Fresh cache, refilled from the stored window (lock held) —

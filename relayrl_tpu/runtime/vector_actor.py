@@ -42,6 +42,7 @@ from relayrl_tpu.runtime.policy_actor import (
     make_batched_step,
     make_batched_window_step,
     normalize_obs,
+    push_window,
     resolve_actor_context,
 )
 from relayrl_tpu.types.action import ActionRecord
@@ -254,16 +255,13 @@ class VectorActorHost:
 
     def _push_windows(self, obs: np.ndarray) -> None:
         """Append one observation per lane to the stacked rolling history
-        (lock held). Lanes at capacity roll independently."""
-        cap = self._windows.shape[1]
+        (lock held). Lanes at capacity roll independently — each goes
+        through the shared push_window rule so the byte-parity contract
+        can't drift across tiers."""
         for lane in range(self.num_envs):
-            t = int(self._window_lens[lane])
-            if t < cap:
-                self._windows[lane, t] = obs[lane]
-                self._window_lens[lane] = t + 1
-            else:
-                self._windows[lane, :-1] = self._windows[lane, 1:]
-                self._windows[lane, -1] = obs[lane]
+            self._window_lens[lane], _ = push_window(
+                self._windows[lane], int(self._window_lens[lane]),
+                obs[lane])
 
 
 def run_vector_gym_loop(host, venv, steps: int,

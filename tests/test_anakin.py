@@ -37,6 +37,20 @@ def _bundle(obs_dim=4, act_dim=2, seed=0, version=0):
     return ModelBundle(version=version, arch=arch, params=params)
 
 
+def _seq_bundle(obs_dim=4, act_dim=2, max_seq_len=8, seed=0, version=0):
+    """Deterministic windowed-transformer bundle (a ``step_window``
+    sequence policy — the fused scan's rolling-window carry path)."""
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    arch = {"kind": "transformer_discrete", "obs_dim": obs_dim,
+            "act_dim": act_dim, "d_model": 16, "n_layers": 1, "n_heads": 2,
+            "max_seq_len": max_seq_len}
+    policy = build_policy(arch)
+    params = policy.init_params(jax.random.PRNGKey(seed))
+    return ModelBundle(version=version, arch=arch, params=params)
+
+
 class TestUnstackWireSemantics:
     def test_episode_stream_matches_live_loop_shape(self, tmp_cwd):
         """Each shipped episode ends in a terminal marker carrying the
@@ -160,20 +174,38 @@ class TestSwapGates:
             AnakinActorHost(_bundle(obs_dim=6), "CartPole-v1",
                             num_envs=1, unroll_length=4)
 
-    def test_sequence_policy_refused(self, tmp_cwd):
-        from relayrl_tpu.models import build_policy
-        from relayrl_tpu.runtime.anakin import AnakinActorHost
-        from relayrl_tpu.types.model_bundle import ModelBundle
+    def test_kv_cache_only_policy_refused(self, tmp_cwd, monkeypatch):
+        """Sequence policies run fused now; the one remaining refusal is
+        KV-cache-only policies (``step_cached`` without ``step_window``),
+        and its message must name the tiers that DO serve them."""
+        import dataclasses
 
-        arch = {"kind": "transformer_discrete", "obs_dim": 4, "act_dim": 2,
-                "d_model": 16, "n_layers": 1, "n_heads": 2,
-                "max_seq_len": 16}
-        policy = build_policy(arch)
-        bundle = ModelBundle(version=0, arch=arch,
-                             params=policy.init_params(jax.random.PRNGKey(0)))
-        with pytest.raises(ValueError, match="sequence"):
-            AnakinActorHost(bundle, "CartPole-v1", num_envs=1,
-                            unroll_length=4, validate=False)
+        from relayrl_tpu.models import build_policy
+        from relayrl_tpu.runtime import anakin as anakin_mod
+
+        def cache_only(arch):
+            return dataclasses.replace(build_policy(arch),
+                                       step_window=None, mode_window=None)
+
+        monkeypatch.setattr(anakin_mod, "build_policy", cache_only)
+        with pytest.raises(ValueError, match="KV-cache"):
+            anakin_mod.AnakinActorHost(_seq_bundle(), "CartPole-v1",
+                                       num_envs=1, unroll_length=4,
+                                       validate=False)
+
+    def test_window_size_clamps_to_model_context(self, tmp_cwd):
+        """``window_size`` narrows the scan-carry ring but can never
+        widen past the model's positional table."""
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+        wide = AnakinActorHost(_seq_bundle(max_seq_len=8), "CartPole-v1",
+                               num_envs=1, unroll_length=4,
+                               window_size=512, seed=0)
+        assert wide._window_size == 8
+        narrow = AnakinActorHost(_seq_bundle(max_seq_len=8), "CartPole-v1",
+                                 num_envs=1, unroll_length=4,
+                                 window_size=0, seed=0)
+        assert narrow._window_size == 1
 
 
 _DETERMINISM_SCRIPT = """
@@ -218,6 +250,201 @@ def test_cross_process_determinism(tmp_path):
     assert digests[0] == digests[1]
 
 
+class TestFusedSequenceRollout:
+    def test_window_helpers_agree(self):
+        """``push_window`` (host numpy rule) and ``window_advance``
+        (its functional scan-carry twin) are ONE rule: identical ring
+        bytes + length at every step through fill, roll, and past
+        capacity."""
+        import jax.numpy as jnp
+
+        from relayrl_tpu.runtime.policy_actor import (push_window,
+                                                      window_advance)
+
+        rng = np.random.default_rng(0)
+        win_np = np.zeros((4, 3), np.float32)
+        win_jx = jnp.zeros((4, 3), jnp.float32)
+        len_np, len_jx = 0, jnp.int32(0)
+        adv = jax.jit(window_advance)
+        for step in range(11):
+            obs = rng.standard_normal(3).astype(np.float32)
+            len_np, rolled = push_window(win_np, len_np, obs)
+            win_jx, len_jx = adv(win_jx, len_jx, obs)
+            np.testing.assert_array_equal(win_np, np.asarray(win_jx))
+            assert len_np == int(len_jx)
+            assert rolled == (step >= 4)
+
+    def test_fused_sequence_ships_episodes(self, tmp_cwd):
+        """A windowed transformer runs INSIDE the scan: per-record wire
+        episodes carry f32 obs plus the logp_a/v aux, and ``record_bver``
+        stamps the behavior version on every step (the RLHF V-trace
+        evidence)."""
+        from relayrl_tpu import telemetry
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+        from relayrl_tpu.types.trajectory import deserialize_actions
+
+        telemetry.reset_for_tests()
+        telemetry.set_registry(telemetry.Registry(run_id="fused-seq"))
+        sent: list[bytes] = []
+        host = AnakinActorHost(
+            _seq_bundle(max_seq_len=8, version=3), "CartPole-v1",
+            num_envs=4, unroll_length=64, columnar_wire=False,
+            record_bver=True,
+            on_send=lambda lane, p: sent.append(p), seed=2)
+        host.rollout()
+        assert len(sent) >= 4
+        for payload in sent:
+            acts = deserialize_actions(payload)
+            marker, steps = acts[-1], acts[:-1]
+            assert marker.done and marker.act is None
+            for rec in steps:
+                assert rec.obs.dtype == np.float32
+                assert set(rec.data) == {"logp_a", "v", "bver"}
+                assert int(rec.data["bver"]) == 3
+        names = {m["name"]
+                 for m in telemetry.get_registry().snapshot()["metrics"]}
+        telemetry.reset_for_tests()
+        assert "relayrl_actor_window_size" in names
+
+
+class _JaxVectorTwin:
+    """Gym-like vector facade over the SAME on-device env stream the
+    fused host scans: identical key derivation (the ``0x0E74`` env-root
+    fold, one 2N reset split into init + carry keys) and the identical
+    ``step_autoreset`` composition — so a vector-tier host driven through
+    the REAL ``run_vector_gym_loop`` replays the fused scan's exact
+    observation/reward/done stream on the host side."""
+
+    def __init__(self, env, num_envs: int, seed: int):
+        from relayrl_tpu.envs.jax.base import step_autoreset
+
+        self.env = env
+        self.num_envs = int(num_envs)
+        env_root = jax.random.fold_in(jax.random.PRNGKey(seed), 0x0E74)
+        reset_keys = jax.random.split(env_root, 2 * num_envs)
+        self._init_keys = reset_keys[:num_envs]
+        self._keys = reset_keys[num_envs:]
+        self._states = None
+        self._reset_fn = jax.jit(jax.vmap(env.reset))
+        self._step_fn = jax.jit(jax.vmap(
+            lambda k, s, a: step_autoreset(env, k, s, a)))
+
+    def reset(self, seed=None):
+        self._states, obs = self._reset_fn(self._init_keys)
+        return np.asarray(obs), [{} for _ in range(self.num_envs)]
+
+    def step(self, actions):
+        import jax.numpy as jnp
+
+        acts = jnp.asarray(np.asarray(actions))
+        (self._keys, self._states, obs, rew, term, trunc,
+         stepped) = self._step_fn(self._keys, self._states, acts)
+        term, trunc = np.asarray(term), np.asarray(trunc)
+        stepped = np.asarray(stepped)
+        # run_vector_gym_loop's contract: the pre-reset observation rides
+        # the per-lane info dict for the time-limit bootstrap.
+        infos = [({"final_observation": stepped[i]}
+                  if (term[i] or trunc[i]) else {})
+                 for i in range(self.num_envs)]
+        return np.asarray(obs), np.asarray(rew), term, trunc, infos
+
+
+class TestFusedSequenceCrossTierParity:
+    """THE acceptance golden: the fused sequence scan ships episodes
+    BYTE-identical to the vector-tier ``step_window`` path at the same
+    seed + params — across in-scan autoreset boundaries (the rolling
+    window must reset, never leak between episodes), through genuine
+    terminations AND time-limit truncations (the bootstrap ``final_obs``
+    marker), in both wire forms."""
+
+    # max_steps=18 against random-policy CartPole episode lengths gives
+    # every run BOTH ending kinds (pole falls < 18 / time limit at 18)
+    # while the W=8 ring still rolls well past capacity.
+    N, UNROLL, SEED, MAX_STEPS = 2, 150, 3, 18
+
+    def _run_fused(self, columnar: bool):
+        from relayrl_tpu.envs.jax import JaxCartPole
+        from relayrl_tpu.runtime.anakin import AnakinActorHost
+
+        per_lane: dict[int, list[bytes]] = {k: [] for k in range(self.N)}
+        host = AnakinActorHost(
+            _seq_bundle(max_seq_len=8),
+            JaxCartPole(max_steps=self.MAX_STEPS),
+            num_envs=self.N, unroll_length=self.UNROLL,
+            columnar_wire=columnar,
+            on_send=lambda lane, p: per_lane[lane].append(p),
+            seed=self.SEED)
+        host.rollout()
+        return per_lane
+
+    def _run_vector(self):
+        from relayrl_tpu.envs.jax import JaxCartPole
+        from relayrl_tpu.runtime.vector_actor import (VectorActorHost,
+                                                      run_vector_gym_loop)
+
+        per_lane: dict[int, list[bytes]] = {k: [] for k in range(self.N)}
+        host = VectorActorHost(
+            _seq_bundle(max_seq_len=8), num_envs=self.N,
+            on_send=lambda lane, p: per_lane[lane].append(p),
+            seed=self.SEED)
+        twin = _JaxVectorTwin(JaxCartPole(max_steps=self.MAX_STEPS),
+                              self.N, self.SEED)
+        run_vector_gym_loop(host, twin, steps=self.UNROLL)
+        return per_lane
+
+    def test_per_record_wire_bytes_identical(self, tmp_cwd):
+        from relayrl_tpu.types.trajectory import deserialize_actions
+
+        fused = self._run_fused(columnar=False)
+        vector = self._run_vector()
+        markers = []
+        for lane in range(self.N):
+            # Enough episodes that the W=8 ring rolled and reset across
+            # several in-scan autoreset boundaries.
+            assert len(fused[lane]) >= 2, "need autoreset boundaries"
+            assert fused[lane] == vector[lane], (
+                f"lane {lane}: fused scan bytes diverged from the "
+                f"vector step_window tier")
+            markers += [deserialize_actions(p)[-1] for p in fused[lane]]
+        # The stream crossed both ending kinds (truncation ships the
+        # bootstrap obs; termination ships none).
+        assert any(m.truncated for m in markers)
+        assert any(not m.truncated for m in markers)
+
+    def test_columnar_frames_decode_identical_to_vector_tier(self,
+                                                             tmp_cwd):
+        """The columnar wire form of the SAME contract: a fused frame
+        parses into exactly the DecodedTrajectory the native decoder
+        produces from the vector tier's per-record payload."""
+        from relayrl_tpu.types.columnar import (NativeDecoder,
+                                                native_codec_available,
+                                                parse_frame)
+
+        if not native_codec_available():
+            pytest.skip("native codec unavailable")
+        fused = self._run_fused(columnar=True)
+        vector = self._run_vector()
+        dec = NativeDecoder()
+        for lane in range(self.N):
+            assert len(fused[lane]) == len(vector[lane]) >= 2
+            for frame, payload in zip(fused[lane], vector[lane]):
+                a = parse_frame(frame, agent_id="x")
+                b = dec.decode(payload, agent_id="x")
+                assert (a.n_steps, a.n_records, a.marker_truncated) == \
+                    (b.n_steps, b.n_records, b.marker_truncated)
+                assert set(a.columns) == set(b.columns)
+                for k in a.columns:
+                    assert a.columns[k].dtype == b.columns[k].dtype, k
+                    assert a.columns[k].tobytes() == \
+                        b.columns[k].tobytes(), k
+                assert set(a.aux) == set(b.aux)
+                for k in a.aux:
+                    assert a.aux[k].tobytes() == b.aux[k].tobytes(), k
+                assert (a.final_obs is None) == (b.final_obs is None)
+                if a.final_obs is not None:
+                    assert a.final_obs.tobytes() == b.final_obs.tobytes()
+
+
 class TestConfigKnobs:
     def test_actor_params_anakin(self, tmp_path):
         from relayrl_tpu.config import ConfigLoader
@@ -242,6 +469,21 @@ class TestConfigKnobs:
         assert params["host_mode"] == "process"  # unknown mode degrades
         assert params["unroll_length"] == 32
         assert params["jax_env"] == "CartPole-v1"
+
+    def test_actor_window_size_clamps(self, tmp_path):
+        from relayrl_tpu.config import ConfigLoader
+
+        counter = iter(range(100))
+
+        def load(actor):
+            path = tmp_path / f"cfg{next(counter)}.json"
+            path.write_text(json.dumps({"actor": actor}))
+            return ConfigLoader(None, str(path)).get_actor_params()
+
+        assert load({})["window_size"] is None  # defer to model context
+        assert load({"window_size": 12})["window_size"] == 12
+        assert load({"window_size": -3})["window_size"] == 1
+        assert load({"window_size": "bogus"})["window_size"] is None
 
 
 class TestNetworkedAnakinZmq:
@@ -332,15 +574,31 @@ def _wait_status(scratch, proc, pred, timeout_s, what) -> dict:
     raise AssertionError(f"timed out waiting for {what}; last={status}")
 
 
+# The fused-sequence drill trains a REINFORCE transformer: episodes must
+# fit the positional table, so the env truncates at 48 and the bucket is
+# 64 (carried in hyperparams — the subprocess scratch config has no
+# learner section). The agent-side window (16) is narrower than the
+# truncation horizon, so the scan ring genuinely rolls AND resets
+# through the outage.
+_SEQ_DRILL_HP = {
+    "traj_per_epoch": 4, "model_kind": "transformer_discrete",
+    "d_model": 16, "n_layers": 1, "n_heads": 2, "max_seq_len": 64,
+    "bucket_lengths": [64], "with_vf_baseline": False,
+}
+
+
 @pytest.mark.slow  # ISSUE 17 wall re-fit: SIGKILL mechanism covered fast by test_recovery's zmq drill
-def test_learner_sigkill_restart_with_anakin_actors_zero_loss(tmp_path,
-                                                              tmp_cwd):
+@pytest.mark.parametrize("policy_kind", ["mlp", "sequence"])
+def test_learner_sigkill_restart_with_anakin_actors_zero_loss(
+        tmp_path, tmp_cwd, policy_kind):
     """The acceptance drill: SIGKILL the learner mid-run while a fused
     anakin host keeps producing windows INTO the outage (the env lives
     on the actor's device — env-steps never stop), restart with resume,
     and assert zero loss / zero double-train per LANE through the
     existing spool → replay → sequence-dedup plane, plus model-version
-    continuity across the crash."""
+    continuity across the crash. Runs twice: the MLP scan and the
+    fused-sequence (rolling-window transformer) scan — the spool/replay
+    plane must be policy-shape-agnostic."""
     scratch = str(tmp_path)
     ports = [free_port() for _ in range(3)]
     server_addrs = {"agent_listener_addr": f"tcp://127.0.0.1:{ports[0]}",
@@ -350,11 +608,17 @@ def test_learner_sigkill_restart_with_anakin_actors_zero_loss(tmp_path,
                    "trajectory_addr": f"tcp://127.0.0.1:{ports[1]}",
                    "model_sub_addr": f"tcp://127.0.0.1:{ports[2]}"}
 
+    hyperparams = (dict(_SEQ_DRILL_HP) if policy_kind == "sequence"
+                   else {"traj_per_epoch": 4, "hidden_sizes": [16, 16],
+                         "with_vf_baseline": False})
+    agent_env_kwargs = ({"jax_env_kwargs": {"max_steps": 48},
+                         "window_size": 16}
+                        if policy_kind == "sequence" else {})
+
     def spawn(resume: bool) -> subprocess.Popen:
         cfg = {
             "algorithm": "REINFORCE", "obs_dim": 4, "act_dim": 2,
-            "hyperparams": {"traj_per_epoch": 4, "hidden_sizes": [16, 16],
-                            "with_vf_baseline": False},
+            "hyperparams": hyperparams,
             "server_type": "zmq", "scratch": scratch,
             "checkpoint_every": 1, "resume": resume,
             "status_path": os.path.join(scratch, "status.json"),
@@ -379,7 +643,7 @@ def test_learner_sigkill_restart_with_anakin_actors_zero_loss(tmp_path,
             num_envs=2, server_type="zmq", handshake_timeout_s=60,
             seed=0, probe=False, host_mode="anakin",
             jax_env="CartPole-v1", unroll_length=16,
-            identity="anakin-chaos", **agent_addrs)
+            identity="anakin-chaos", **agent_env_kwargs, **agent_addrs)
         # Phase 1: train until a checkpoint base exists.
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:

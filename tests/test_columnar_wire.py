@@ -161,6 +161,13 @@ class TestEmitterParity:
         "cartpole": ("CartPole-v1", None, {}, 1000),
         "cartpole_chunked": ("CartPole-v1", None, {}, 17),
         "cartpole_truncating": ("CartPole-v1", None, {"max_steps": 5}, 1000),
+        # Fused-sequence scan (ISSUE 20): the rolling-window carry must
+        # unstack to the same frames as the per-record path — truncating
+        # past W=8 so the ring rolls AND resets inside the scan.
+        "cartpole_sequence": (
+            "CartPole-v1",
+            {"kind": "transformer_discrete", "d_model": 16, "n_layers": 1,
+             "n_heads": 2, "max_seq_len": 8}, {"max_steps": 18}, 1000),
         "pendulum_continuous": (
             "Pendulum-v1",
             {"kind": "mlp_continuous", "obs_dim": 3, "act_dim": 1}, {}, 1000),

@@ -166,6 +166,35 @@ def _generate_episode(seed: int, vocab=6, prompt_len=2, max_new=5):
     return sent[0], env
 
 
+def _fused_generation_frames(seed=0, vocab=6, prompt_len=2, max_new=6,
+                             lanes=2, unroll=24):
+    """Fused-scan TokenGen episodes as columnar frames through a real
+    AnakinActorHost — the anakin generation tier's wire form (ISSUE 20):
+    whole episodes, per-token logp_a/v aux, bver stamped at unstack."""
+    import jax
+
+    from relayrl_tpu.models import build_policy
+    from relayrl_tpu.runtime.anakin import AnakinActorHost
+    from relayrl_tpu.types.model_bundle import ModelBundle
+
+    ctx = prompt_len + max_new
+    arch = {"kind": "transformer_discrete", "obs_dim": ctx,
+            "act_dim": vocab, "d_model": 16, "n_layers": 1, "n_heads": 2,
+            "max_seq_len": ctx}
+    policy = build_policy(arch)
+    bundle = ModelBundle(version=2, arch=arch,
+                         params=policy.init_params(jax.random.PRNGKey(seed)))
+    sent: list[tuple[int, bytes]] = []
+    host = AnakinActorHost(
+        bundle, "TokenGen-v0", num_envs=lanes, unroll_length=unroll,
+        columnar_wire=True, record_bver=True,
+        on_send=lambda lane, p: sent.append((lane, p)), seed=seed,
+        vocab_size=vocab, prompt_len=prompt_len, max_new_tokens=max_new)
+    host.rollout()
+    assert sent, "fused generation never shipped an episode"
+    return sent
+
+
 class TestScoreStage:
     def test_extract_generation_reconstructs_tokens(self):
         from relayrl_tpu.rlhf.scheduler import extract_generation
@@ -232,6 +261,59 @@ class TestScoreStage:
             expected = sc.score_np(tokens, 2, gen_len)
             out = deserialize_actions(out_bytes)
             assert out[-1].rew == expected
+
+    def test_extract_generation_frame_reconstructs_tokens(self, tmp_cwd):
+        """The columnar twin of extract_generation: the full token
+        buffer comes back from the LAST observation row plus the final
+        action (the env never materializes the terminal row), and every
+        generated slot equals the action column that wrote it."""
+        from relayrl_tpu.rlhf.scheduler import extract_generation_frame
+        from relayrl_tpu.types.columnar import parse_frame
+
+        for _lane, frame in _fused_generation_frames():
+            dt = parse_frame(frame)
+            tokens, gen_len = extract_generation_frame(dt, 2)
+            assert gen_len == dt.n_steps >= 1
+            assert tokens.dtype == np.int32
+            first = np.asarray(dt.columns["o"][0]).astype(np.int32)
+            np.testing.assert_array_equal(tokens[:2], first[:2])
+            acts = np.asarray(dt.columns["a"], np.int32).reshape(-1)
+            for i in range(gen_len):
+                assert int(tokens[2 + i]) == int(acts[i]), i
+
+    def test_score_stage_patches_columnar_frame(self, tmp_cwd):
+        """A fused-tier columnar frame flows through the SAME stage:
+        the terminal reward cell is replaced with the score, every other
+        column/aux byte survives, and the submitted frame is never
+        mutated in place."""
+        from relayrl_tpu.rlhf.scheduler import ScoreStage
+        from relayrl_tpu.types.columnar import parse_frame
+
+        _lane, frame = _fused_generation_frames()[0]
+
+        class FixedScorer:
+            def score_np(self, tokens, prompt_len, gen_len):
+                return 7.25
+
+        emitted = []
+        stage = ScoreStage(FixedScorer(), prompt_len=2,
+                           emit_fn=lambda lane, p: emitted.append((lane, p)),
+                           batch=4)
+        stage.submit(3, frame)
+        stage.close()
+        assert len(emitted) == 1 and emitted[0][0] == 3
+        out = parse_frame(emitted[0][1])
+        inp = parse_frame(frame)
+        assert out.columns["r"][-1] == np.float32(7.25)
+        assert inp.columns["r"][-1] == 0.0  # scorer-less env, unmutated
+        np.testing.assert_array_equal(out.columns["r"][:-1],
+                                      inp.columns["r"][:-1])
+        for k in ("o", "a", "t", "u", "x"):
+            assert out.columns[k].tobytes() == inp.columns[k].tobytes(), k
+        assert set(out.aux) == set(inp.aux) >= {"logp_a", "bver"}
+        for k in inp.aux:
+            assert out.aux[k].tobytes() == inp.aux[k].tobytes(), k
+        assert stage.scored_snapshot() == [7.25]
 
 
 # ---------------------------------------------------------------------------
@@ -600,6 +682,63 @@ class TestLivePlane:
                 sched.close()
             server.disable_server()
 
+    def test_fused_generation_tier_anakin(self, tmp_cwd):
+        """ISSUE 20 acceptance: ``rlhf.generation_tier:
+        "anakin"`` moves TokenGen INSIDE the fused scan. The live locks:
+        FusedGenerationStage drives whole rollout windows, withheld
+        episodes come back score-patched as columnar frames, the
+        transformer IMPALA learner trains on them (per-token logp_a +
+        bver intact for V-trace), and the per-lane zero-loss accounting
+        holds on the same spool plane."""
+        from relayrl_tpu.rlhf.scheduler import (FusedGenerationStage,
+                                                RlhfScheduler)
+        from relayrl_tpu.runtime.server import TrainingServer
+
+        config_path = _write_rlhf_config(
+            tmp_cwd / "relayrl_config.json",
+            extra={"rlhf": {"generation_tier": "anakin"}})
+        addrs, agent_addrs = _zmq_addr_pair()
+        telemetry.set_registry(telemetry.Registry(run_id="rlhf-fused"))
+        server = TrainingServer(
+            "IMPALA", obs_dim=8, act_dim=6, env_dir=str(tmp_cwd),
+            hyperparams=dict(_TRANSFORMER_HP), config_path=config_path,
+            **addrs)
+        sched = None
+        try:
+            sched = RlhfScheduler(config_path=config_path,
+                                  server_type="zmq", seed=0,
+                                  identity="rlhf-fused",
+                                  handshake_timeout_s=60, **agent_addrs)
+            assert isinstance(sched.generation, FusedGenerationStage)
+            assert sched.venv is None  # no host-side envs at all
+            stats = sched.run(episodes=64, deadline_s=180)
+            assert stats["episodes_scored"] >= 64
+            # lanes x unroll tokens per round, counted by the stage
+            assert stats["tokens_generated"] >= 128
+            sched.flush()
+            deadline = time.monotonic() + 60
+            while (server.stats["updates"] < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            assert server.stats["updates"] >= 2, "learner never trained"
+            server.drain(timeout=60)
+            acct = server.ingest_accounting()
+            assert len(acct["agents"]) == 4
+            sent = sched.agent.spool.sent_counts()
+            for lane_id, row in acct["agents"].items():
+                assert row["accepted"] == row["max_seq"] == sent[lane_id]
+                assert row["contiguous"]
+            names = {m["name"]
+                     for m in telemetry.get_registry().snapshot()["metrics"]}
+            for metric in ("relayrl_rlhf_generated_tokens_total",
+                           "relayrl_rlhf_scored_episodes_total",
+                           "relayrl_rlhf_stage_seconds"):
+                assert metric in names, metric
+        finally:
+            if sched is not None:
+                sched.close()
+            server.disable_server()
+
     @pytest.mark.slow
     def test_remote_generation_tier_mlp(self, tmp_cwd):
         """(slow: spins a serving plane + thin clients — the fast suite
@@ -832,7 +971,8 @@ class TestConfigAndTop:
         p = tmp_path / "relayrl_config.json"
         p.write_text(json.dumps({"rlhf": {
             "vocab_size": "junk", "prompt_len": -3, "lanes": 0,
-            "scorer": "nope", "generation_tier": "warp"}}))
+            "scorer": "nope", "generation_tier": "warp",
+            "generation_unroll": 0}}))
         loader = ConfigLoader(None, p, create_if_missing=False)
         params = loader.get_rlhf_params()
         assert params["vocab_size"] == 8
@@ -840,6 +980,26 @@ class TestConfigAndTop:
         assert params["lanes"] == 1
         assert params["scorer"] == "programmatic"
         assert params["generation_tier"] == "vector"
+        assert params["generation_unroll"] == 1
+
+    def test_generation_unroll_default_bounds_burst(self):
+        from relayrl_tpu.config import ConfigLoader
+
+        # The fused tier's burst size: one dispatch emits
+        # lanes x generation_unroll same-version tokens, so the default
+        # must stay near the episode budget (max_new_tokens), NOT the
+        # rollout tier's unroll_length (32) — the measured failure mode
+        # is triple-digit train-time version lag and a reward collapse.
+        params = ConfigLoader(None, None).get_rlhf_params()
+        assert params["generation_unroll"] <= params["max_new_tokens"]
+
+    def test_generation_tier_anakin_accepted(self, tmp_path):
+        from relayrl_tpu.config import ConfigLoader
+
+        p = tmp_path / "relayrl_config.json"
+        p.write_text(json.dumps({"rlhf": {"generation_tier": "anakin"}}))
+        loader = ConfigLoader(None, p, create_if_missing=False)
+        assert loader.get_rlhf_params()["generation_tier"] == "anakin"
 
     def test_unknown_rlhf_key_warns(self, tmp_path):
         from relayrl_tpu.config import ConfigLoader
